@@ -1,0 +1,365 @@
+"""256-bit modular arithmetic on TPU via 12-bit limbs in int32 lanes.
+
+This is the foundation of the TPU crypto plane: every scalar-field and
+base-field operation used by batched ECDSA-P256 / ed25519 verification
+(the reference's hot path: /root/reference/bccsp/sw/ecdsa.go:41,
+msp/identities.go:169) runs on arrays shaped (N_LIMBS, B) where B is the
+signature batch dimension.
+
+Design notes (TPU-first):
+- 12-bit limbs stored in int32: schoolbook partial products are <= 2^24 and
+  a full 22-term column sum stays < 2^31, so everything fits int32 lanes —
+  no int64 emulation, no float tricks.
+- limbs-first layout (L, B): the batch axis is minor, so the VPU vectorizes
+  across signatures; limb indexing is static leading-axis slicing.
+- Montgomery (CIOS) multiplication, generic over any odd modulus <= 2^256:
+  the same machinery serves the P-256 base field, the P-256 group order,
+  the curve25519 field, and the ed25519 group order.
+- Limb iteration uses lax.scan so the traced graph stays small (a full
+  ECDSA verify compiles to a few thousand HLO ops, not millions); all
+  shapes are static and there is no data-dependent control flow.
+
+int32 overflow analysis for the CIOS accumulator: each scan step adds
+a_i*b_j + m*p_j <= 2*(2^12-1)^2 ~ 3.36e7 to a limb; a limb lives through at
+most N_LIMBS=22 steps before being shifted out, so its magnitude stays
+below 22*3.36e7 + carry ~ 7.4e8 < 2^31.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+LIMB_BITS = 12
+LIMB_MASK = (1 << LIMB_BITS) - 1
+N_LIMBS = 22  # 264 bits capacity: holds any value < 2*p for p < 2^256
+
+
+# ---------------------------------------------------------------------------
+# Host-side conversions (numpy, used for constants and tests)
+# ---------------------------------------------------------------------------
+
+def int_to_limbs(x: int, n_limbs: int = N_LIMBS) -> np.ndarray:
+    """Little-endian 12-bit limb decomposition of a python int."""
+    if x < 0:
+        raise ValueError("negative")
+    out = np.zeros((n_limbs,), dtype=np.int32)
+    for i in range(n_limbs):
+        out[i] = x & LIMB_MASK
+        x >>= LIMB_BITS
+    if x:
+        raise ValueError("value does not fit in %d limbs" % n_limbs)
+    return out
+
+
+def limbs_to_int(a) -> int:
+    """Inverse of int_to_limbs (host-side; accepts (L,) or (L, 1))."""
+    arr = np.asarray(a).reshape(np.asarray(a).shape[0], -1)
+    if arr.shape[1] != 1:
+        raise ValueError("limbs_to_int expects a single element")
+    return limbs_to_ints(arr)[0]
+
+
+def limbs_to_ints(a) -> list:
+    """Batch version: (L, B) -> list of B python ints."""
+    arr = np.asarray(a)
+    out = []
+    for b in range(arr.shape[1]):
+        x = 0
+        for i in reversed(range(arr.shape[0])):
+            x = (x << LIMB_BITS) | int(arr[i, b])
+        out.append(x)
+    return out
+
+
+def ints_to_limbs(vals) -> np.ndarray:
+    """list of B python ints -> (N_LIMBS, B) int32."""
+    return np.stack([int_to_limbs(v) for v in vals], axis=1)
+
+
+# ---------------------------------------------------------------------------
+# Device-side primitives. All arrays are int32 (L, B); ops return new arrays.
+# ---------------------------------------------------------------------------
+
+def words_be_to_limbs(words) -> jnp.ndarray:
+    """(8, B) uint32 big-endian words -> (N_LIMBS, B) int32 12-bit limbs.
+
+    words[0] is the most significant 32 bits (matches SEC1/RFC8032 byte
+    order after packing bytes big-endian into uint32 words).
+    """
+    w = jnp.asarray(words, dtype=jnp.uint32)
+    wle = w[::-1]  # little-endian word order
+    limbs = []
+    for j in range(N_LIMBS):
+        bitpos = j * LIMB_BITS
+        wi = bitpos // 32
+        shift = bitpos % 32
+        if wi >= 8:
+            limbs.append(jnp.zeros_like(wle[0]))
+            continue
+        val = wle[wi] >> shift
+        if shift > 32 - LIMB_BITS and wi + 1 < 8:
+            val = val | (wle[wi + 1] << (32 - shift))
+        limbs.append(val & LIMB_MASK)
+    return jnp.stack(limbs).astype(jnp.int32)
+
+
+def limbs_to_words_be(a) -> jnp.ndarray:
+    """(N_LIMBS, B) canonical limbs -> (8, B) uint32 big-endian words."""
+    a = jnp.asarray(a, dtype=jnp.uint32)
+    words = []
+    for wi in range(8):  # little-endian word index
+        lo_bit = wi * 32
+        acc = jnp.zeros_like(a[0])
+        for j in range(N_LIMBS):
+            bitpos = j * LIMB_BITS
+            if bitpos + LIMB_BITS <= lo_bit or bitpos >= lo_bit + 32:
+                continue
+            sh = bitpos - lo_bit
+            if sh >= 0:
+                acc = acc | (a[j] << sh)
+            else:
+                acc = acc | (a[j] >> (-sh))
+        words.append(acc)
+    return jnp.stack(words[::-1])
+
+
+def carry_prop(x: jnp.ndarray, n_out: int) -> jnp.ndarray:
+    """Signed carry propagation: (L, B) int32 -> (n_out, B) canonical limbs.
+
+    Accepts limbs with magnitude up to ~2^30 (positive or negative); output
+    limbs are in [0, 2^LIMB_BITS). The total value must be representable in
+    n_out limbs and non-negative.
+    """
+    L = x.shape[0]
+    if L < n_out:
+        pad = jnp.zeros((n_out - L,) + x.shape[1:], dtype=x.dtype)
+        x = jnp.concatenate([x, pad], axis=0)
+    elif L > n_out:
+        raise ValueError("carry_prop cannot drop limbs")
+
+    def body(c, xi):
+        v = xi + c
+        return v >> LIMB_BITS, v & LIMB_MASK
+
+    _, out = lax.scan(body, jnp.zeros_like(x[0]), x)
+    return out
+
+
+def cond_sub(x: jnp.ndarray, c_limbs: np.ndarray) -> jnp.ndarray:
+    """If x >= c then x - c else x.  x: (L, B) canonical limbs, c: (L,) const."""
+    c = jnp.asarray(np.asarray(c_limbs, dtype=np.int32).reshape(-1, *([1] * (x.ndim - 1))))
+
+    def body(borrow, args):
+        xi, ci = args
+        v = xi - ci + borrow
+        return v >> LIMB_BITS, v & LIMB_MASK
+
+    borrow, t = lax.scan(body, jnp.zeros_like(x[0]), (x, jnp.broadcast_to(c, x.shape)))
+    return jnp.where(borrow == 0, t, x)
+
+
+def limbs_eq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """(L, B) x (L, B) -> (B,) bool, exact limb equality."""
+    return jnp.all(a == b, axis=0)
+
+
+def limbs_is_zero(a: jnp.ndarray) -> jnp.ndarray:
+    return jnp.all(a == 0, axis=0)
+
+
+def limbs_lt_const(x: jnp.ndarray, c: int) -> jnp.ndarray:
+    """(L, B) canonical limbs < python-int constant -> (B,) bool."""
+    c_l = jnp.asarray(int_to_limbs(c, x.shape[0]).reshape(-1, *([1] * (x.ndim - 1))))
+
+    def body(borrow, args):
+        xi, ci = args
+        v = xi - ci + borrow
+        return v >> LIMB_BITS, None
+
+    borrow, _ = lax.scan(body, jnp.zeros_like(x[0]), (x, jnp.broadcast_to(c_l, x.shape)))
+    return borrow < 0
+
+
+def bit(a: jnp.ndarray, i: int) -> jnp.ndarray:
+    """Static bit extraction from canonical limbs: (L, B) -> (B,) int32 0/1."""
+    return (a[i // LIMB_BITS] >> (i % LIMB_BITS)) & 1
+
+
+def bits_window(a: jnp.ndarray, lo: int, width: int) -> jnp.ndarray:
+    """Static extraction of bits [lo, lo+width) as a (B,) int32 value."""
+    acc = jnp.zeros_like(a[0])
+    for k in range(width):
+        acc = acc | (bit(a, lo + k) << k)
+    return acc
+
+
+def to_bits(a: jnp.ndarray, n_bits: int) -> jnp.ndarray:
+    """(L, B) canonical limbs -> (n_bits, B) int32 bits, LSB first.
+
+    Vectorized: expands each limb into LIMB_BITS rows, then trims.
+    """
+    L = a.shape[0]
+    shifts = jnp.arange(LIMB_BITS, dtype=jnp.int32).reshape(1, LIMB_BITS, *([1] * (a.ndim - 1)))
+    expanded = (a[:, None] >> shifts) & 1  # (L, LIMB_BITS, B)
+    flat = expanded.reshape((L * LIMB_BITS,) + a.shape[1:])
+    return flat[:n_bits]
+
+
+# ---------------------------------------------------------------------------
+# Montgomery context
+# ---------------------------------------------------------------------------
+
+class Mont:
+    """Montgomery arithmetic mod an odd prime p <= 2^256, R = 2^264.
+
+    Domain invariant: all "Montgomery-form" values are canonical-limbed
+    integers in [0, 2p).  mul/add/sub preserve the invariant; canon()
+    produces the unique representative in [0, p).
+    """
+
+    def __init__(self, modulus: int, name: str = ""):
+        if modulus % 2 == 0 or modulus >= (1 << 256):
+            raise ValueError("modulus must be odd and < 2^256")
+        self.p = modulus
+        self.name = name
+        self.R = 1 << (N_LIMBS * LIMB_BITS)
+        self.n0inv = (-pow(modulus, -1, 1 << LIMB_BITS)) % (1 << LIMB_BITS)
+        self.p_limbs = int_to_limbs(modulus)
+        self.p2_limbs = int_to_limbs(2 * modulus)
+        self.r2_np = int_to_limbs((self.R * self.R) % modulus)
+        self.one_np = int_to_limbs(self.R % modulus)  # 1 in Montgomery form
+
+    # -- constant helpers ---------------------------------------------------
+
+    def const(self, x: int) -> np.ndarray:
+        """Montgomery form of python int x as a (L, 1) numpy constant
+        (broadcasts against (L, B) arrays)."""
+        m = (x % self.p) * self.R % self.p
+        return int_to_limbs(m).reshape(N_LIMBS, 1)
+
+    def one(self) -> np.ndarray:
+        return self.one_np.reshape(N_LIMBS, 1).copy()
+
+    def zero(self) -> np.ndarray:
+        return np.zeros((N_LIMBS, 1), dtype=np.int32)
+
+    # -- core ops -----------------------------------------------------------
+
+    def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+        """CIOS Montgomery multiplication: returns a*b*R^-1 mod p, < 2p.
+
+        Inputs must be canonical-limbed and < 2p (one operand may be any
+        value < R).  Implemented as a lax.scan over a's limbs.
+        """
+        a = jnp.asarray(a, dtype=jnp.int32)
+        b = jnp.asarray(b, dtype=jnp.int32)
+        bshape = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+        b = jnp.broadcast_to(b, (N_LIMBS,) + bshape)
+        p_col = jnp.asarray(
+            self.p_limbs.reshape(N_LIMBS, *([1] * len(bshape))))
+        n0inv = np.int32(self.n0inv)
+
+        def body(acc, ai):
+            # acc: (N_LIMBS, B); ai: broadcastable to (B,).  Every partial
+            # product a_t*b_j lands at a final (shifted) index <= N_LIMBS-1,
+            # so no extra top row is needed.
+            acc = acc + ai * b
+            m = (acc[0] * n0inv) & LIMB_MASK
+            acc = acc + m * p_col
+            c0 = acc[0] >> LIMB_BITS
+            top = jnp.zeros((1,) + acc.shape[1:], dtype=acc.dtype)
+            acc = jnp.concatenate([acc[1:2] + c0, acc[2:], top], axis=0)
+            return acc, None
+
+        init = jnp.zeros((N_LIMBS,) + bshape, dtype=jnp.int32)
+        a_b = jnp.broadcast_to(a, (N_LIMBS,) + bshape)
+        acc, _ = lax.scan(body, init, a_b)
+        return carry_prop(acc, N_LIMBS)
+
+    def sqr(self, a):
+        return self.mul(a, a)
+
+    def add(self, a, b):
+        s = carry_prop(jnp.asarray(a) + jnp.asarray(b), N_LIMBS)
+        return cond_sub(s, self.p2_limbs)
+
+    def sub(self, a, b):
+        p2 = jnp.asarray(self.p2_limbs.reshape(N_LIMBS, *([1] * (jnp.asarray(a).ndim - 1))))
+        s = carry_prop(jnp.asarray(a) + p2 - jnp.asarray(b), N_LIMBS)
+        return cond_sub(s, self.p2_limbs)
+
+    def neg(self, a):
+        """-a mod p, kept strictly < 2p."""
+        a = jnp.asarray(a)
+        p2 = jnp.asarray(self.p2_limbs.reshape(N_LIMBS, *([1] * (a.ndim - 1))))
+        s = carry_prop(p2 - a, N_LIMBS)
+        return cond_sub(s, self.p2_limbs)
+
+    def mul_small(self, a, k: int):
+        """a * k for small non-negative int k (k <= 8)."""
+        if not 0 <= k <= 8:
+            raise ValueError("k out of range")
+        s = carry_prop(jnp.asarray(a) * k, N_LIMBS)
+        # value < k * 2p; k-1 conditional subtractions of 2p guarantee < 2p
+        for _ in range(max(0, k - 1)):
+            s = cond_sub(s, self.p2_limbs)
+        return s
+
+    def to_mont(self, a):
+        """Canonical integer limbs (< R) -> Montgomery form (< 2p)."""
+        return self.mul(a, jnp.asarray(self.r2_np.reshape(N_LIMBS, 1)))
+
+    def from_mont(self, a):
+        """Montgomery form -> canonical integer in [0, p)."""
+        a = jnp.asarray(a)
+        one = np.zeros((N_LIMBS, 1), dtype=np.int32)
+        one[0, 0] = 1
+        out = self.mul(a, jnp.asarray(one))
+        return cond_sub(out, self.p_limbs)
+
+    def canon(self, a):
+        """Reduce a Montgomery-form value from [0,2p) to [0,p)."""
+        return cond_sub(a, self.p_limbs)
+
+    def eq(self, a, b):
+        return limbs_eq(self.canon(a), self.canon(b))
+
+    def is_zero(self, a):
+        return limbs_is_zero(self.canon(a))
+
+    def select(self, cond, a, b):
+        """Elementwise (B,) bool select between two (L, B) values."""
+        return jnp.where(cond[None, :], a, b)
+
+    def pow_const(self, a, e: int):
+        """a^e mod p for a fixed python-int exponent.
+
+        Square-and-multiply as a scan over the exponent's bits (MSB first)
+        so the traced graph stays small regardless of exponent size.
+        """
+        if e < 0:
+            raise ValueError("negative exponent")
+        a = jnp.asarray(a)
+        bshape = a.shape[1:]
+        one = jnp.broadcast_to(
+            jnp.asarray(self.one_np.reshape(N_LIMBS, *([1] * len(bshape)))),
+            (N_LIMBS,) + bshape)
+        if e == 0:
+            return one
+        bits = np.array([int(c) for c in bin(e)[2:]], dtype=np.int32)
+
+        def body(res, eb):
+            res = self.sqr(res)
+            res = jnp.where(eb != 0, self.mul(res, a), res)
+            return res, None
+
+        # first bit is always 1: start from a (skips one sqr+mul)
+        res, _ = lax.scan(body, jnp.broadcast_to(a, one.shape), jnp.asarray(bits[1:]))
+        return res
+
+    def inv(self, a):
+        """Modular inverse via Fermat (p must be prime). inv(0) = 0."""
+        return self.pow_const(a, self.p - 2)

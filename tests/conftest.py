@@ -1,0 +1,12 @@
+"""Test configuration: run everything on a virtual 8-device CPU mesh.
+
+Must set env vars BEFORE jax is imported anywhere (mirrors the driver's
+dryrun_multichip environment).  Real-TPU benchmarking happens in bench.py,
+not under pytest.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,127 @@
+"""Differential tests of the limb/Montgomery machinery vs python ints."""
+import random
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from fabric_tpu.ops import bignum as bn
+
+P256_P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+P256_N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+ED_P = 2**255 - 19
+ED_L = 2**252 + 27742317777372353535851937790883648493
+
+MODULI = [P256_P, P256_N, ED_P, ED_L]
+
+rng = random.Random(1234)
+
+
+def rand_batch(mod, B):
+    vals = [rng.randrange(0, mod) for _ in range(B)]
+    arr = bn.ints_to_limbs(vals)
+    return vals, jnp.asarray(arr)
+
+
+def test_limb_roundtrip():
+    for v in [0, 1, 2**256 - 1, P256_P, rng.getrandbits(250)]:
+        assert bn.limbs_to_int(bn.int_to_limbs(v).reshape(-1, 1)) == v
+
+
+def test_words_be_to_limbs_roundtrip():
+    B = 7
+    vals = [rng.getrandbits(256) for _ in range(B)]
+    words = np.zeros((8, B), dtype=np.uint32)
+    for b, v in enumerate(vals):
+        for wi in range(8):
+            words[wi, b] = (v >> (32 * (7 - wi))) & 0xFFFFFFFF
+    limbs = bn.words_be_to_limbs(jnp.asarray(words))
+    assert bn.limbs_to_ints(np.asarray(limbs)) == vals
+    back = np.asarray(bn.limbs_to_words_be(limbs))
+    np.testing.assert_array_equal(back, words)
+
+
+@pytest.mark.parametrize("mod", MODULI)
+def test_mont_mul_add_sub(mod):
+    m = bn.Mont(mod)
+    B = 16
+    av, a = rand_batch(mod, B)
+    bv, b = rand_batch(mod, B)
+    am = m.to_mont(a)
+    bm = m.to_mont(b)
+
+    got_mul = bn.limbs_to_ints(np.asarray(m.from_mont(m.mul(am, bm))))
+    got_add = bn.limbs_to_ints(np.asarray(m.from_mont(m.add(am, bm))))
+    got_sub = bn.limbs_to_ints(np.asarray(m.from_mont(m.sub(am, bm))))
+    got_neg = bn.limbs_to_ints(np.asarray(m.from_mont(m.neg(am))))
+    for i in range(B):
+        assert got_mul[i] == av[i] * bv[i] % mod
+        assert got_add[i] == (av[i] + bv[i]) % mod
+        assert got_sub[i] == (av[i] - bv[i]) % mod
+        assert got_neg[i] == (-av[i]) % mod
+
+
+@pytest.mark.parametrize("mod", MODULI)
+def test_mont_edge_values(mod):
+    m = bn.Mont(mod)
+    vals = [0, 1, 2, mod - 1, mod - 2, (mod + 1) // 2]
+    arr = jnp.asarray(bn.ints_to_limbs(vals))
+    am = m.to_mont(arr)
+    # x * x
+    got = bn.limbs_to_ints(np.asarray(m.from_mont(m.sqr(am))))
+    for i, v in enumerate(vals):
+        assert got[i] == v * v % mod
+    # -0 == 0 canonical
+    z = m.to_mont(jnp.asarray(bn.int_to_limbs(0).reshape(-1, 1)))
+    assert bool(m.is_zero(m.neg(z))[0])
+
+
+@pytest.mark.parametrize("mod", MODULI)
+def test_mont_inv(mod):
+    m = bn.Mont(mod)
+    B = 8
+    av, a = rand_batch(mod, B)
+    # avoid zero
+    av = [v if v != 0 else 1 for v in av]
+    a = jnp.asarray(bn.ints_to_limbs(av))
+    am = m.to_mont(a)
+    got = bn.limbs_to_ints(np.asarray(m.from_mont(m.inv(am))))
+    for i in range(B):
+        assert got[i] == pow(av[i], -1, mod)
+
+
+def test_mul_small():
+    m = bn.Mont(P256_P)
+    av, a = rand_batch(P256_P, 8)
+    am = m.to_mont(a)
+    for k in [0, 1, 2, 3, 4, 8]:
+        got = bn.limbs_to_ints(np.asarray(m.from_mont(m.mul_small(am, k))))
+        for i in range(8):
+            assert got[i] == av[i] * k % P256_P
+
+
+def test_pow_const():
+    m = bn.Mont(P256_N)
+    av, a = rand_batch(P256_N, 4)
+    am = m.to_mont(a)
+    for e in [0, 1, 2, 3, 65537, P256_N - 2]:
+        got = bn.limbs_to_ints(np.asarray(m.from_mont(m.pow_const(am, e))))
+        for i in range(4):
+            assert got[i] == pow(av[i], e, P256_N)
+
+
+def test_bits_window():
+    v = rng.getrandbits(256)
+    a = jnp.asarray(bn.int_to_limbs(v).reshape(-1, 1))
+    for lo in [0, 5, 12, 100, 250]:
+        w = int(bn.bits_window(a, lo, 4)[0])
+        assert w == (v >> lo) & 0xF
+
+
+def test_lt_const():
+    m = P256_N
+    vals = [0, m - 1, m, m + 1, 2**256 - 1]
+    arr = jnp.asarray(bn.ints_to_limbs(vals))
+    got = np.asarray(bn.limbs_lt_const(arr, m))
+    np.testing.assert_array_equal(got, [True, True, False, False, False])
