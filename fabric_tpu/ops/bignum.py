@@ -144,7 +144,7 @@ def carry_prop(x: jnp.ndarray, n_out: int) -> jnp.ndarray:
         v = xi + c
         return v >> LIMB_BITS, v & LIMB_MASK
 
-    _, out = lax.scan(body, jnp.zeros_like(x[0]), x)
+    _, out = lax.scan(body, x[0] * 0, x)
     return out
 
 
@@ -157,7 +157,7 @@ def cond_sub(x: jnp.ndarray, c_limbs: np.ndarray) -> jnp.ndarray:
         v = xi - ci + borrow
         return v >> LIMB_BITS, v & LIMB_MASK
 
-    borrow, t = lax.scan(body, jnp.zeros_like(x[0]), (x, jnp.broadcast_to(c, x.shape)))
+    borrow, t = lax.scan(body, x[0] * 0, (x, jnp.broadcast_to(c, x.shape)))
     return jnp.where(borrow == 0, t, x)
 
 
@@ -179,7 +179,7 @@ def limbs_lt_const(x: jnp.ndarray, c: int) -> jnp.ndarray:
         v = xi - ci + borrow
         return v >> LIMB_BITS, None
 
-    borrow, _ = lax.scan(body, jnp.zeros_like(x[0]), (x, jnp.broadcast_to(c_l, x.shape)))
+    borrow, _ = lax.scan(body, x[0] * 0, (x, jnp.broadcast_to(c_l, x.shape)))
     return borrow < 0
 
 
@@ -246,6 +246,12 @@ class Mont:
     def zero(self) -> np.ndarray:
         return np.zeros((N_LIMBS, 1), dtype=np.int32)
 
+    def one_bc(self, bshape) -> jnp.ndarray:
+        """Montgomery 1 broadcast to (N_LIMBS,) + bshape."""
+        return jnp.broadcast_to(
+            jnp.asarray(self.one_np.reshape(N_LIMBS, *([1] * len(bshape)))),
+            (N_LIMBS,) + tuple(bshape))
+
     # -- core ops -----------------------------------------------------------
 
     def mul(self, a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
@@ -274,8 +280,10 @@ class Mont:
             acc = jnp.concatenate([acc[1:2] + c0, acc[2:], top], axis=0)
             return acc, None
 
-        init = jnp.zeros((N_LIMBS,) + bshape, dtype=jnp.int32)
         a_b = jnp.broadcast_to(a, (N_LIMBS,) + bshape)
+        # init as a zero-multiple of the operands so it inherits their
+        # varying-manual-axes type under shard_map (fresh zeros would not)
+        init = a_b * 0 + b * 0
         acc, _ = lax.scan(body, init, a_b)
         return carry_prop(acc, N_LIMBS)
 
@@ -343,10 +351,7 @@ class Mont:
         if e < 0:
             raise ValueError("negative exponent")
         a = jnp.asarray(a)
-        bshape = a.shape[1:]
-        one = jnp.broadcast_to(
-            jnp.asarray(self.one_np.reshape(N_LIMBS, *([1] * len(bshape)))),
-            (N_LIMBS,) + bshape)
+        one = self.one_bc(a.shape[1:])
         if e == 0:
             return one
         bits = np.array([int(c) for c in bin(e)[2:]], dtype=np.int32)

@@ -1,0 +1,92 @@
+"""Batched ed25519 (RFC 8032) signature verification on TPU.
+
+NEW capability vs the reference (no ed25519 exists in /root/reference —
+SURVEY.md §2 bccsp/sw note); required by BASELINE.json configs 2-3.
+
+Split of labor:
+- host (provider layer): SHA-512(R || A || M) over the variable-length
+  message, reduced mod L — hashing never goes on device (mirrors the
+  reference's design where bccsp.Verify receives a fixed-size digest,
+  msp/identities.go:178);
+- device (this module): batched decompression of A and R, scalar ladder
+  [S]B + [k](-A), projective comparison against R.  Cofactorless equation
+  ([S]B == R + [k]A), matching RFC 8032 / OpenSSL / Go crypto/ed25519.
+
+Kernel inputs are (8, B) uint32 big-endian words of the *integer values*
+(the host unpacks the little-endian wire encoding) plus (B,) sign bits.
+"""
+
+from __future__ import annotations
+
+import hashlib
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import bignum as bn
+from . import edwards as ed
+
+
+def verify_words(ay, a_sign, ry, r_sign, s, k) -> jnp.ndarray:
+    """Batched ed25519 verify.
+
+    ay, ry: (8, B) uint32 big-endian words of the A / R y-coordinates
+    a_sign, r_sign: (B,) int32 x-parity bits from the encodings
+    s: (8, B) words of S (checked < L here)
+    k: (8, B) words of SHA512(R||A||M) already reduced mod L by the host
+    Returns (B,) bool.
+    """
+    fp = ed.fp
+    ay_l = bn.words_be_to_limbs(ay)
+    ry_l = bn.words_be_to_limbs(ry)
+    s_l = bn.words_be_to_limbs(s)
+    k_l = bn.words_be_to_limbs(k)
+
+    s_ok = bn.limbs_lt_const(s_l, ed.L)
+    (ax_m, ay_m), a_ok = ed.decompress(ay_l, a_sign)
+    (rx_m, ry_m), r_ok = ed.decompress(ry_l, r_sign)
+
+    A = ed.from_affine(ax_m, ay_m)
+    R = ed.from_affine(rx_m, ry_m)
+    # [S]B + [k](-A) == R
+    lhs = ed.shamir(s_l, k_l, ed.neg(A), n_bits=253)
+    ok_eq = ed.eq_points(lhs, R)
+    return s_ok & a_ok & r_ok & ok_eq
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing: RFC 8032 wire format -> kernel inputs
+# ---------------------------------------------------------------------------
+
+def pack_verify_inputs(pubkeys: list, sigs: list, msgs: list):
+    """(32B pubkey, 64B sig, message) triples -> kernel input arrays.
+
+    Returns (ay, a_sign, ry, r_sign, s, k) ready for verify_words.
+    Malformed-length inputs raise ValueError (callers pre-screen).
+    """
+    B = len(pubkeys)
+    ay = np.zeros((8, B), dtype=np.uint32)
+    ry = np.zeros((8, B), dtype=np.uint32)
+    sw = np.zeros((8, B), dtype=np.uint32)
+    kw = np.zeros((8, B), dtype=np.uint32)
+    a_sign = np.zeros((B,), dtype=np.int32)
+    r_sign = np.zeros((B,), dtype=np.int32)
+    for i, (pk, sig, msg) in enumerate(zip(pubkeys, sigs, msgs)):
+        if len(pk) != 32 or len(sig) != 64:
+            raise ValueError("ed25519: bad pubkey/signature length")
+        rb, sb = sig[:32], sig[32:]
+        a_int = int.from_bytes(pk, "little")
+        r_int = int.from_bytes(rb, "little")
+        a_sign[i] = (a_int >> 255) & 1
+        r_sign[i] = (r_int >> 255) & 1
+        _fill_words(ay, i, a_int & ((1 << 255) - 1))
+        _fill_words(ry, i, r_int & ((1 << 255) - 1))
+        _fill_words(sw, i, int.from_bytes(sb, "little"))
+        k = int.from_bytes(hashlib.sha512(rb + pk + msg).digest(), "little") % ed.L
+        _fill_words(kw, i, k)
+    return ay, a_sign, ry, r_sign, sw, kw
+
+
+def _fill_words(arr: np.ndarray, col: int, val: int) -> None:
+    for wi in range(8):
+        arr[wi, col] = (val >> (32 * (7 - wi))) & 0xFFFFFFFF

@@ -1,0 +1,150 @@
+"""Batched twisted-Edwards (a=-1) extended-coordinate arithmetic for ed25519.
+
+The ed25519 capability is NEW relative to the reference (verified in
+SURVEY.md §2: no ed25519 anywhere in /root/reference — BCCSP is ECDSA-only);
+it exists because BASELINE.json configs 2-3 call for ed25519 and mixed-curve
+batch verification on TPU.
+
+Extended homogeneous coordinates (X : Y : Z : T) with x = X/Z, y = Y/Z,
+T = XY/Z.  The unified addition law (add-2008-hwcd-3) is COMPLETE for
+a = -1 with non-square d, so there are no degenerate cases at all — ideal
+for a branchless batched TPU ladder.  Identity is (0 : 1 : 1 : 0).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from . import bignum as bn
+
+P = 2**255 - 19
+L = 2**252 + 27742317777372353535851937790883648493  # group order
+D = (-121665 * pow(121666, -1, P)) % P
+SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1) mod p
+BX = 15112221349535400772501151409588531511454012693041857206046113283949847762202
+BY = 46316835694926478169428394003475163141307993866256225615783033603165251855960
+
+fp = bn.Mont(P, "ed25519.p")
+fl = bn.Mont(L, "ed25519.l")
+
+D_M = fp.const(D)
+D2_M = fp.const(2 * D % P)
+SQRT_M1_M = fp.const(SQRT_M1)
+B_AFF = (fp.const(BX), fp.const(BY))
+
+
+def identity(bshape) -> tuple:
+    one = fp.one_bc(bshape)
+    zero = jnp.zeros((bn.N_LIMBS,) + tuple(bshape), dtype=jnp.int32)
+    return zero, one, one, zero
+
+
+def from_affine(x_m, y_m) -> tuple:
+    one = fp.one_bc(jnp.asarray(x_m).shape[1:])
+    return jnp.asarray(x_m), jnp.asarray(y_m), one, fp.mul(x_m, y_m)
+
+
+def neg(Pt) -> tuple:
+    X, Y, Z, T = Pt
+    return fp.neg(X), Y, Z, fp.neg(T)
+
+
+def select(cond, A, Bp) -> tuple:
+    return tuple(fp.select(cond, a, b) for a, b in zip(A, Bp))
+
+
+def add(Pt, Qt) -> tuple:
+    """Complete unified addition (add-2008-hwcd-3, a=-1, k=2d)."""
+    X1, Y1, Z1, T1 = Pt
+    X2, Y2, Z2, T2 = Qt
+    A = fp.mul(fp.sub(Y1, X1), fp.sub(Y2, X2))
+    Bv = fp.mul(fp.add(Y1, X1), fp.add(Y2, X2))
+    C = fp.mul(fp.mul(T1, jnp.asarray(D2_M)), T2)
+    Dv = fp.mul_small(fp.mul(Z1, Z2), 2)
+    E = fp.sub(Bv, A)
+    F = fp.sub(Dv, C)
+    G = fp.add(Dv, C)
+    H = fp.add(Bv, A)
+    return fp.mul(E, F), fp.mul(G, H), fp.mul(F, G), fp.mul(E, H)
+
+
+def dbl(Pt) -> tuple:
+    """Doubling (dbl-2008-hwcd, a=-1); also complete."""
+    X1, Y1, Z1, _ = Pt
+    A = fp.sqr(X1)
+    Bv = fp.sqr(Y1)
+    C = fp.mul_small(fp.sqr(Z1), 2)
+    H = fp.add(A, Bv)
+    E = fp.sub(H, fp.sqr(fp.add(X1, Y1)))
+    G = fp.sub(A, Bv)
+    F = fp.add(C, G)
+    return fp.mul(E, F), fp.mul(G, H), fp.mul(F, G), fp.mul(E, H)
+
+
+def shamir(u1_limbs, u2_limbs, Q, n_bits: int = 253) -> tuple:
+    """u1*B + u2*Q, interleaved double-and-add over the basepoint B and Q.
+
+    Scalars as canonical little-endian limbs (L, Bsz); returns extended point.
+    """
+    bshape = jnp.asarray(u1_limbs).shape[1:]
+    Bpt = from_affine(
+        jnp.broadcast_to(jnp.asarray(B_AFF[0]), (bn.N_LIMBS,) + tuple(bshape)),
+        jnp.broadcast_to(jnp.asarray(B_AFF[1]), (bn.N_LIMBS,) + tuple(bshape)))
+    BQ = add(Bpt, Q)
+    u1b = bn.to_bits(u1_limbs, n_bits)[::-1]
+    u2b = bn.to_bits(u2_limbs, n_bits)[::-1]
+
+    def body(acc, bits):
+        b1, b2 = bits
+        acc = dbl(acc)
+        t = select(b1 != 0, Bpt, identity(bshape))
+        t = select((b1 == 0) & (b2 != 0), Q, t)
+        t = select((b1 != 0) & (b2 != 0), BQ, t)
+        acc = add(acc, t)
+        return acc, None
+
+    # tie the init to the scalars so its shard_map variance matches
+    init = tuple(c + jnp.asarray(u1_limbs) * 0 for c in identity(bshape))
+    acc, _ = lax.scan(body, init, (u1b, u2b))
+    return acc
+
+
+def decompress(y_limbs, sign_bit) -> tuple:
+    """RFC 8032 §5.1.3 point decompression, batched & branchless.
+
+    y_limbs: (L, B) canonical integer limbs of the y coordinate (< 2^255);
+    sign_bit: (B,) int32 0/1 (the x parity bit from the encoding MSB).
+    Returns ((x_m, y_m), ok): affine Montgomery coords and validity mask.
+    Callers must reject when y >= p (checked here) or when no sqrt exists.
+    """
+    y_ok = bn.limbs_lt_const(y_limbs, P)
+    y_m = fp.to_mont(y_limbs)
+    y2 = fp.sqr(y_m)
+    one = jnp.asarray(fp.one_np.reshape(bn.N_LIMBS, 1))
+    u = fp.sub(y2, one)                      # y^2 - 1
+    v = fp.add(fp.mul(y2, jnp.asarray(D_M)), one)  # d*y^2 + 1
+    # candidate root: x = u * v^3 * (u * v^7)^((p-5)/8)
+    v3 = fp.mul(fp.sqr(v), v)
+    v7 = fp.mul(fp.sqr(v3), v)
+    x = fp.mul(fp.mul(u, v3), fp.pow_const(fp.mul(u, v7), (P - 5) // 8))
+    vx2 = fp.mul(v, fp.sqr(x))
+    root_ok = fp.eq(vx2, u)
+    root_neg = fp.eq(vx2, fp.neg(u))
+    x = fp.select(root_neg, fp.mul(x, jnp.asarray(SQRT_M1_M)), x)
+    ok = y_ok & (root_ok | root_neg)
+    # sign handling: if x == 0 and sign==1 -> invalid; else negate to match
+    x_can = fp.from_mont(x)  # already canonical in [0, p)
+    x_is_zero = bn.limbs_is_zero(x_can)
+    x_parity = bn.bit(x_can, 0)
+    ok = ok & ~(x_is_zero & (sign_bit == 1))
+    x = fp.select((x_parity != sign_bit) & ~x_is_zero, fp.neg(x), x)
+    return (x, y_m), ok
+
+
+def eq_points(Pt, Qt) -> jnp.ndarray:
+    """Projective equality: X1*Z2 == X2*Z1 and Y1*Z2 == Y2*Z1."""
+    X1, Y1, Z1, _ = Pt
+    X2, Y2, Z2, _ = Qt
+    return (fp.eq(fp.mul(X1, Z2), fp.mul(X2, Z1)) &
+            fp.eq(fp.mul(Y1, Z2), fp.mul(Y2, Z1)))

@@ -1,0 +1,108 @@
+"""Batched ECDSA-P256 verification on TPU.
+
+TPU-native equivalent of the reference's verifyECDSA
+(/root/reference/bccsp/sw/ecdsa.go:41-58): same semantics — the message is
+already hashed upstream (msp/identities.go:178), r/s must be in [1, n-1],
+and high-S signatures are REJECTED (ecdsa.go:47-53, bccsp/utils/ecdsa.go:84)
+— but evaluated for an entire block's worth of signatures in one jitted
+data-parallel dispatch instead of one goroutine per transaction
+(core/committer/txvalidator/v20/validator.go:194-209).
+
+Inputs are (8, B) uint32 big-endian words (SEC1 byte order); output is a
+(B,) bool verdict bitmap.  No hashing, parsing, or variable-length data on
+device.  The final x-coordinate comparison is done projectively
+(X == r*Z^2), avoiding any field inversion; only one Fermat inversion mod n
+(for s^-1) remains.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import bignum as bn
+from .weierstrass import ShortCurve
+
+P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+A = P - 3
+B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+HALF_N = (N - 1) // 2
+
+curve = ShortCurve(P, A, B, GX, GY, N, name="p256")
+
+
+def verify_words(qx, qy, r, s, e, require_low_s: bool = True) -> jnp.ndarray:
+    """Batched ECDSA-P256 verify over big-endian uint32 words.
+
+    qx, qy, r, s, e: (8, B) uint32 — public key affine coords, signature
+    (r, s), and the 32-byte message digest interpreted as a big-endian
+    integer (SEC1 bits2int for SHA-256 is the identity).
+    Returns (B,) bool.
+    """
+    fp, fn = curve.fp, curve.fn
+    qx_l = bn.words_be_to_limbs(qx)
+    qy_l = bn.words_be_to_limbs(qy)
+    r_l = bn.words_be_to_limbs(r)
+    s_l = bn.words_be_to_limbs(s)
+    e_l = bn.words_be_to_limbs(e)
+
+    # --- scalar-range and key validity preconditions (all batched) ---
+    r_ok = bn.limbs_lt_const(r_l, N) & ~bn.limbs_is_zero(r_l)
+    s_ok = bn.limbs_lt_const(s_l, N) & ~bn.limbs_is_zero(s_l)
+    if require_low_s:
+        s_ok = s_ok & bn.limbs_lt_const(s_l, HALF_N + 1)
+    q_range_ok = bn.limbs_lt_const(qx_l, P) & bn.limbs_lt_const(qy_l, P)
+
+    qx_m = fp.to_mont(qx_l)
+    qy_m = fp.to_mont(qy_l)
+    q_ok = q_range_ok & curve.on_curve_affine(qx_m, qy_m)
+    # affine input cannot encode infinity; (0, +-sqrt(b)) is on-curve but is
+    # a valid finite point on P-256 (cofactor 1), so no extra subgroup check.
+
+    # --- u1 = e/s, u2 = r/s (mod n) ---
+    s_mn = fn.to_mont(s_l)
+    e_mn = fn.to_mont(e_l)  # to_mont reduces e mod n implicitly
+    r_mn = fn.to_mont(r_l)
+    w = fn.inv(s_mn)
+    u1 = fn.from_mont(fn.mul(e_mn, w))   # canonical integer limbs in [0, n)
+    u2 = fn.from_mont(fn.mul(r_mn, w))
+
+    # --- R = u1*G + u2*Q ---
+    Q = curve.to_jacobian(qx_m, qy_m)
+    X, Y, Z = curve.shamir(u1, u2, Q, n_bits=256)
+    nonzero = ~fp.is_zero(Z)
+
+    # --- projective check: X == (r mod p adjustments) * Z^2 ---
+    z2 = fp.sqr(Z)
+    r_mp = fp.to_mont(r_l)
+    eq1 = fp.eq(X, fp.mul(r_mp, z2))
+    # r + n may also be a valid x-coordinate when r + n < p
+    rn_l = bn.carry_prop(r_l + jnp.asarray(bn.int_to_limbs(N).reshape(bn.N_LIMBS, 1)),
+                         bn.N_LIMBS)
+    rn_lt_p = bn.limbs_lt_const(rn_l, P)
+    eq2 = rn_lt_p & fp.eq(X, fp.mul(fp.to_mont(rn_l), z2))
+
+    return r_ok & s_ok & q_ok & nonzero & (eq1 | eq2)
+
+
+# ---------------------------------------------------------------------------
+# Host-side packing helpers (numpy; byte-level, used by the provider layer)
+# ---------------------------------------------------------------------------
+
+def bytes32_to_words(vals: list) -> np.ndarray:
+    """list of B 32-byte big-endian bytestrings -> (8, B) uint32."""
+    out = np.zeros((8, len(vals)), dtype=np.uint32)
+    for b, v in enumerate(vals):
+        if len(v) != 32:
+            raise ValueError("expected 32-byte value")
+        for wi in range(8):
+            out[wi, b] = int.from_bytes(v[4 * wi:4 * wi + 4], "big")
+    return out
+
+
+def ints_to_words(vals: list) -> np.ndarray:
+    """list of B python ints (< 2^256) -> (8, B) uint32 big-endian words."""
+    return bytes32_to_words([int(v).to_bytes(32, "big") for v in vals])

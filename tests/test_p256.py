@@ -1,0 +1,126 @@
+"""Differential tests of batched TPU ECDSA-P256 verify vs the OpenSSL oracle.
+
+Mirrors the reference's sw-vs-hw differential idiom (bccsp/sw as oracle)
+using the `cryptography` package and adversarial vectors from
+SURVEY.md §7 acceptance criteria: r/s = 0, r = n, high-S, off-curve Q,
+wrong digest, swapped signatures.
+"""
+import hashlib
+import random
+
+import numpy as np
+import jax
+import pytest
+
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
+from cryptography.hazmat.primitives import hashes
+
+from fabric_tpu.ops import p256
+
+rng = random.Random(99)
+
+
+def sign_lows(key, msg: bytes):
+    sig = key.sign(msg, ec.ECDSA(hashes.SHA256()))
+    r, s = decode_dss_signature(sig)
+    if s > p256.HALF_N:
+        s = p256.N - s
+    return r, s
+
+
+def make_case(valid=True, mutate=None):
+    key = ec.generate_private_key(ec.SECP256R1())
+    pub = key.public_key().public_numbers()
+    msg = rng.randbytes(48)
+    digest = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+    r, s = sign_lows(key, msg)
+    qx, qy = pub.x, pub.y
+    if mutate == "high_s":
+        s = p256.N - s
+    elif mutate == "wrong_digest":
+        digest ^= 1 << 13
+    elif mutate == "r_zero":
+        r = 0
+    elif mutate == "s_zero":
+        s = 0
+    elif mutate == "r_eq_n":
+        r = p256.N
+    elif mutate == "off_curve":
+        qy = (qy + 1) % p256.P
+    elif mutate == "qx_ge_p":
+        qx = p256.P
+    elif mutate == "flip_sig_bit":
+        r ^= 1 << 200
+    return (qx, qy, r, s, digest)
+
+
+@pytest.fixture(scope="module")
+def verify_jit():
+    return jax.jit(p256.verify_words, static_argnames=("require_low_s",))
+
+
+def run_batch(verify_jit, cases, require_low_s=True):
+    qx, qy, r, s, e = zip(*cases)
+    out = verify_jit(
+        p256.ints_to_words(qx), p256.ints_to_words(qy),
+        p256.ints_to_words(r), p256.ints_to_words(s),
+        p256.ints_to_words(e), require_low_s=require_low_s)
+    return np.asarray(out)
+
+
+def test_valid_and_adversarial_batch(verify_jit):
+    mutations = [None, "high_s", "wrong_digest", "r_zero", "s_zero",
+                 "r_eq_n", "off_curve", "qx_ge_p", "flip_sig_bit", None]
+    cases = [make_case(mutate=m) for m in mutations]
+    got = run_batch(verify_jit, cases)
+    want = [m is None for m in mutations]
+    np.testing.assert_array_equal(got, want)
+
+
+def test_high_s_accepted_without_lowS_rule(verify_jit):
+    cases = [make_case(mutate="high_s"), make_case()]
+    got = run_batch(verify_jit, cases, require_low_s=False)
+    np.testing.assert_array_equal(got, [True, True])
+
+
+def test_swapped_signatures(verify_jit):
+    a = make_case()
+    b = make_case()
+    # a's key+digest with b's signature and vice versa
+    cases = [(a[0], a[1], b[2], b[3], a[4]), (b[0], b[1], a[2], a[3], b[4]), a, b]
+    got = run_batch(verify_jit, cases)
+    np.testing.assert_array_equal(got, [False, False, True, True])
+
+
+def test_matches_openssl_on_random_noise(verify_jit):
+    """Random r/s values against a fixed key: oracle and TPU path agree."""
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        encode_dss_signature, Prehashed)
+    from cryptography.exceptions import InvalidSignature
+
+    key = ec.generate_private_key(ec.SECP256R1())
+    pubkey = key.public_key()
+    pub = pubkey.public_numbers()
+    msg = b"fabric-tpu differential"
+    digest_bytes = hashlib.sha256(msg).digest()
+    digest = int.from_bytes(digest_bytes, "big")
+    cases = []
+    for _ in range(6):
+        r = rng.randrange(1, p256.N)
+        s = rng.randrange(1, p256.HALF_N)
+        cases.append((pub.x, pub.y, r, s, digest))
+    cases.append((pub.x, pub.y, *sign_lows(key, msg), digest))
+
+    def openssl_verdict(r, s):
+        try:
+            pubkey.verify(encode_dss_signature(r, s), digest_bytes,
+                          ec.ECDSA(Prehashed(hashes.SHA256())))
+            return True
+        except InvalidSignature:
+            return False
+
+    want = [openssl_verdict(c[2], c[3]) for c in cases]
+    got = run_batch(verify_jit, cases)
+    np.testing.assert_array_equal(got, want)
+    assert want[-1] is True  # the genuine signature must be in the batch
