@@ -1,0 +1,24 @@
+"""BCCSP: the pluggable crypto provider plane (batch-first, TPU-gated).
+
+Re-design of the reference's Blockchain Crypto Service Provider
+(/root/reference/bccsp/bccsp.go:121-133, factory at bccsp/factory/factory.go:42):
+same role — every signature creation/verification in the framework flows
+through a provider selected by config — but the interface is *batch-first*:
+the primary verb is `batch_verify(items) -> bool[N]`, because the whole point
+of the TPU-native design is verify-then-gate over an entire block
+(SURVEY.md §7) instead of per-tx serial verifies.
+
+Providers:
+- sw      : CPU/OpenSSL provider — fallback and correctness oracle
+            (the reference's bccsp/sw equivalent)
+- jaxtpu  : JAX/TPU batched provider (the reference's PKCS#11 "hardware
+            slot" — SURVEY.md §2.1.1 — occupied by the TPU)
+"""
+
+from .provider import VerifyItem, SCHEME_P256, SCHEME_ED25519
+from .factory import get_default, init_factories, FactoryOpts
+
+__all__ = [
+    "VerifyItem", "SCHEME_P256", "SCHEME_ED25519",
+    "get_default", "init_factories", "FactoryOpts",
+]

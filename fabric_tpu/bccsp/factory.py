@@ -1,0 +1,61 @@
+"""BCCSP factory: config-gated provider selection.
+
+Mirror of the reference's bccsp/factory (factory.go:42 GetDefault,
+nopkcs11.go:19-28 FactoryOpts / InitFactories, selected by the BCCSP
+section of core.yaml — sampleconfig/core.yaml:287-303).  Here the options
+are `SW` and `JAXTPU` (the latter replacing the PKCS11 hardware slot).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from .provider import Provider
+from .sw import SoftwareProvider
+
+logger = logging.getLogger("fabric_tpu.bccsp.factory")
+
+_default: Optional[Provider] = None
+
+
+@dataclass
+class FactoryOpts:
+    """The BCCSP config block (core.yaml `bccsp:` equivalent)."""
+    default: str = "JAXTPU"          # "SW" | "JAXTPU"
+    require_low_s: bool = True
+    use_mesh: bool = False           # shard batches over all visible devices
+
+
+def init_factories(opts: Optional[FactoryOpts] = None) -> Provider:
+    """Initialize the default provider (InitFactories equivalent)."""
+    global _default
+    opts = opts or FactoryOpts()
+    kind = opts.default.upper()
+    if kind == "SW":
+        _default = SoftwareProvider(require_low_s=opts.require_low_s)
+    elif kind == "JAXTPU":
+        from .jaxtpu import JaxTpuProvider
+        mesh = None
+        if opts.use_mesh:
+            from fabric_tpu.parallel import mesh as meshmod
+            mesh = meshmod.make_mesh()
+        _default = JaxTpuProvider(require_low_s=opts.require_low_s, mesh=mesh)
+    else:
+        raise ValueError(f"unknown BCCSP provider {opts.default!r}")
+    logger.info("BCCSP default provider: %s", _default.name)
+    return _default
+
+
+def get_default() -> Provider:
+    """GetDefault equivalent: lazily initializes a JAXTPU provider."""
+    global _default
+    if _default is None:
+        init_factories()
+    return _default
+
+
+def set_default(p: Provider) -> None:
+    global _default
+    _default = p

@@ -1,0 +1,189 @@
+"""JAX/TPU batched BCCSP provider — the hardware slot of the framework.
+
+Occupies the position the reference gives PKCS#11 HSMs (bccsp/pkcs11,
+gated by bccsp/factory — SURVEY.md §2.1.1), but instead of one-at-a-time
+HSM calls it dispatches the whole batch to the TPU kernels in
+fabric_tpu.ops.  Signing and key-gen delegate to the software provider
+(private keys never touch the TPU).
+
+Host/device split per the reference's own design (msp/identities.go:178):
+variable-length parsing (DER signatures, SEC1 points, RFC 8032 encodings,
+SHA-512 for ed25519) happens on host; the device sees only fixed-size
+word arrays.
+
+Batching strategy: items are grouped by scheme, packed into word arrays,
+and padded to power-of-two buckets so XLA compiles a small, reusable set
+of programs.  Malformed items short-circuit to False on the host.
+If device dispatch fails entirely, the whole batch falls back to the
+software provider atomically (SURVEY.md §7 hard-part #5: fallback must be
+atomic to keep determinism).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
+
+from . import provider as prov
+from .provider import VerifyItem, SCHEME_P256, SCHEME_ED25519
+from .sw import SoftwareProvider
+
+logger = logging.getLogger("fabric_tpu.bccsp.jaxtpu")
+
+MIN_BUCKET = 128
+MAX_BUCKET = 1 << 17
+
+
+def _bucket(n: int) -> int:
+    b = MIN_BUCKET
+    while b < n:
+        b <<= 1
+    return b
+
+
+class JaxTpuProvider(prov.Provider):
+    name = "jaxtpu"
+
+    def __init__(self, require_low_s: bool = True, mesh=None,
+                 fallback: Optional[SoftwareProvider] = None):
+        self.require_low_s = require_low_s
+        self.mesh = mesh
+        self.fallback = fallback or SoftwareProvider(require_low_s=require_low_s)
+        self._fns = {}
+        self.stats = {"dispatches": 0, "device_sigs": 0, "host_rejects": 0,
+                      "fallbacks": 0}
+
+    # signing / key-gen are host-side: delegate
+    def key_gen(self, scheme: str):
+        return self.fallback.key_gen(scheme)
+
+    def sign(self, private_key, payload: bytes) -> bytes:
+        return self.fallback.sign(private_key, payload)
+
+    # -- device plumbing ----------------------------------------------------
+
+    def _get_fn(self, scheme: str):
+        key = scheme
+        if key not in self._fns:
+            import jax
+            if scheme == SCHEME_P256:
+                from fabric_tpu.ops import p256
+                if self.mesh is not None:
+                    from fabric_tpu.parallel import mesh as meshmod
+                    f = meshmod.sharded_p256_verify(self.mesh, self.require_low_s)
+                    self._fns[key] = lambda *a: f(*a)[0]
+                else:
+                    jf = jax.jit(p256.verify_words,
+                                 static_argnames=("require_low_s",))
+                    low_s = self.require_low_s
+                    self._fns[key] = lambda *a: jf(*a, require_low_s=low_s)
+            elif scheme == SCHEME_ED25519:
+                from fabric_tpu.ops import ed25519
+                if self.mesh is not None:
+                    from fabric_tpu.parallel import mesh as meshmod
+                    f = meshmod.sharded_ed25519_verify(self.mesh)
+                    self._fns[key] = lambda *a: f(*a)[0]
+                else:
+                    self._fns[key] = jax.jit(ed25519.verify_words)
+            else:
+                raise ValueError(f"unsupported scheme {scheme!r}")
+        return self._fns[key]
+
+    def _pack_p256(self, items, idxs):
+        """-> (ok_idx, arrays) with malformed items dropped (verdict False)."""
+        qx, qy, r, s, e, keep = [], [], [], [], [], []
+        for i in idxs:
+            it = items[i]
+            try:
+                pk = it.pubkey
+                if len(pk) != 65 or pk[0] != 0x04:
+                    raise ValueError("bad SEC1 point")
+                if len(it.payload) != 32:
+                    raise ValueError("p256 payload must be a 32B digest")
+                ri, si = decode_dss_signature(it.signature)
+                if not (0 < ri < (1 << 256) and 0 < si < (1 << 256)):
+                    raise ValueError("r/s out of range")
+            except Exception:
+                self.stats["host_rejects"] += 1
+                continue
+            qx.append(int.from_bytes(pk[1:33], "big"))
+            qy.append(int.from_bytes(pk[33:65], "big"))
+            r.append(ri)
+            s.append(si)
+            e.append(int.from_bytes(it.payload, "big"))
+            keep.append(i)
+        if not keep:
+            return [], None
+        from fabric_tpu.ops import p256 as p256mod
+        arrays = [p256mod.ints_to_words(v) for v in (qx, qy, r, s, e)]
+        return keep, arrays
+
+    def _pack_ed25519(self, items, idxs):
+        keep, pks, sigs, msgs = [], [], [], []
+        for i in idxs:
+            it = items[i]
+            if len(it.pubkey) != 32 or len(it.signature) != 64:
+                self.stats["host_rejects"] += 1
+                continue
+            keep.append(i)
+            pks.append(it.pubkey)
+            sigs.append(it.signature)
+            msgs.append(it.payload)
+        if not keep:
+            return [], None
+        from fabric_tpu.ops import ed25519 as edmod
+        arrays = list(edmod.pack_verify_inputs(pks, sigs, msgs))
+        return keep, arrays
+
+    def _pad(self, arrays, n: int):
+        b = _bucket(n)
+        if self.mesh is not None:
+            size = self.mesh.devices.size
+            b = max(b, size)
+        out = []
+        for a in arrays:
+            a = np.asarray(a)
+            pad = b - a.shape[-1]
+            widths = [(0, 0)] * (a.ndim - 1) + [(0, pad)]
+            out.append(np.pad(a, widths))
+        return out
+
+    # -- the batch verb -----------------------------------------------------
+
+    def batch_verify(self, items: Sequence[VerifyItem]) -> np.ndarray:
+        verdicts = np.zeros(len(items), dtype=bool)
+        by_scheme = {}
+        for i, it in enumerate(items):
+            by_scheme.setdefault(it.scheme, []).append(i)
+        try:
+            for scheme, idxs in by_scheme.items():
+                if scheme == SCHEME_P256:
+                    keep, arrays = self._pack_p256(items, idxs)
+                elif scheme == SCHEME_ED25519:
+                    keep, arrays = self._pack_ed25519(items, idxs)
+                else:
+                    self.stats["host_rejects"] += len(idxs)
+                    continue  # unknown scheme: all False
+                if not keep:
+                    continue
+                fn = self._get_fn(scheme)
+                # chunk batches beyond MAX_BUCKET so the compiled-program set
+                # stays bounded while arbitrarily large blocks still use TPU
+                for lo in range(0, len(keep), MAX_BUCKET):
+                    hi = min(lo + MAX_BUCKET, len(keep))
+                    chunk = [a[..., lo:hi] for a in arrays]
+                    padded = self._pad(chunk, hi - lo)
+                    out = np.asarray(fn(*padded))[:hi - lo]
+                    self.stats["dispatches"] += 1
+                    self.stats["device_sigs"] += hi - lo
+                    verdicts[np.asarray(keep[lo:hi])] = out
+        except Exception:
+            # atomic fallback: recompute the WHOLE batch on the sw provider
+            logger.exception("TPU dispatch failed; falling back to sw provider")
+            self.stats["fallbacks"] += 1
+            return self.fallback.batch_verify(items)
+        return verdicts

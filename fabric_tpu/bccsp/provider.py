@@ -1,0 +1,79 @@
+"""Provider interface and item types for the batch-first BCCSP plane.
+
+Reference parity: bccsp.BCCSP (bccsp/bccsp.go:121-133) exposes KeyGen /
+KeyImport / Hash / Sign / Verify.  Here the same verbs exist, plus the
+batch verb that the verify-then-gate pipeline (SURVEY.md §7) is built on.
+Signing always stays on the host CPU — private keys never touch the TPU.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+SCHEME_P256 = "ecdsa-p256"
+SCHEME_ED25519 = "ed25519"
+
+HASH_SHA256 = "sha256"
+HASH_SHA384 = "sha384"
+HASH_SHA3_256 = "sha3_256"
+HASH_SHA3_384 = "sha3_384"
+
+
+@dataclass(frozen=True)
+class VerifyItem:
+    """One signature-verification work item.
+
+    scheme  : SCHEME_P256 | SCHEME_ED25519
+    pubkey  : SEC1 uncompressed point (65B, 0x04||X||Y) for p256;
+              raw 32B for ed25519
+    signature: ASN.1/DER (r,s) for p256; raw 64B (R||S) for ed25519
+    payload : the 32-byte *digest* for p256 (hashing happened upstream,
+              mirroring msp/identities.go:178); the full *message* for
+              ed25519 (RFC 8032 signs the message itself)
+    """
+    scheme: str
+    pubkey: bytes
+    signature: bytes
+    payload: bytes
+
+
+def hash_payload(data: bytes, algo: str = HASH_SHA256) -> bytes:
+    """The provider Hash verb (bccsp.Hash equivalent)."""
+    try:
+        return hashlib.new(algo, data).digest()
+    except ValueError as e:
+        raise ValueError(f"unsupported hash {algo!r}") from e
+
+
+class Provider:
+    """Abstract BCCSP provider. Concrete: sw.SoftwareProvider, jaxtpu.JaxTpuProvider."""
+
+    name = "abstract"
+
+    # -- keys / signing (host-side in every provider) -----------------------
+
+    def key_gen(self, scheme: str):
+        raise NotImplementedError
+
+    def sign(self, private_key, payload: bytes) -> bytes:
+        raise NotImplementedError
+
+    # -- verification -------------------------------------------------------
+
+    def verify(self, item: VerifyItem) -> bool:
+        return bool(self.batch_verify([item])[0])
+
+    def batch_verify(self, items: Sequence[VerifyItem]) -> np.ndarray:
+        """Verify a batch; returns bool[N] aligned to `items`.
+
+        Malformed items (bad lengths, undecodable DER/points) yield False —
+        they never raise, so one bad signature cannot fail a whole block
+        (policy.go:390-393 semantics)."""
+        raise NotImplementedError
+
+    def hash(self, data: bytes, algo: str = HASH_SHA256) -> bytes:
+        return hash_payload(data, algo)

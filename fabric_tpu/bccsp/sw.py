@@ -1,0 +1,109 @@
+"""Software (CPU/OpenSSL) BCCSP provider — fallback and correctness oracle.
+
+Equivalent of the reference's bccsp/sw (pure-Go CSP, bccsp/sw/impl.go:247):
+ECDSA-P256 with low-S enforcement on sign AND verify
+(bccsp/sw/ecdsa.go:27-58), plus ed25519 (new capability).  Backed by the
+`cryptography` package (OpenSSL), which is faster than Go's crypto/ecdsa —
+so using it as the benchmark baseline is conservative.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey, Ed25519PublicKey)
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed, decode_dss_signature, encode_dss_signature)
+from cryptography.hazmat.primitives import serialization
+
+from . import provider as prov
+from .provider import VerifyItem, SCHEME_P256, SCHEME_ED25519
+
+P256_N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+P256_HALF_N = (P256_N - 1) // 2
+
+
+class SigningKey:
+    """A host-side private key (scheme + cryptography key object)."""
+
+    def __init__(self, scheme: str, key):
+        self.scheme = scheme
+        self._key = key
+
+    def public_bytes(self) -> bytes:
+        """Provider wire format: SEC1 uncompressed for p256, raw for ed25519."""
+        pub = self._key.public_key()
+        if self.scheme == SCHEME_P256:
+            return pub.public_bytes(
+                serialization.Encoding.X962,
+                serialization.PublicFormat.UncompressedPoint)
+        return pub.public_bytes(
+            serialization.Encoding.Raw, serialization.PublicFormat.Raw)
+
+    @property
+    def key(self):
+        return self._key
+
+
+def parse_p256_pubkey(pubkey: bytes):
+    """SEC1 uncompressed 65B -> EllipticCurvePublicKey (raises on bad input)."""
+    return ec.EllipticCurvePublicKey.from_encoded_point(ec.SECP256R1(), pubkey)
+
+
+def low_s(r: int, s: int) -> tuple:
+    """Normalize an ECDSA-P256 signature to low-S (bccsp/utils ToLowS)."""
+    if s > P256_HALF_N:
+        s = P256_N - s
+    return r, s
+
+
+class SoftwareProvider(prov.Provider):
+    name = "sw"
+
+    def __init__(self, require_low_s: bool = True):
+        self.require_low_s = require_low_s
+
+    def key_gen(self, scheme: str) -> SigningKey:
+        if scheme == SCHEME_P256:
+            return SigningKey(scheme, ec.generate_private_key(ec.SECP256R1()))
+        if scheme == SCHEME_ED25519:
+            return SigningKey(scheme, Ed25519PrivateKey.generate())
+        raise ValueError(f"unsupported scheme {scheme!r}")
+
+    def sign(self, private_key: SigningKey, payload: bytes) -> bytes:
+        """p256: payload is the 32B digest; ed25519: payload is the message."""
+        if private_key.scheme == SCHEME_P256:
+            der = private_key.key.sign(
+                payload, ec.ECDSA(Prehashed(hashes.SHA256())))
+            r, s = low_s(*decode_dss_signature(der))
+            return encode_dss_signature(r, s)
+        if private_key.scheme == SCHEME_ED25519:
+            return private_key.key.sign(payload)
+        raise ValueError(f"unsupported scheme {private_key.scheme!r}")
+
+    def _verify_one(self, it: VerifyItem) -> bool:
+        try:
+            if it.scheme == SCHEME_P256:
+                r, s = decode_dss_signature(it.signature)
+                if self.require_low_s and s > P256_HALF_N:
+                    return False
+                pub = parse_p256_pubkey(it.pubkey)
+                pub.verify(it.signature, it.payload,
+                           ec.ECDSA(Prehashed(hashes.SHA256())))
+                return True
+            if it.scheme == SCHEME_ED25519:
+                Ed25519PublicKey.from_public_bytes(it.pubkey).verify(
+                    it.signature, it.payload)
+                return True
+            return False
+        except (InvalidSignature, ValueError, TypeError):
+            return False
+
+    def batch_verify(self, items: Sequence[VerifyItem]) -> np.ndarray:
+        return np.array([self._verify_one(it) for it in items], dtype=bool)

@@ -1,0 +1,16 @@
+"""MSP: membership service provider — X.509 identity plane.
+
+Re-design of /root/reference/msp/ (msp.go interfaces, mspimpl.go bccspmsp,
+identities.go, mspimplvalidate.go, mspmgrimpl.go): deserialize identities,
+validate cert chains against org root/intermediate CAs, evaluate principals,
+and — the TPU-native twist — *collect* signature verifications as
+VerifyItems instead of verifying one-by-one, so the txvalidator can gate an
+entire block on one batched TPU dispatch (verify-then-gate, SURVEY.md §7).
+"""
+
+from .identity import Identity, SigningIdentity
+from .msp import MSP, MSPConfig, MSPManager, Principal
+from .cache import CachedMSP
+
+__all__ = ["Identity", "SigningIdentity", "MSP", "MSPConfig", "MSPManager",
+           "Principal", "CachedMSP"]

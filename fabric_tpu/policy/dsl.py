@@ -1,0 +1,65 @@
+"""Fabric policy-expression language parser.
+
+Parity: /root/reference/common/cauthdsl/policyparser.go — expressions like
+  AND('Org1.member', 'Org2.member')
+  OR('Org1.admin', AND('Org2.peer', 'Org3.member'))
+  OutOf(2, 'Org1.member', 'Org2.member', 'Org3.member')
+Roles: member | admin | client | peer | orderer (client/peer/orderer are
+treated as member-grade roles here; OU-based role refinement arrives with
+NodeOUs).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from fabric_tpu.msp import Principal
+from .policy import PolicyError, SignaturePolicy, n_out_of, signed_by
+
+_ROLES = {"member", "admin", "client", "peer", "orderer"}
+
+
+def parse_policy(expr: str) -> SignaturePolicy:
+    """Parse a policy expression string into a SignaturePolicy tree."""
+    try:
+        tree = ast.parse(expr.strip(), mode="eval")
+    except SyntaxError as e:
+        raise PolicyError(f"bad policy expression: {e}") from e
+    return _conv(tree.body)
+
+
+def _principal_from_str(s: str) -> Principal:
+    if "." not in s:
+        raise PolicyError(f"principal {s!r} must be 'MSPID.role'")
+    mspid, role = s.rsplit(".", 1)
+    if role not in _ROLES:
+        raise PolicyError(f"unknown role {role!r} in {s!r}")
+    if role == "admin":
+        return Principal.admin(mspid)
+    return Principal.member(mspid)
+
+
+def _conv(node) -> SignaturePolicy:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return signed_by(_principal_from_str(node.value))
+    if not isinstance(node, ast.Call) or not isinstance(node.func, ast.Name):
+        raise PolicyError("expected AND()/OR()/OutOf() call or 'MSP.role' string")
+    name = node.func.id.upper()
+    if name == "AND":
+        rules = [_conv(a) for a in node.args]
+        if not rules:
+            raise PolicyError("AND() needs at least one argument")
+        return n_out_of(len(rules), rules)
+    if name == "OR":
+        rules = [_conv(a) for a in node.args]
+        if not rules:
+            raise PolicyError("OR() needs at least one argument")
+        return n_out_of(1, rules)
+    if name == "OUTOF":
+        if len(node.args) < 2 or not isinstance(node.args[0], ast.Constant) \
+                or not isinstance(node.args[0].value, int):
+            raise PolicyError("OutOf(n, rule, ...) needs an int then rules")
+        n = node.args[0].value
+        rules = [_conv(a) for a in node.args[1:]]
+        return n_out_of(n, rules)
+    raise PolicyError(f"unknown combinator {node.func.id!r}")

@@ -1,0 +1,69 @@
+"""Signature-policy AST and SignedData (protoutil/signeddata.go:21 parity)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Union
+
+from fabric_tpu.msp import Principal
+from fabric_tpu.utils import serde
+
+
+class PolicyError(Exception):
+    pass
+
+
+@dataclass(frozen=True)
+class SignedData:
+    """The (data, identity, signature) triple every policy evaluates over."""
+    data: bytes
+    identity: bytes   # serialized Identity
+    signature: bytes
+
+
+@dataclass(frozen=True)
+class SignaturePolicy:
+    """A node of the policy tree: either a SignedBy leaf or an NOutOf gate.
+
+    kind: "signed_by" (principal set) | "n_out_of" (n, rules)
+    """
+    kind: str
+    principal: Optional[Principal] = None
+    n: int = 0
+    rules: tuple = ()
+
+    def to_dict(self) -> dict:
+        if self.kind == "signed_by":
+            p = self.principal
+            return {"kind": "signed_by",
+                    "principal": {"pkind": p.kind, "mspid": p.mspid,
+                                  "role": p.role, "org_unit": p.org_unit,
+                                  "identity_bytes": p.identity_bytes}}
+        return {"kind": "n_out_of", "n": self.n,
+                "rules": [r.to_dict() for r in self.rules]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "SignaturePolicy":
+        if d["kind"] == "signed_by":
+            pd = d["principal"]
+            return signed_by(Principal(pd["pkind"], mspid=pd["mspid"],
+                                       role=pd["role"], org_unit=pd["org_unit"],
+                                       identity_bytes=pd["identity_bytes"]))
+        return n_out_of(d["n"], [SignaturePolicy.from_dict(r) for r in d["rules"]])
+
+    def serialize(self) -> bytes:
+        return serde.encode(self.to_dict())
+
+    @staticmethod
+    def deserialize(data: bytes) -> "SignaturePolicy":
+        return SignaturePolicy.from_dict(serde.decode(data))
+
+
+def signed_by(principal: Principal) -> SignaturePolicy:
+    return SignaturePolicy("signed_by", principal=principal)
+
+
+def n_out_of(n: int, rules: List[SignaturePolicy]) -> SignaturePolicy:
+    if n < 0 or n > len(rules):
+        raise PolicyError(f"NOutOf({n}) with {len(rules)} rules")
+    return SignaturePolicy("n_out_of", n=n, rules=tuple(rules))

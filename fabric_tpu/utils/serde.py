@@ -1,0 +1,143 @@
+"""Canonical deterministic serialization for framework messages.
+
+Role-equivalent of the reference's protobuf layer + protoutil
+(/root/reference/protoutil/commonutils.go, txutils.go, blockutils.go): every
+on-wire / on-disk structure (identities, transactions, blocks, policies) is
+encoded through here, and hashes/signatures are computed over these bytes.
+
+Format ("FTLV"): a tiny canonical TLV scheme —
+  None   -> 'N'
+  bool   -> 'T'/'F'
+  int    -> 'I' + 8-byte signed big-endian (or 'V' + 4-len + magnitude for big)
+  bytes  -> 'B' + u32 len + raw
+  str    -> 'S' + u32 len + utf-8
+  list   -> 'L' + u32 count + items
+  dict   -> 'D' + u32 count + sorted (str key, value) pairs
+Deterministic by construction (sorted dict keys, fixed-width lengths), so
+equal values always produce equal bytes — the property Fabric gets from
+deterministic proto marshaling of header bytes.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+_U32 = struct.Struct(">I")
+_I64 = struct.Struct(">q")
+
+
+def encode(v: Any) -> bytes:
+    out = bytearray()
+    _enc(v, out)
+    return bytes(out)
+
+
+def _enc(v: Any, out: bytearray) -> None:
+    if v is None:
+        out += b"N"
+    elif v is True:
+        out += b"T"
+    elif v is False:
+        out += b"F"
+    elif isinstance(v, int):
+        if -(2**63) <= v < 2**63:
+            out += b"I"
+            out += _I64.pack(v)
+        else:
+            if v < 0:
+                raise ValueError("big negative ints unsupported")
+            mag = v.to_bytes((v.bit_length() + 7) // 8, "big")
+            out += b"V"
+            out += _U32.pack(len(mag))
+            out += mag
+    elif isinstance(v, (bytes, bytearray, memoryview)):
+        b = bytes(v)
+        out += b"B"
+        out += _U32.pack(len(b))
+        out += b
+    elif isinstance(v, str):
+        b = v.encode("utf-8")
+        out += b"S"
+        out += _U32.pack(len(b))
+        out += b
+    elif isinstance(v, (list, tuple)):
+        out += b"L"
+        out += _U32.pack(len(v))
+        for item in v:
+            _enc(item, out)
+    elif isinstance(v, dict):
+        out += b"D"
+        keys = sorted(v.keys())
+        out += _U32.pack(len(keys))
+        for k in keys:
+            if not isinstance(k, str):
+                raise TypeError("dict keys must be str")
+            kb = k.encode("utf-8")
+            out += _U32.pack(len(kb))
+            out += kb
+            _enc(v[k], out)
+    else:
+        raise TypeError(f"unsupported type {type(v)!r}")
+
+
+def decode(data: bytes) -> Any:
+    try:
+        v, off = _dec(memoryview(data), 0)
+    except struct.error as e:  # truncated length/int field
+        raise ValueError(f"truncated input: {e}") from e
+    if off != len(data):
+        raise ValueError("trailing bytes")
+    return v
+
+
+def _take(mv: memoryview, off: int, n: int) -> bytes:
+    if off + n > len(mv):
+        raise ValueError(f"short buffer: need {n} bytes at {off}, have {len(mv) - off}")
+    return mv[off:off + n].tobytes()
+
+
+def _dec(mv: memoryview, off: int):
+    tag = _take(mv, off, 1)
+    off += 1
+    if tag == b"N":
+        return None, off
+    if tag == b"T":
+        return True, off
+    if tag == b"F":
+        return False, off
+    if tag == b"I":
+        return _I64.unpack_from(mv, off)[0], off + 8
+    if tag == b"V":
+        n = _U32.unpack_from(mv, off)[0]
+        off += 4
+        return int.from_bytes(_take(mv, off, n), "big"), off + n
+    if tag == b"B":
+        n = _U32.unpack_from(mv, off)[0]
+        off += 4
+        return _take(mv, off, n), off + n
+    if tag == b"S":
+        n = _U32.unpack_from(mv, off)[0]
+        off += 4
+        return _take(mv, off, n).decode("utf-8"), off + n
+    if tag == b"L":
+        n = _U32.unpack_from(mv, off)[0]
+        off += 4
+        items = []
+        for _ in range(n):
+            v, off = _dec(mv, off)
+            items.append(v)
+        return items, off
+    if tag == b"D":
+        n = _U32.unpack_from(mv, off)[0]
+        off += 4
+        d = {}
+        for _ in range(n):
+            kn = _U32.unpack_from(mv, off)[0]
+            off += 4
+            k = _take(mv, off, kn).decode("utf-8")
+            off += kn
+            v, off = _dec(mv, off)
+            d[k] = v
+        return d, off
+    raise ValueError(f"bad tag {tag!r} at {off - 1}")

@@ -1,0 +1,101 @@
+"""Differential tests: jaxtpu provider vs sw provider (the reference's
+sw-vs-pkcs11 idiom, bccsp test strategy per SURVEY.md §4)."""
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from fabric_tpu.bccsp import (VerifyItem, SCHEME_P256, SCHEME_ED25519,
+                              init_factories, FactoryOpts)
+from fabric_tpu.bccsp.sw import SoftwareProvider
+from fabric_tpu.bccsp.jaxtpu import JaxTpuProvider
+
+rng = random.Random(11)
+
+
+@pytest.fixture(scope="module")
+def sw():
+    return SoftwareProvider()
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    return JaxTpuProvider()
+
+
+def make_items(sw, n_p256=4, n_ed=3):
+    items = []
+    for _ in range(n_p256):
+        k = sw.key_gen(SCHEME_P256)
+        digest = hashlib.sha256(rng.randbytes(50)).digest()
+        items.append(VerifyItem(SCHEME_P256, k.public_bytes(),
+                                sw.sign(k, digest), digest))
+    for _ in range(n_ed):
+        k = sw.key_gen(SCHEME_ED25519)
+        msg = rng.randbytes(rng.randrange(0, 99))
+        items.append(VerifyItem(SCHEME_ED25519, k.public_bytes(),
+                                sw.sign(k, msg), msg))
+    return items
+
+
+def test_mixed_scheme_batch_matches_sw(sw, tpu):
+    items = make_items(sw)
+    # corrupt a couple
+    bad1 = items[1]
+    items[1] = VerifyItem(bad1.scheme, bad1.pubkey, bad1.signature,
+                          hashlib.sha256(b"other").digest())
+    bad2 = items[5]
+    items[5] = VerifyItem(bad2.scheme, bad2.pubkey, bad2.signature,
+                          bad2.payload + b"x")
+    want = sw.batch_verify(items)
+    got = tpu.batch_verify(items)
+    np.testing.assert_array_equal(got, want)
+    assert want.sum() == len(items) - 2
+
+
+def test_malformed_items_are_false_not_fatal(sw, tpu):
+    k = sw.key_gen(SCHEME_P256)
+    digest = hashlib.sha256(b"m").digest()
+    good = VerifyItem(SCHEME_P256, k.public_bytes(), sw.sign(k, digest), digest)
+    items = [
+        good,
+        VerifyItem(SCHEME_P256, b"\x04" + b"\x00" * 10, good.signature, digest),  # short point
+        VerifyItem(SCHEME_P256, good.pubkey, b"\x30\x01\x00", digest),  # bad DER
+        VerifyItem(SCHEME_P256, good.pubkey, good.signature, b"short"),  # bad digest len
+        VerifyItem(SCHEME_ED25519, b"\x00" * 31, b"\x00" * 64, b""),  # short key
+        VerifyItem("rsa-4096", good.pubkey, good.signature, digest),  # unknown scheme
+        good,
+    ]
+    want = sw.batch_verify(items)
+    got = tpu.batch_verify(items)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(got, [True, False, False, False, False, False, True])
+
+
+def test_high_s_rejected_by_both(sw, tpu):
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature, encode_dss_signature)
+    from fabric_tpu.bccsp.sw import P256_N
+    k = sw.key_gen(SCHEME_P256)
+    digest = hashlib.sha256(b"hs").digest()
+    sig = sw.sign(k, digest)
+    r, s = decode_dss_signature(sig)
+    high = encode_dss_signature(r, P256_N - s)
+    items = [VerifyItem(SCHEME_P256, k.public_bytes(), high, digest),
+             VerifyItem(SCHEME_P256, k.public_bytes(), sig, digest)]
+    np.testing.assert_array_equal(sw.batch_verify(items), [False, True])
+    np.testing.assert_array_equal(tpu.batch_verify(items), [False, True])
+
+
+def test_factory_gate(sw):
+    p = init_factories(FactoryOpts(default="SW"))
+    assert p.name == "sw"
+    p = init_factories(FactoryOpts(default="JAXTPU"))
+    assert p.name == "jaxtpu"
+    with pytest.raises(ValueError):
+        init_factories(FactoryOpts(default="HSM"))
+
+
+def test_empty_batch(tpu):
+    assert tpu.batch_verify([]).shape == (0,)

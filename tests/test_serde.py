@@ -1,0 +1,29 @@
+"""Canonical serde: roundtrips, determinism, malformed-input rejection."""
+import pytest
+
+from fabric_tpu.utils import serde
+
+
+def test_roundtrip_and_determinism():
+    v = {"b": b"\x00\xff", "a": [1, -5, 2**200, None, True, False, "s"],
+         "nested": {"k": [{"x": b""}]}}
+    enc = serde.encode(v)
+    assert serde.decode(enc) == v
+    assert serde.encode({"a": v["a"], "b": v["b"], "nested": v["nested"]}) == enc
+
+
+def test_malformed_inputs_raise_valueerror():
+    for bad in [b"", b"I\x00\x01", b"B\x00\x00\x00\x10abc", b"Z",
+                b"D\x00\x00\x00\x01\x00\x00\x00\x05ab",
+                serde.encode({"a": 1}) + b"tail"]:
+        with pytest.raises(ValueError):
+            serde.decode(bad)
+
+
+def test_unsupported_types_raise():
+    with pytest.raises(TypeError):
+        serde.encode(1.5)
+    with pytest.raises(TypeError):
+        serde.encode({1: "intkey"})
+    with pytest.raises(ValueError):
+        serde.encode(-(2**100))
