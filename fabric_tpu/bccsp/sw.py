@@ -90,6 +90,13 @@ class SoftwareProvider(prov.Provider):
     def _verify_one(self, it: VerifyItem) -> bool:
         try:
             if it.scheme == SCHEME_P256:
+                # same wire checks as the jaxtpu packer (_pack_p256) so the
+                # two providers reject the exact same malformed inputs —
+                # required for the atomic-fallback determinism invariant
+                if len(it.pubkey) != 65 or it.pubkey[0] != 0x04:
+                    return False
+                if len(it.payload) != 32:
+                    return False
                 r, s = decode_dss_signature(it.signature)
                 if self.require_low_s and s > P256_HALF_N:
                     return False

@@ -1,0 +1,4 @@
+from .txvalidator import TxValidator, PolicyRegistry, ValidationResult
+from .committer import Committer
+
+__all__ = ["TxValidator", "PolicyRegistry", "ValidationResult", "Committer"]

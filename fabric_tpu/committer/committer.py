@@ -1,0 +1,48 @@
+"""Committer: validate + commit, the StoreBlock composition.
+
+Reference parity: core/committer/committer_impl.go LedgerCommitter plus
+the gossip/state coordinator hand-off (state.go:781 commitBlock ->
+coordinator.StoreBlock -> txvalidator.Validate -> CommitLegacy).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Optional
+
+from fabric_tpu.ledger import KVLedger
+from fabric_tpu.protocol import Block
+
+from .txvalidator import TxValidator, ValidationResult
+
+logger = logging.getLogger("fabric_tpu.committer")
+
+
+@dataclass
+class BlockCommitResult:
+    validation: ValidationResult  # flags as of the sig/policy gate
+    commit_stats: object          # ledger CommitStats
+    final_flags: object           # TxFlags after MVCC (what the block stores)
+
+
+class Committer:
+    def __init__(self, ledger: KVLedger, validator: TxValidator):
+        self.ledger = ledger
+        self.validator = validator
+        # wire the duplicate-txid oracle to the block store
+        self.validator.ledger_has_txid = ledger.blockstore.has_txid
+
+    def store_block(self, block: Block) -> BlockCommitResult:
+        """Validate (verify-then-gate) and commit one block."""
+        from fabric_tpu.protocol.txflags import TxFlags
+        from fabric_tpu.protocol.types import META_TXFLAGS
+
+        vr = self.validator.validate(block)
+        stats = self.ledger.commit(block)
+        final = TxFlags.from_bytes(block.metadata.items[META_TXFLAGS])
+        return BlockCommitResult(vr, stats, final)
+
+    @property
+    def height(self) -> int:
+        return self.ledger.height

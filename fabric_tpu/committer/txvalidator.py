@@ -1,0 +1,282 @@
+"""Block validation orchestrator — the verify-then-gate hot path.
+
+Reference flow being restructured (SURVEY.md §3.2, §7):
+  core/committer/txvalidator/v20/validator.go:181-266 Validate(block):
+    per-tx goroutines (:194-209) each doing
+      ValidateTransaction (core/common/validation/msgvalidation.go:248)
+        checkSignatureFromCreator (:26-56)          <- 1 ECDSA verify
+      Dispatcher.Dispatch (plugindispatcher/dispatcher.go:102)
+        builtin v20 Validate (validation_logic.go:185)
+          policy EvaluateSignedData                 <- N ECDSA verifies
+    then txflags bitmap assembly (:214-260).
+
+TPU-native restructure, in three passes over the whole block:
+  PASS 1 (host, no crypto):  structural validation, duplicate-txid marking,
+    and *collection* of every SignedData the reference would have verified
+    — creator sigs and endorsement sets — deduplicated globally by
+    (scheme, pubkey, payload, signature) since Verify is a pure function.
+  DISPATCH (device):         ONE batched provider.batch_verify for the
+    entire block (p256 + ed25519 sub-batches, mesh-sharded).
+  PASS 2 (host, no crypto):  gate on the verdict bitmap — creator-sig
+    check consumes its bit; policy evaluation re-runs the exact cauthdsl
+    greedy semantics over identities whose bits are set (a bad endorsement
+    only weakens the policy, it never fails the block: policy.go:390-393).
+
+MVCC runs afterwards in the ledger (kvledger.commit), consuming the flags
+this produces — identical decision order to the reference.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from fabric_tpu.bccsp import VerifyItem
+from fabric_tpu.msp import Identity
+from fabric_tpu.policy import PolicyEvaluator, SignaturePolicy, SignedData
+from fabric_tpu.protocol import (
+    Block,
+    Envelope,
+    Header,
+    Transaction,
+)
+from fabric_tpu.protocol.build import compute_txid
+from fabric_tpu.protocol.txflags import TxFlags, ValidationCode
+from fabric_tpu.protocol.types import META_TXFLAGS, TX_CONFIG, TX_ENDORSER
+
+logger = logging.getLogger("fabric_tpu.committer")
+
+
+class PolicyRegistry:
+    """namespace -> endorsement policy (the _lifecycle/plugindispatcher
+    lookup surface, dispatcher.go:102).  Falls back to a default policy,
+    like a chaincode with no explicit endorsement policy falls back to
+    the channel's majority-endorsement default."""
+
+    def __init__(self, default: Optional[SignaturePolicy] = None):
+        self._policies: Dict[str, SignaturePolicy] = {}
+        self._default = default
+
+    def set_policy(self, namespace: str, policy: SignaturePolicy) -> None:
+        self._policies[namespace] = policy
+
+    def policy_for(self, namespace: str) -> Optional[SignaturePolicy]:
+        return self._policies.get(namespace, self._default)
+
+
+@dataclass
+class _TxWork:
+    """Collected verification workload for one transaction."""
+    tx_num: int
+    creator_key: Optional[Tuple] = None          # dedup key of creator item
+    creator_identity: Optional[Identity] = None
+    # per-namespace: (policy, [(dedup_key, identity), ...])
+    namespaces: List[Tuple[str, SignaturePolicy, List[Tuple[Tuple, Identity]]]] = \
+        field(default_factory=list)
+
+
+@dataclass
+class ValidationResult:
+    flags: TxFlags
+    collect_s: float
+    dispatch_s: float
+    gate_s: float
+    n_items: int
+    n_unique_items: int
+
+    @property
+    def total_s(self) -> float:
+        return self.collect_s + self.dispatch_s + self.gate_s
+
+
+class TxValidator:
+    """v20 TxValidator equivalent bound to one channel."""
+
+    def __init__(self, channel_id: str, msps: Dict[str, object], provider,
+                 policies: PolicyRegistry,
+                 ledger_has_txid=None):
+        self.channel_id = channel_id
+        self.msps = msps
+        self.provider = provider
+        self.policies = policies
+        self.evaluator = PolicyEvaluator(msps, provider)
+        # blkstorage-backed duplicate-txid oracle (validator.go dedup vs ledger)
+        self.ledger_has_txid = ledger_has_txid or (lambda txid: False)
+
+    # -- pass 1: structural + collect ---------------------------------------
+
+    def _item_key(self, item: VerifyItem) -> Tuple:
+        return (item.scheme, item.pubkey, item.payload, item.signature)
+
+    def _deserialize(self, ident_bytes: bytes) -> Optional[Identity]:
+        from fabric_tpu.utils import serde
+        try:
+            mspid = serde.decode(ident_bytes).get("mspid")
+            msp = self.msps.get(mspid)
+            if msp is None:
+                return None
+            return msp.deserialize_identity(ident_bytes)
+        except Exception:
+            return None
+
+    def _collect_tx(self, tx_num: int, env_bytes: bytes, flags: TxFlags,
+                    seen_txids: Dict[str, int],
+                    items: Dict[Tuple, VerifyItem]) -> Optional[_TxWork]:
+        """ValidateTransaction's structural half + workload collection.
+        Returns None when the tx is already terminally flagged."""
+        if not env_bytes:
+            flags.set(tx_num, ValidationCode.NIL_ENVELOPE)
+            return None
+        try:
+            env = Envelope.deserialize(env_bytes)
+            payload = env.payload_dict()  # decode ONCE; header comes from it
+            header = Header.from_dict(payload["header"])
+        except Exception:
+            flags.set(tx_num, ValidationCode.BAD_PAYLOAD)
+            return None
+        ch = header.channel_header
+        if ch.channel_id != self.channel_id:
+            flags.set(tx_num, ValidationCode.TARGET_CHAIN_NOT_FOUND)
+            return None
+        sh = header.signature_header
+        # txid must be derivable from (nonce, creator) — msgvalidation.go
+        if ch.txid != compute_txid(sh.nonce, sh.creator):
+            flags.set(tx_num, ValidationCode.BAD_PROPOSAL_TXID)
+            return None
+        # duplicate txid: against the ledger and earlier txs in this block
+        if ch.txid in seen_txids or self.ledger_has_txid(ch.txid):
+            flags.set(tx_num, ValidationCode.DUPLICATE_TXID)
+            return None
+        seen_txids[ch.txid] = tx_num
+
+        if ch.type == TX_CONFIG:
+            # config txs are validated by the config plane before commit;
+            # their creator sig still gets checked like any other
+            work = _TxWork(tx_num)
+        elif ch.type == TX_ENDORSER:
+            work = _TxWork(tx_num)
+        else:
+            flags.set(tx_num, ValidationCode.UNKNOWN_TX_TYPE)
+            return None
+
+        # creator signature item (checkSignatureFromCreator)
+        creator = self._deserialize(sh.creator)
+        if creator is None or not _msp_validates(self.msps, creator):
+            flags.set(tx_num, ValidationCode.BAD_CREATOR_SIGNATURE)
+            return None
+        item = creator.verify_item(env.payload, env.signature)
+        key = self._item_key(item)
+        items.setdefault(key, item)
+        work.creator_key = key
+        work.creator_identity = creator
+
+        if ch.type == TX_CONFIG:
+            return work
+
+        # endorser tx: unpack actions, collect endorsement sets
+        try:
+            tx = Transaction.from_dict(payload["data"])
+            if not tx.actions:
+                flags.set(tx_num, ValidationCode.NIL_TXACTION)
+                return None
+        except Exception:
+            flags.set(tx_num, ValidationCode.BAD_PAYLOAD)
+            return None
+
+        for action in tx.actions:
+            endorsed = action.endorsed_bytes()
+            # policy scope: the invoked chaincode plus every namespace the tx
+            # WRITES (dispatcher.go:189-191) — read-only namespaces are not
+            # endorsement-checked in the reference
+            namespaces = {ns.namespace for ns in action.action.rwset.ns_rwsets
+                          if ns.writes}
+            namespaces.add(action.action.chaincode_id)
+            # one signature set per action; evaluated against every
+            # written namespace's policy (dispatcher.go:189-191)
+            sigset: List[Tuple[Tuple, Identity]] = []
+            seen_idents = set()
+            for e in action.endorsements:
+                if e.endorser in seen_idents:  # policy.go:385-387 dedup
+                    continue
+                seen_idents.add(e.endorser)
+                ident = self._deserialize(e.endorser)
+                if ident is None:
+                    continue
+                it = ident.verify_item(endorsed + e.endorser, e.signature)
+                k = self._item_key(it)
+                items.setdefault(k, it)
+                sigset.append((k, ident))
+            for ns in sorted(namespaces):
+                pol = self.policies.policy_for(ns)
+                if pol is None:
+                    flags.set(tx_num, ValidationCode.INVALID_CHAINCODE)
+                    return None
+                work.namespaces.append((ns, pol, sigset))
+        return work
+
+    # -- pass 2: gate + evaluate --------------------------------------------
+
+    def _gate_tx(self, work: _TxWork, flags: TxFlags,
+                 verdict: Dict[Tuple, bool]) -> None:
+        if not verdict.get(work.creator_key, False):
+            flags.set(work.tx_num, ValidationCode.BAD_CREATOR_SIGNATURE)
+            return
+        for ns, pol, sigset in work.namespaces:
+            valid_idents = [ident for key, ident in sigset
+                            if verdict.get(key, False)]
+            if not self.evaluator.evaluate(pol, valid_idents):
+                flags.set(work.tx_num, ValidationCode.ENDORSEMENT_POLICY_FAILURE)
+                return
+        flags.set(work.tx_num, ValidationCode.VALID)
+
+    # -- the block entry point (validator.go:181) ---------------------------
+
+    def validate(self, block: Block) -> ValidationResult:
+        n = len(block.data)
+        flags = TxFlags(n)
+
+        t0 = time.perf_counter()
+        seen_txids: Dict[str, int] = {}
+        items: Dict[Tuple, VerifyItem] = {}
+        works: List[_TxWork] = []
+        for tx_num, env_bytes in enumerate(block.data):
+            work = self._collect_tx(tx_num, env_bytes, flags, seen_txids, items)
+            if work is not None:
+                works.append(work)
+        collect_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        keys = list(items.keys())
+        verdicts = (self.provider.batch_verify([items[k] for k in keys])
+                    if keys else np.zeros(0, dtype=bool))
+        verdict = {k: bool(v) for k, v in zip(keys, verdicts)}
+        dispatch_s = time.perf_counter() - t0
+
+        t0 = time.perf_counter()
+        for work in works:
+            self._gate_tx(work, flags, verdict)
+        gate_s = time.perf_counter() - t0
+
+        n_refs = sum(1 + sum(len(s) for _, _, s in w.namespaces) for w in works)
+        block.metadata.items[META_TXFLAGS] = flags.to_bytes()
+        logger.info(
+            "[%s] validated block %d: %d/%d valid | collect=%.1fms "
+            "dispatch=%.1fms (%d uniq sigs) gate=%.1fms",
+            self.channel_id, block.header.number, flags.valid_count(), n,
+            collect_s * 1e3, dispatch_s * 1e3, len(keys), gate_s * 1e3)
+        return ValidationResult(flags, collect_s, dispatch_s, gate_s,
+                                n_refs, len(keys))
+
+
+def _msp_validates(msps: Dict[str, object], ident: Identity) -> bool:
+    msp = msps.get(ident.mspid)
+    if msp is None:
+        return False
+    try:
+        return msp.is_valid(ident)
+    except Exception:
+        return False

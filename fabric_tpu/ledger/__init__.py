@@ -1,0 +1,7 @@
+from .blkstorage import BlockStore, BlockStoreError
+from .statedb import VersionedValue, StateDB, UpdateBatch
+from .historydb import HistoryDB
+from .kvledger import KVLedger, LedgerConfig
+
+__all__ = ["BlockStore", "BlockStoreError", "VersionedValue", "StateDB",
+           "UpdateBatch", "HistoryDB", "KVLedger", "LedgerConfig"]

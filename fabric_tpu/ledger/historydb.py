@@ -1,0 +1,101 @@
+"""Key-history index: which (block, tx) wrote each (ns, key).
+
+Reference parity: core/ledger/kvledger/history/ — a write-only index
+committed per block, queried by GetHistoryForKey (qscc / chaincode shim).
+Only VALID transactions' writes are indexed, newest first on query.
+
+Durable via the same WAL pattern as the state DB; rebuildable from the
+block store (rebuild_dbs.go parity is handled by kvledger).
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from fabric_tpu.utils import serde
+
+_LEN = struct.Struct("<Q")
+
+
+@dataclass(frozen=True)
+class KeyMod:
+    """One historical modification (history.KeyModification)."""
+    block_num: int
+    tx_num: int
+    txid: str
+    value: bytes
+    is_delete: bool
+
+
+class HistoryDB:
+    def __init__(self, root: Optional[str] = None):
+        self.root = root
+        self._lock = threading.RLock()
+        self._index: Dict[Tuple[str, str], List[KeyMod]] = {}
+        self._savepoint: Optional[int] = None
+        if root is not None:
+            os.makedirs(root, exist_ok=True)
+            self._recover()
+
+    @property
+    def savepoint(self) -> Optional[int]:
+        with self._lock:
+            return self._savepoint
+
+    def commit(self, block_num: int,
+               writes: List[Tuple[int, str, str, str, bytes, bool]]) -> None:
+        """writes: (tx_num, txid, ns, key, value, is_delete) of VALID txs."""
+        with self._lock:
+            if self._savepoint is not None and block_num <= self._savepoint:
+                return  # already committed (recovery replay)
+            if self.root is not None:
+                payload = serde.encode(
+                    {"block": block_num,
+                     "writes": [[t, x, n, k, v, d]
+                                for t, x, n, k, v, d in writes]})
+                with open(self._wal_path(), "ab") as f:
+                    f.write(_LEN.pack(len(payload)))
+                    f.write(payload)
+                    f.flush()
+                    os.fsync(f.fileno())
+            self._apply(block_num, writes)
+
+    def _apply(self, block_num, writes) -> None:
+        for tx_num, txid, ns, key, value, is_delete in writes:
+            self._index.setdefault((ns, key), []).append(
+                KeyMod(block_num, tx_num, txid, value, is_delete))
+        self._savepoint = block_num
+
+    def get_history(self, ns: str, key: str) -> List[KeyMod]:
+        """Newest-first modification list (GetHistoryForKey)."""
+        with self._lock:
+            return list(reversed(self._index.get((ns, key), [])))
+
+    def _wal_path(self) -> str:
+        return os.path.join(self.root, "history.wal")
+
+    def _recover(self) -> None:
+        if not os.path.exists(self._wal_path()):
+            return
+        with open(self._wal_path(), "rb") as f:
+            data = f.read()
+        off, good_end = 0, 0
+        while off + _LEN.size <= len(data):
+            (n,) = _LEN.unpack_from(data, off)
+            if off + _LEN.size + n > len(data):
+                break
+            try:
+                rec = serde.decode(data[off + _LEN.size:off + _LEN.size + n])
+            except ValueError:
+                break
+            off += _LEN.size + n
+            good_end = off
+            self._apply(rec["block"],
+                        [tuple(w) for w in rec["writes"]])
+        if good_end != len(data):
+            with open(self._wal_path(), "r+b") as f:
+                f.truncate(good_end)

@@ -1,0 +1,226 @@
+"""Versioned key-value state database with savepoint + crash recovery.
+
+Reference parity: core/ledger/kvledger/txmgmt/statedb/statedb.go interface
+and the stateleveldb implementation — versioned values (value, Height),
+update batches applied atomically with a savepoint, ordered range scans.
+
+Durability model: an append-only WAL of update batches (one record per
+block) plus periodic full snapshots for compaction.  On open: load the
+newest snapshot, replay WAL records past it, truncate any torn tail.
+Savepoint = block number of the last applied batch; the kvledger recovery
+path replays blocks above the savepoint from the block store
+(core/ledger/kvledger/recovery.go semantics).
+
+Keys are (namespace, key) pairs, ordered lexicographically for range
+scans (leveldb iterator parity).
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import struct
+import threading
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Tuple
+
+from fabric_tpu.protocol import Version
+from fabric_tpu.utils import serde
+
+_LEN = struct.Struct("<Q")
+SNAPSHOT_EVERY = 256  # batches between snapshot compactions
+
+
+@dataclass(frozen=True)
+class VersionedValue:
+    value: bytes
+    version: Version
+
+
+class UpdateBatch:
+    """statedb.UpdateBatch: puts/deletes staged by MVCC validation."""
+
+    def __init__(self):
+        self._updates: Dict[Tuple[str, str], Optional[VersionedValue]] = {}
+
+    def put(self, ns: str, key: str, value: bytes, version: Version) -> None:
+        self._updates[(ns, key)] = VersionedValue(value, version)
+
+    def delete(self, ns: str, key: str, version: Version) -> None:
+        # deletes still carry the deleting tx's version (stateleveldb tombstone)
+        self._updates[(ns, key)] = None
+
+    def get(self, ns: str, key: str):
+        """(found, vv) — distinguishes absent from staged-delete."""
+        k = (ns, key)
+        return (k in self._updates), self._updates.get(k)
+
+    def items(self):
+        return self._updates.items()
+
+    def __len__(self):
+        return len(self._updates)
+
+
+class StateDB:
+    """Versioned state store (VersionedDB iface, statedb.go)."""
+
+    def __init__(self, root: Optional[str] = None,
+                 snapshot_every: int = SNAPSHOT_EVERY):
+        self.root = root
+        self.snapshot_every = snapshot_every
+        self._lock = threading.RLock()
+        self._data: Dict[Tuple[str, str], VersionedValue] = {}
+        self._sorted_keys: List[Tuple[str, str]] = []
+        self._savepoint: Optional[int] = None
+        self._batches_since_snapshot = 0
+        if root is not None:
+            os.makedirs(root, exist_ok=True)
+            self._recover()
+
+    # -- reads --------------------------------------------------------------
+
+    def get(self, ns: str, key: str) -> Optional[VersionedValue]:
+        with self._lock:
+            return self._data.get((ns, key))
+
+    def get_version(self, ns: str, key: str) -> Optional[Version]:
+        vv = self.get(ns, key)
+        return None if vv is None else vv.version
+
+    def range_scan(self, ns: str, start_key: str, end_key: str,
+                   limit: int = 0) -> Iterator[Tuple[str, VersionedValue]]:
+        """Ordered scan over [start_key, end_key) within a namespace;
+        empty end_key = scan to namespace end (stateleveldb iterator)."""
+        with self._lock:
+            lo = bisect.bisect_left(self._sorted_keys, (ns, start_key))
+            out = []
+            for i in range(lo, len(self._sorted_keys)):
+                kns, key = self._sorted_keys[i]
+                if kns != ns or (end_key and key >= end_key):
+                    break
+                out.append((key, self._data[(kns, key)]))
+                if limit and len(out) >= limit:
+                    break
+        return iter(out)
+
+    @property
+    def savepoint(self) -> Optional[int]:
+        with self._lock:
+            return self._savepoint
+
+    def __len__(self):
+        return len(self._data)
+
+    # -- writes -------------------------------------------------------------
+
+    def apply_updates(self, batch: UpdateBatch, block_num: int) -> None:
+        """Atomically apply one block's updates + advance the savepoint
+        (statedb ApplyUpdates with sp)."""
+        with self._lock:
+            if self._savepoint is not None and block_num <= self._savepoint:
+                raise ValueError(
+                    f"batch for block {block_num} <= savepoint {self._savepoint}")
+            if self.root is not None:
+                self._wal_append(batch, block_num)
+            self._apply_in_memory(batch, block_num)
+            if self.root is not None:
+                self._batches_since_snapshot += 1
+                if self._batches_since_snapshot >= self.snapshot_every:
+                    self._write_snapshot()
+
+    def _apply_in_memory(self, batch: UpdateBatch, block_num: int) -> None:
+        for (ns, key), vv in batch.items():
+            k = (ns, key)
+            if vv is None:
+                if k in self._data:
+                    del self._data[k]
+                    i = bisect.bisect_left(self._sorted_keys, k)
+                    if i < len(self._sorted_keys) and self._sorted_keys[i] == k:
+                        self._sorted_keys.pop(i)
+            else:
+                if k not in self._data:
+                    bisect.insort(self._sorted_keys, k)
+                self._data[k] = vv
+        self._savepoint = block_num
+
+    # -- persistence --------------------------------------------------------
+
+    def _wal_path(self) -> str:
+        return os.path.join(self.root, "state.wal")
+
+    def _snap_path(self) -> str:
+        return os.path.join(self.root, "state.snapshot")
+
+    @staticmethod
+    def _encode_batch(batch: UpdateBatch, block_num: int) -> bytes:
+        recs = []
+        for (ns, key), vv in sorted(batch.items()):
+            recs.append({"ns": ns, "key": key,
+                         "value": None if vv is None else vv.value,
+                         "version": None if vv is None else vv.version.to_list()})
+        return serde.encode({"block": block_num, "updates": recs})
+
+    def _wal_append(self, batch: UpdateBatch, block_num: int) -> None:
+        payload = self._encode_batch(batch, block_num)
+        with open(self._wal_path(), "ab") as f:
+            f.write(_LEN.pack(len(payload)))
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+
+    def _write_snapshot(self) -> None:
+        recs = []
+        for (ns, key) in self._sorted_keys:
+            vv = self._data[(ns, key)]
+            recs.append({"ns": ns, "key": key, "value": vv.value,
+                         "version": vv.version.to_list()})
+        payload = serde.encode({"savepoint": self._savepoint, "data": recs})
+        tmp = self._snap_path() + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(payload)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._snap_path())
+        with open(self._wal_path(), "wb") as f:
+            f.truncate(0)
+        self._batches_since_snapshot = 0
+
+    def _recover(self) -> None:
+        if os.path.exists(self._snap_path()):
+            with open(self._snap_path(), "rb") as f:
+                snap = serde.decode(f.read())
+            self._savepoint = snap["savepoint"]
+            for rec in snap["data"]:
+                k = (rec["ns"], rec["key"])
+                self._data[k] = VersionedValue(
+                    rec["value"], Version.from_list(rec["version"]))
+            self._sorted_keys = sorted(self._data.keys())
+        if not os.path.exists(self._wal_path()):
+            return
+        with open(self._wal_path(), "rb") as f:
+            data = f.read()
+        off, good_end = 0, 0
+        while off + _LEN.size <= len(data):
+            (n,) = _LEN.unpack_from(data, off)
+            if off + _LEN.size + n > len(data):
+                break
+            try:
+                rec = serde.decode(data[off + _LEN.size:off + _LEN.size + n])
+            except ValueError:
+                break
+            off += _LEN.size + n
+            good_end = off
+            if self._savepoint is not None and rec["block"] <= self._savepoint:
+                continue  # already in snapshot
+            batch = UpdateBatch()
+            for u in rec["updates"]:
+                if u["value"] is None:
+                    batch.delete(u["ns"], u["key"], Version(rec["block"], 0))
+                else:
+                    batch.put(u["ns"], u["key"], u["value"],
+                              Version.from_list(u["version"]))
+            self._apply_in_memory(batch, rec["block"])
+        if good_end != len(data):
+            with open(self._wal_path(), "r+b") as f:
+                f.truncate(good_end)

@@ -1,0 +1,34 @@
+from .txflags import ValidationCode, TxFlags
+from .types import (
+    ChannelHeader,
+    SignatureHeader,
+    Header,
+    Envelope,
+    KVRead,
+    KVWrite,
+    RangeQueryInfo,
+    NsRwSet,
+    TxRwSet,
+    Endorsement,
+    ChaincodeAction,
+    TransactionAction,
+    Transaction,
+    BlockHeader,
+    BlockMetadata,
+    Block,
+    Version,
+    TX_ENDORSER,
+    TX_CONFIG,
+    block_data_hash,
+    block_header_hash,
+)
+from . import build
+
+__all__ = [
+    "ValidationCode", "TxFlags", "ChannelHeader", "SignatureHeader", "Header",
+    "Envelope", "KVRead", "KVWrite", "RangeQueryInfo", "NsRwSet", "TxRwSet",
+    "Endorsement", "ChaincodeAction", "TransactionAction", "Transaction",
+    "BlockHeader", "BlockMetadata", "Block", "Version",
+    "TX_ENDORSER", "TX_CONFIG", "block_data_hash", "block_header_hash",
+    "build",
+]

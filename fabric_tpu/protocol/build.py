@@ -1,0 +1,120 @@
+"""Constructors for envelopes/transactions/blocks (protoutil parity).
+
+Reference: protoutil/txutils.go CreateSignedTx, protoutil/commonutils.go
+ComputeTxID (sha256 over nonce||creator), protoutil/blockutils.go NewBlock.
+Signing identities are fabric_tpu.msp.SigningIdentity; signatures cover the
+canonical payload bytes, exactly what the verify-then-gate collector later
+re-derives (SURVEY.md §7).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import time
+from typing import List, Optional, Sequence
+
+from fabric_tpu.utils import serde
+
+from .types import (
+    Block,
+    BlockHeader,
+    BlockMetadata,
+    ChaincodeAction,
+    ChannelHeader,
+    Endorsement,
+    Envelope,
+    Header,
+    SignatureHeader,
+    Transaction,
+    TransactionAction,
+    TxRwSet,
+    TX_CONFIG,
+    TX_ENDORSER,
+    META_TXFLAGS,
+    block_data_hash,
+    block_header_hash,
+)
+
+
+def compute_txid(nonce: bytes, creator: bytes) -> str:
+    """protoutil.ComputeTxID: sha256(nonce || creator), hex."""
+    return hashlib.sha256(nonce + creator).hexdigest()
+
+
+def new_nonce() -> bytes:
+    return os.urandom(24)
+
+
+def make_header(tx_type: str, channel_id: str, creator: bytes,
+                nonce: Optional[bytes] = None,
+                timestamp: Optional[int] = None) -> Header:
+    nonce = new_nonce() if nonce is None else nonce
+    ts = int(time.time()) if timestamp is None else timestamp
+    return Header(
+        ChannelHeader(tx_type, channel_id, compute_txid(nonce, creator),
+                      timestamp=ts),
+        SignatureHeader(creator, nonce))
+
+
+def proposal_hash(channel_id: str, txid: str, chaincode_id: str,
+                  args: Sequence[bytes]) -> bytes:
+    """Binds endorsements to the simulated proposal
+    (protoutil GetProposalHash2 role)."""
+    return hashlib.sha256(serde.encode(
+        {"channel_id": channel_id, "txid": txid,
+         "chaincode_id": chaincode_id, "args": list(args)})).digest()
+
+
+def endorse(action: TransactionAction, signer) -> Endorsement:
+    """ESCC signing step (default_endorsement.go:36): signature over
+    endorsed-bytes || serialized endorser identity."""
+    ident = signer.serialize()
+    return Endorsement(ident, signer.sign(action.endorsed_bytes() + ident))
+
+
+def signed_envelope(tx_type: str, channel_id: str, data: dict, signer,
+                    nonce: Optional[bytes] = None,
+                    timestamp: Optional[int] = None) -> Envelope:
+    """Assemble + creator-sign an envelope (protoutil CreateSignedEnvelope)."""
+    header = make_header(tx_type, channel_id, signer.serialize(), nonce,
+                         timestamp)
+    payload = serde.encode({"header": header.to_dict(), "data": data})
+    return Envelope(payload, signer.sign(payload))
+
+
+def endorser_tx(channel_id: str, chaincode_id: str, chaincode_version: str,
+                rwset: TxRwSet, creator, endorsers: Sequence,
+                args: Sequence[bytes] = (),
+                response_payload: bytes = b"",
+                nonce: Optional[bytes] = None,
+                timestamp: Optional[int] = None) -> Envelope:
+    """One-call endorser transaction: simulate-result -> endorsed ->
+    creator-signed envelope (protoutil.CreateSignedTx flow)."""
+    nonce = new_nonce() if nonce is None else nonce
+    creator_bytes = creator.serialize()
+    txid = compute_txid(nonce, creator_bytes)
+    action = ChaincodeAction(chaincode_id, chaincode_version, rwset,
+                             response_payload=response_payload)
+    ta = TransactionAction(
+        proposal_hash(channel_id, txid, chaincode_id, args), action)
+    ta = TransactionAction(ta.proposal_hash, ta.action,
+                           tuple(endorse(ta, e) for e in endorsers))
+    tx = Transaction((ta,))
+    return signed_envelope(TX_ENDORSER, channel_id, tx.to_dict(), creator,
+                           nonce=nonce, timestamp=timestamp)
+
+
+def new_block(number: int, previous_hash: bytes,
+              envelopes: Sequence[Envelope]) -> Block:
+    """protoutil.NewBlock + data-hash computation."""
+    data = [e.serialize() for e in envelopes]
+    return Block(BlockHeader(number, previous_hash, block_data_hash(data)),
+                 data, BlockMetadata())
+
+
+def genesis_block(channel_id: str, config_data: dict, signer) -> Block:
+    """Block 0: a config envelope carrying the channel config
+    (configtxgen's output shape)."""
+    env = signed_envelope(TX_CONFIG, channel_id, config_data, signer)
+    return new_block(0, b"\x00" * 32, [env])

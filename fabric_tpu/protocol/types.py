@@ -1,0 +1,397 @@
+"""Wire/on-disk message structures: envelopes, transactions, blocks.
+
+Role-equivalent of fabric-protos-go common/peer messages plus protoutil
+(/root/reference/protoutil/{commonutils,txutils,blockutils}.go).  Encoding
+is the canonical FTLV scheme in fabric_tpu.utils.serde; all hashes and
+signatures are computed over those bytes, mirroring how the reference
+hashes deterministic proto marshals (protoutil/blockutils.go BlockDataHash,
+BlockHeaderHash).
+
+Structure map (reference -> here):
+  common.Envelope{Payload,Signature}            -> Envelope
+  common.Header{ChannelHeader,SignatureHeader}  -> Header
+  peer.Transaction /{TransactionAction}         -> Transaction/TransactionAction
+  rwset.TxReadWriteSet (kvrwset)                -> TxRwSet/NsRwSet/KVRead/KVWrite
+  peer.Endorsement                              -> Endorsement
+  common.Block{Header,Data,Metadata}            -> Block
+  version.Height (core/ledger/.../version)      -> Version
+"""
+
+from __future__ import annotations
+
+import hashlib
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from fabric_tpu.utils import serde
+
+# channel-header types (common.HeaderType equivalents)
+TX_ENDORSER = "endorser_transaction"
+TX_CONFIG = "config"
+
+# block metadata indexes (common.BlockMetadataIndex)
+META_SIGNATURES = "signatures"
+META_TXFLAGS = "txflags"
+META_LAST_CONFIG = "last_config"
+META_COMMIT_HASH = "commit_hash"
+
+
+def _d(obj) -> dict:
+    """Strip None values so encodings stay minimal and stable."""
+    return {k: v for k, v in obj.items() if v is not None}
+
+
+# ---------------------------------------------------------------------------
+# headers / envelopes
+
+
+@dataclass(frozen=True)
+class ChannelHeader:
+    """common.ChannelHeader (protoutil/commonutils.go MakeChannelHeader)."""
+    type: str
+    channel_id: str
+    txid: str
+    epoch: int = 0
+    timestamp: int = 0  # unix seconds; NOT part of txid derivation
+
+    def to_dict(self) -> dict:
+        return {"type": self.type, "channel_id": self.channel_id,
+                "txid": self.txid, "epoch": self.epoch,
+                "timestamp": self.timestamp}
+
+    @staticmethod
+    def from_dict(d: dict) -> "ChannelHeader":
+        return ChannelHeader(d["type"], d["channel_id"], d["txid"],
+                             d.get("epoch", 0), d.get("timestamp", 0))
+
+
+@dataclass(frozen=True)
+class SignatureHeader:
+    """common.SignatureHeader{Creator, Nonce}."""
+    creator: bytes  # serialized Identity
+    nonce: bytes
+
+    def to_dict(self) -> dict:
+        return {"creator": self.creator, "nonce": self.nonce}
+
+    @staticmethod
+    def from_dict(d: dict) -> "SignatureHeader":
+        return SignatureHeader(d["creator"], d["nonce"])
+
+
+@dataclass(frozen=True)
+class Header:
+    channel_header: ChannelHeader
+    signature_header: SignatureHeader
+
+    def to_dict(self) -> dict:
+        return {"channel_header": self.channel_header.to_dict(),
+                "signature_header": self.signature_header.to_dict()}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Header":
+        return Header(ChannelHeader.from_dict(d["channel_header"]),
+                      SignatureHeader.from_dict(d["signature_header"]))
+
+
+@dataclass(frozen=True)
+class Envelope:
+    """common.Envelope: payload bytes + creator signature over them.
+
+    payload decodes to {"header": Header, "data": <tx-type-specific>}.
+    """
+    payload: bytes
+    signature: bytes
+
+    def serialize(self) -> bytes:
+        return serde.encode({"payload": self.payload, "signature": self.signature})
+
+    @staticmethod
+    def deserialize(data: bytes) -> "Envelope":
+        d = serde.decode(data)
+        return Envelope(d["payload"], d["signature"])
+
+    def payload_dict(self) -> dict:
+        return serde.decode(self.payload)
+
+    def header(self) -> Header:
+        return Header.from_dict(self.payload_dict()["header"])
+
+
+# ---------------------------------------------------------------------------
+# read/write sets
+
+
+@dataclass(frozen=True)
+class Version:
+    """version.Height — (block_num, tx_num) of the committing write."""
+    block_num: int
+    tx_num: int
+
+    def to_list(self) -> list:
+        return [self.block_num, self.tx_num]
+
+    @staticmethod
+    def from_list(v) -> Optional["Version"]:
+        return None if v is None else Version(v[0], v[1])
+
+    def __lt__(self, other: "Version") -> bool:
+        return (self.block_num, self.tx_num) < (other.block_num, other.tx_num)
+
+
+@dataclass(frozen=True)
+class KVRead:
+    key: str
+    version: Optional[Version]  # None = key absent at read time
+
+    def to_dict(self) -> dict:
+        return {"key": self.key,
+                "version": None if self.version is None else self.version.to_list()}
+
+    @staticmethod
+    def from_dict(d: dict) -> "KVRead":
+        return KVRead(d["key"], Version.from_list(d.get("version")))
+
+
+@dataclass(frozen=True)
+class KVWrite:
+    key: str
+    value: bytes = b""
+    is_delete: bool = False
+
+    def to_dict(self) -> dict:
+        return {"key": self.key, "value": self.value, "is_delete": self.is_delete}
+
+    @staticmethod
+    def from_dict(d: dict) -> "KVWrite":
+        return KVWrite(d["key"], d.get("value", b""), d.get("is_delete", False))
+
+
+@dataclass(frozen=True)
+class RangeQueryInfo:
+    """kvrwset.RangeQueryInfo — raw-reads variant: the full result list is
+    replayed at validation (rangequery_validator.go)."""
+    start_key: str
+    end_key: str
+    itr_exhausted: bool
+    reads: Tuple[KVRead, ...] = ()
+
+    def to_dict(self) -> dict:
+        return {"start_key": self.start_key, "end_key": self.end_key,
+                "itr_exhausted": self.itr_exhausted,
+                "reads": [r.to_dict() for r in self.reads]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "RangeQueryInfo":
+        return RangeQueryInfo(d["start_key"], d["end_key"], d["itr_exhausted"],
+                              tuple(KVRead.from_dict(r) for r in d["reads"]))
+
+
+@dataclass(frozen=True)
+class NsRwSet:
+    namespace: str
+    reads: Tuple[KVRead, ...] = ()
+    writes: Tuple[KVWrite, ...] = ()
+    range_queries: Tuple[RangeQueryInfo, ...] = ()
+
+    def to_dict(self) -> dict:
+        return {"namespace": self.namespace,
+                "reads": [r.to_dict() for r in self.reads],
+                "writes": [w.to_dict() for w in self.writes],
+                "range_queries": [q.to_dict() for q in self.range_queries]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "NsRwSet":
+        return NsRwSet(
+            d["namespace"],
+            tuple(KVRead.from_dict(r) for r in d["reads"]),
+            tuple(KVWrite.from_dict(w) for w in d["writes"]),
+            tuple(RangeQueryInfo.from_dict(q) for q in d.get("range_queries", [])))
+
+
+@dataclass(frozen=True)
+class TxRwSet:
+    ns_rwsets: Tuple[NsRwSet, ...] = ()
+
+    def to_dict(self) -> dict:
+        return {"ns": [n.to_dict() for n in self.ns_rwsets]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "TxRwSet":
+        return TxRwSet(tuple(NsRwSet.from_dict(n) for n in d["ns"]))
+
+    def serialize(self) -> bytes:
+        return serde.encode(self.to_dict())
+
+    @staticmethod
+    def deserialize(data: bytes) -> "TxRwSet":
+        return TxRwSet.from_dict(serde.decode(data))
+
+
+# ---------------------------------------------------------------------------
+# endorser transactions
+
+
+@dataclass(frozen=True)
+class Endorsement:
+    """peer.Endorsement: endorser identity + signature over
+    (response_payload || endorser)."""
+    endorser: bytes  # serialized Identity
+    signature: bytes
+
+    def to_dict(self) -> dict:
+        return {"endorser": self.endorser, "signature": self.signature}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Endorsement":
+        return Endorsement(d["endorser"], d["signature"])
+
+
+@dataclass(frozen=True)
+class ChaincodeAction:
+    """peer.ChaincodeAction: the simulation result all endorsers signed.
+
+    proposal_hash binds the action to the simulated proposal
+    (protoutil/txutils.go GetProposalHash2 role).
+    """
+    chaincode_id: str
+    chaincode_version: str
+    rwset: TxRwSet
+    response_status: int = 200
+    response_payload: bytes = b""
+    events: bytes = b""
+
+    def to_dict(self) -> dict:
+        return {"chaincode_id": self.chaincode_id,
+                "chaincode_version": self.chaincode_version,
+                "rwset": self.rwset.to_dict(),
+                "response_status": self.response_status,
+                "response_payload": self.response_payload,
+                "events": self.events}
+
+    @staticmethod
+    def from_dict(d: dict) -> "ChaincodeAction":
+        return ChaincodeAction(d["chaincode_id"], d["chaincode_version"],
+                               TxRwSet.from_dict(d["rwset"]),
+                               d.get("response_status", 200),
+                               d.get("response_payload", b""),
+                               d.get("events", b""))
+
+    def serialize(self) -> bytes:
+        return serde.encode(self.to_dict())
+
+
+@dataclass(frozen=True)
+class TransactionAction:
+    """peer.TransactionAction: proposal hash + action payload + endorsements.
+
+    The bytes every endorsement signature covers are
+    `endorsed_bytes()` || endorser-identity (validation_logic.go:185-217
+    checks sig over ProposalResponsePayload || endorser).
+    """
+    proposal_hash: bytes
+    action: ChaincodeAction
+    endorsements: Tuple[Endorsement, ...] = ()
+
+    def endorsed_bytes(self) -> bytes:
+        return serde.encode({"proposal_hash": self.proposal_hash,
+                             "action": self.action.to_dict()})
+
+    def to_dict(self) -> dict:
+        return {"proposal_hash": self.proposal_hash,
+                "action": self.action.to_dict(),
+                "endorsements": [e.to_dict() for e in self.endorsements]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "TransactionAction":
+        return TransactionAction(d["proposal_hash"],
+                                 ChaincodeAction.from_dict(d["action"]),
+                                 tuple(Endorsement.from_dict(e)
+                                       for e in d["endorsements"]))
+
+
+@dataclass(frozen=True)
+class Transaction:
+    """peer.Transaction: ordered list of actions (in practice length 1)."""
+    actions: Tuple[TransactionAction, ...]
+
+    def to_dict(self) -> dict:
+        return {"actions": [a.to_dict() for a in self.actions]}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Transaction":
+        return Transaction(tuple(TransactionAction.from_dict(a)
+                                 for a in d["actions"]))
+
+
+# ---------------------------------------------------------------------------
+# blocks
+
+
+@dataclass(frozen=True)
+class BlockHeader:
+    """common.BlockHeader — hash-chained (blockutils.go BlockHeaderHash)."""
+    number: int
+    previous_hash: bytes
+    data_hash: bytes
+
+    def to_dict(self) -> dict:
+        return {"number": self.number, "previous_hash": self.previous_hash,
+                "data_hash": self.data_hash}
+
+    @staticmethod
+    def from_dict(d: dict) -> "BlockHeader":
+        return BlockHeader(d["number"], d["previous_hash"], d["data_hash"])
+
+
+@dataclass
+class BlockMetadata:
+    """common.BlockMetadata keyed by META_* (mutable: the committer fills
+    txflags/commit_hash after ordering signed the block)."""
+    items: Dict[str, Any] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return dict(self.items)
+
+    @staticmethod
+    def from_dict(d: dict) -> "BlockMetadata":
+        return BlockMetadata(dict(d))
+
+
+@dataclass
+class Block:
+    header: BlockHeader
+    data: List[bytes]  # serialized Envelopes
+    metadata: BlockMetadata = field(default_factory=BlockMetadata)
+
+    def to_dict(self) -> dict:
+        return {"header": self.header.to_dict(), "data": list(self.data),
+                "metadata": self.metadata.to_dict()}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Block":
+        return Block(BlockHeader.from_dict(d["header"]), list(d["data"]),
+                     BlockMetadata.from_dict(d["metadata"]))
+
+    def serialize(self) -> bytes:
+        return serde.encode(self.to_dict())
+
+    @staticmethod
+    def deserialize(data: bytes) -> "Block":
+        return Block.from_dict(serde.decode(data))
+
+    def envelopes(self) -> List[Envelope]:
+        return [Envelope.deserialize(b) for b in self.data]
+
+    def hash(self) -> bytes:
+        return block_header_hash(self.header)
+
+
+def block_data_hash(data: List[bytes]) -> bytes:
+    """protoutil.BlockDataHash: hash over the concatenated tx bytes."""
+    return hashlib.sha256(serde.encode(list(data))).digest()
+
+
+def block_header_hash(header: BlockHeader) -> bytes:
+    """protoutil.BlockHeaderHash: the chain link (prev_hash of block n+1)."""
+    return hashlib.sha256(serde.encode(header.to_dict())).digest()

@@ -6,7 +6,13 @@ not under pytest.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The image's sitecustomize force-registers the axon TPU plugin at interpreter
+# startup, which overrides JAX_PLATFORMS; jax.config wins over both.
+import jax
+
+jax.config.update("jax_platforms", "cpu")

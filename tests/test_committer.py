@@ -1,0 +1,161 @@
+"""Verify-then-gate block validation + end-to-end commit pipeline."""
+import numpy as np
+import pytest
+
+from fabric_tpu.bccsp.factory import init_factories, FactoryOpts
+from fabric_tpu.committer import Committer, PolicyRegistry, TxValidator
+from fabric_tpu.ledger import KVLedger, LedgerConfig
+from fabric_tpu.msp import CachedMSP
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.policy import parse_policy
+from fabric_tpu.protocol import (Envelope, KVRead, KVWrite, NsRwSet, TxFlags,
+                                 TxRwSet, ValidationCode, Version)
+from fabric_tpu.protocol import build
+from fabric_tpu.protocol.types import META_TXFLAGS
+
+
+@pytest.fixture(scope="module", autouse=True)
+def sw_provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture()
+def world(sw_provider):
+    org1, org2 = DevOrg("Org1"), DevOrg("Org2")
+    msps = {o.mspid: CachedMSP(o.msp()) for o in (org1, org2)}
+    policies = PolicyRegistry()
+    policies.set_policy("cc", parse_policy("AND('Org1.member', 'Org2.member')"))
+    ledger = KVLedger("ch", LedgerConfig())
+    validator = TxValidator("ch", msps, sw_provider, policies)
+    return org1, org2, Committer(ledger, validator)
+
+
+def rw(reads=(), writes=(), ns="cc"):
+    return TxRwSet((NsRwSet(ns, reads=tuple(reads), writes=tuple(writes)),))
+
+
+def make_tx(org1, org2, rwset, endorsers=None, creator=None):
+    endorsers = endorsers or [org1.new_identity("e1"), org2.new_identity("e2")]
+    return build.endorser_tx("ch", "cc", "1.0", rwset,
+                             creator or org1.new_identity("client"), endorsers)
+
+
+def next_block(committer, envs):
+    lg = committer.ledger
+    prev = (lg.blockstore.chain_info().current_hash
+            if lg.height else b"\x00" * 32)
+    return build.new_block(lg.height, prev, envs)
+
+
+def test_happy_path_commit(world):
+    org1, org2, committer = world
+    envs = [make_tx(org1, org2, rw(writes=[KVWrite(f"k{i}", b"v")]))
+            for i in range(5)]
+    block = next_block(committer, envs)
+    res = committer.store_block(block)
+    assert res.validation.flags.valid_count() == 5
+    assert res.validation.n_unique_items > 0
+    assert committer.ledger.get_state("cc", "k3") == b"v"
+
+
+def test_policy_failure_and_bad_sigs(world):
+    org1, org2, committer = world
+    good = make_tx(org1, org2, rw(writes=[KVWrite("a", b"1")]))
+    # only Org1 endorses an AND(Org1,Org2) policy -> policy failure
+    only1 = make_tx(org1, org2, rw(writes=[KVWrite("b", b"1")]),
+                    endorsers=[org1.new_identity("e")])
+    # corrupt creator signature
+    bad_creator = make_tx(org1, org2, rw(writes=[KVWrite("c", b"1")]))
+    bad_creator = Envelope(bad_creator.payload,
+                           bad_creator.signature[:-2] + b"\x00\x01")
+    block = next_block(committer, [good, only1, bad_creator])
+    res = committer.store_block(block)
+    assert res.validation.flags.codes() == [
+        int(ValidationCode.VALID),
+        int(ValidationCode.ENDORSEMENT_POLICY_FAILURE),
+        int(ValidationCode.BAD_CREATOR_SIGNATURE)]
+    assert committer.ledger.get_state("cc", "a") == b"1"
+    assert committer.ledger.get_state("cc", "b") is None
+
+
+def test_tampered_endorsement_excluded_not_fatal(world):
+    """A bad endorsement signature only excludes that identity
+    (policy.go:390-393) — OR policies still pass via the good one."""
+    org1, org2, committer = world
+    committer.validator.policies.set_policy(
+        "cc", parse_policy("OR('Org1.member', 'Org2.member')"))
+    env = make_tx(org1, org2, rw(writes=[KVWrite("x", b"1")]))
+    # tamper org2's endorsement signature in-place
+    from fabric_tpu.protocol import Transaction
+    payload = env.payload_dict()
+    tx = payload["data"]
+    e2 = tx["actions"][0]["endorsements"][1]
+    e2["signature"] = e2["signature"][:-2] + b"\x00\x01"
+    from fabric_tpu.utils import serde
+    # rebuild envelope with same creator signature -> creator sig now stale;
+    # instead re-sign with the original creator to isolate the endorsement
+    creator = org1.new_identity("fresh")
+    env2 = build.signed_envelope("endorser_transaction", "ch", tx, creator)
+    block = next_block(committer, [env2])
+    res = committer.store_block(block)
+    assert res.validation.flags.is_valid(0)
+
+
+def test_duplicate_txid_within_block_and_ledger(world):
+    org1, org2, committer = world
+    env = make_tx(org1, org2, rw(writes=[KVWrite("d", b"1")]))
+    block = next_block(committer, [env, env])
+    res = committer.store_block(block)
+    assert res.validation.flags.codes() == [
+        int(ValidationCode.VALID), int(ValidationCode.DUPLICATE_TXID)]
+    # replaying the same tx in a later block: duplicate against the ledger
+    block2 = next_block(committer, [env])
+    res2 = committer.store_block(block2)
+    assert res2.validation.flags.codes() == [int(ValidationCode.DUPLICATE_TXID)]
+
+
+def test_mvcc_after_gate(world):
+    org1, org2, committer = world
+    setup = make_tx(org1, org2, rw(writes=[KVWrite("m", b"v0")]))
+    committer.store_block(next_block(committer, [setup]))
+    v = Version(0, 0)
+    t1 = make_tx(org1, org2, rw(reads=[KVRead("m", v)],
+                                writes=[KVWrite("m", b"v1")]))
+    t2 = make_tx(org1, org2, rw(reads=[KVRead("m", v)],
+                                writes=[KVWrite("m", b"v2")]))
+    res = committer.store_block(next_block(committer, [t1, t2]))
+    assert res.validation.flags.valid_count() == 2  # sig/policy pass
+    final = TxFlags.from_bytes(
+        committer.ledger.blockstore.get_by_number(1)
+        .metadata.items[META_TXFLAGS])
+    assert final.codes() == [int(ValidationCode.VALID),
+                             int(ValidationCode.MVCC_READ_CONFLICT)]
+    assert committer.ledger.get_state("cc", "m") == b"v1"
+
+
+def test_structural_rejects(world):
+    org1, org2, committer = world
+    good = make_tx(org1, org2, rw(writes=[KVWrite("s", b"1")]))
+    garbage = b"\xde\xad\xbe\xef"
+    wrong_channel = build.endorser_tx(
+        "other-ch", "cc", "1.0", rw(), org1.new_identity("c"),
+        [org1.new_identity("e")])
+    block = next_block(committer, [good])
+    block.data.append(garbage)
+    block.data.append(wrong_channel.serialize())
+    res = committer.store_block(block)
+    assert res.validation.flags.codes() == [
+        int(ValidationCode.VALID),
+        int(ValidationCode.BAD_PAYLOAD),
+        int(ValidationCode.TARGET_CHAIN_NOT_FOUND)]
+
+
+def test_unknown_namespace_policy_rejected(world):
+    org1, org2, committer = world
+    committer.validator.policies = PolicyRegistry()  # no default, no entries
+    committer.validator.policies.set_policy(
+        "cc", parse_policy("OR('Org1.member')"))
+    env = make_tx(org1, org2, rw(writes=[KVWrite("q", b"1")], ns="unknown_ns"))
+    res = committer.store_block(next_block(committer, [env]))
+    assert res.validation.flags.codes() == [
+        int(ValidationCode.INVALID_CHAINCODE)]

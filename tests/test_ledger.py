@@ -1,0 +1,234 @@
+"""Ledger storage: block store, state DB, history, MVCC, recovery."""
+import os
+
+import pytest
+
+from fabric_tpu.bccsp.factory import init_factories, FactoryOpts
+from fabric_tpu.ledger import (BlockStore, BlockStoreError, HistoryDB,
+                               KVLedger, LedgerConfig, StateDB, UpdateBatch)
+from fabric_tpu.ledger.mvcc import validate_and_prepare_batch
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.protocol import (KVRead, KVWrite, NsRwSet, TxFlags, TxRwSet,
+                                 ValidationCode, Version)
+from fabric_tpu.protocol import build
+from fabric_tpu.protocol.types import META_TXFLAGS, RangeQueryInfo
+
+
+@pytest.fixture(scope="module", autouse=True)
+def sw_provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture(scope="module")
+def org():
+    return DevOrg("Org1")
+
+
+def tx(org, rwset, channel="ch"):
+    return build.endorser_tx(channel, "cc", "1.0", rwset, org.admin, [org.admin])
+
+
+def rw(reads=(), writes=(), ns="cc", rqs=()):
+    return TxRwSet((NsRwSet(ns, reads=tuple(reads), writes=tuple(writes),
+                            range_queries=tuple(rqs)),))
+
+
+# -- block store -------------------------------------------------------------
+
+def test_blockstore_append_index_recover(tmp_path, org):
+    root = str(tmp_path / "blocks")
+    bs = BlockStore(root)
+    envs = [tx(org, rw(writes=[KVWrite(f"k{i}", b"v")])) for i in range(4)]
+    b0 = build.new_block(0, b"\x00" * 32, envs[:2])
+    b1 = build.new_block(1, b0.hash(), envs[2:])
+    bs.add_block(b0)
+    bs.add_block(b1)
+    assert bs.height == 2
+    assert bs.chain_info().current_hash == b1.hash()
+    txid = envs[2].header().channel_header.txid
+    assert bs.get_by_txid(txid).header.number == 1
+    assert bs.get_by_hash(b0.hash()).header.number == 0
+    with pytest.raises(BlockStoreError):
+        bs.add_block(build.new_block(5, b1.hash(), envs[:1]))  # gap
+    with pytest.raises(BlockStoreError):
+        bs.add_block(build.new_block(2, b"\xff" * 32, envs[:1]))  # bad prev
+
+    # reopen: index rebuilt by scan
+    bs2 = BlockStore(root)
+    assert bs2.height == 2
+    assert [b.header.number for b in bs2.iter_blocks()] == [0, 1]
+    assert bs2.get_by_txid(txid).header.number == 1
+
+    # torn trailing write is truncated on open
+    seg = os.path.join(root, "blocks_000000.bin")
+    with open(seg, "ab") as f:
+        f.write(b"\x40\x00\x00\x00\x00\x00\x00\x00partial")
+    bs3 = BlockStore(root)
+    assert bs3.height == 2
+    b2 = build.new_block(2, b1.hash(), envs[:1])
+    bs3.add_block(b2)
+    assert BlockStore(root).height == 3
+
+
+# -- state db ---------------------------------------------------------------
+
+def test_statedb_versions_scan_persistence(tmp_path):
+    root = str(tmp_path / "state")
+    db = StateDB(root, snapshot_every=2)
+    b = UpdateBatch()
+    b.put("cc", "a", b"1", Version(0, 0))
+    b.put("cc", "c", b"2", Version(0, 1))
+    b.put("other", "b", b"9", Version(0, 2))
+    db.apply_updates(b, 0)
+    b = UpdateBatch()
+    b.put("cc", "b", b"3", Version(1, 0))
+    b.delete("cc", "c", Version(1, 1))
+    db.apply_updates(b, 1)  # triggers snapshot
+    b = UpdateBatch()
+    b.put("cc", "d", b"4", Version(2, 0))
+    db.apply_updates(b, 2)  # in WAL past snapshot
+
+    assert db.get("cc", "a").value == b"1"
+    assert db.get("cc", "c") is None
+    assert [k for k, _ in db.range_scan("cc", "a", "")] == ["a", "b", "d"]
+    assert [k for k, _ in db.range_scan("cc", "a", "c")] == ["a", "b"]
+    assert db.savepoint == 2
+
+    db2 = StateDB(root)
+    assert db2.savepoint == 2
+    assert db2.get("cc", "d").value == b"4"
+    assert db2.get("cc", "c") is None
+    assert [k for k, _ in db2.range_scan("cc", "", "")] == ["a", "b", "d"]
+    with pytest.raises(ValueError):
+        db2.apply_updates(UpdateBatch(), 1)  # below savepoint
+
+
+# -- mvcc --------------------------------------------------------------------
+
+def committed_db():
+    db = StateDB()
+    b = UpdateBatch()
+    b.put("cc", "k1", b"v1", Version(1, 0))
+    b.put("cc", "k2", b"v2", Version(1, 1))
+    db.apply_updates(b, 1)
+    return db
+
+
+def test_mvcc_read_conflicts(org):
+    db = committed_db()
+    envs = [
+        tx(org, rw(reads=[KVRead("k1", Version(1, 0))],
+                   writes=[KVWrite("k1", b"new")])),     # valid
+        tx(org, rw(reads=[KVRead("k1", Version(1, 0))],
+                   writes=[KVWrite("k3", b"x")])),       # stale: tx0 wrote k1
+        tx(org, rw(reads=[KVRead("k2", Version(0, 0))])),  # wrong version
+        tx(org, rw(reads=[KVRead("nope", None)],
+                   writes=[KVWrite("k4", b"y")])),       # valid nil read
+    ]
+    flags = TxFlags(4, ValidationCode.VALID)
+    batch, history = validate_and_prepare_batch(
+        db, 2, [e for e in envs], flags)
+    assert flags.codes() == [0, int(ValidationCode.MVCC_READ_CONFLICT),
+                             int(ValidationCode.MVCC_READ_CONFLICT), 0]
+    found, vv = batch.get("cc", "k1")
+    assert found and vv.value == b"new" and vv.version == Version(2, 0)
+    assert {h[3] for h in history} == {"k1", "k4"}
+    # invalid-flagged txs are skipped entirely
+    flags2 = TxFlags(1, ValidationCode.BAD_CREATOR_SIGNATURE)
+    batch2, _ = validate_and_prepare_batch(db, 3, [envs[0]], flags2)
+    assert len(batch2) == 0
+
+
+def test_mvcc_phantom_read(org):
+    db = committed_db()
+    rq_ok = RangeQueryInfo("k0", "k9", True,
+                           (KVRead("k1", Version(1, 0)),
+                            KVRead("k2", Version(1, 1))))
+    rq_missing = RangeQueryInfo("k0", "k9", True,
+                                (KVRead("k1", Version(1, 0)),))
+    envs = [tx(org, rw(rqs=[rq_ok], writes=[KVWrite("z", b"1")])),
+            tx(org, rw(rqs=[rq_missing], writes=[KVWrite("z2", b"1")]))]
+    flags = TxFlags(2, ValidationCode.VALID)
+    validate_and_prepare_batch(db, 2, envs, flags)
+    assert flags.codes() == [0, int(ValidationCode.PHANTOM_READ_CONFLICT)]
+    # a write inside the scanned range by an earlier tx in the same block
+    envs2 = [tx(org, rw(writes=[KVWrite("k15", b"new")])),
+             tx(org, rw(rqs=[rq_ok], writes=[KVWrite("z", b"1")]))]
+    flags2 = TxFlags(2, ValidationCode.VALID)
+    validate_and_prepare_batch(db, 3, envs2, flags2)
+    assert flags2.codes() == [0, int(ValidationCode.PHANTOM_READ_CONFLICT)]
+
+
+# -- kvledger ---------------------------------------------------------------
+
+def ledger_block(ledger, org, rwsets):
+    envs = [tx(org, r) for r in rwsets]
+    prev = (ledger.blockstore.chain_info().current_hash
+            if ledger.height else b"\x00" * 32)
+    block = build.new_block(ledger.height, prev, envs)
+    flags = TxFlags(len(envs), ValidationCode.VALID)
+    block.metadata.items[META_TXFLAGS] = flags.to_bytes()
+    return block
+
+
+def test_kvledger_commit_query_history(tmp_path, org):
+    cfg = LedgerConfig(root=str(tmp_path))
+    lg = KVLedger("ch", cfg)
+    b0 = ledger_block(lg, org, [rw(writes=[KVWrite("k", b"v0")])])
+    lg.commit(b0)
+    b1 = ledger_block(lg, org, [
+        rw(reads=[KVRead("k", Version(0, 0))], writes=[KVWrite("k", b"v1")]),
+        rw(reads=[KVRead("k", Version(0, 0))], writes=[KVWrite("k", b"BAD")]),
+    ])
+    stats = lg.commit(b1)
+    assert stats.valid_txs == 1  # second is an MVCC conflict
+    assert lg.get_state("cc", "k") == b"v1"
+    mods = lg.get_history("cc", "k")
+    assert [m.value for m in mods] == [b"v1", b"v0"]  # newest first
+    assert lg.height == 2
+    ch1 = lg.commit_hash
+    assert ch1 != b"\x00" * 32
+
+    # crash-recovery: reopen; state/history replay to same commit hash
+    lg2 = KVLedger("ch", cfg)
+    assert lg2.height == 2
+    assert lg2.get_state("cc", "k") == b"v1"
+    assert lg2.commit_hash == ch1
+
+    # rebuild derived DBs from blocks only
+    lg2.rebuild_dbs()
+    assert lg2.get_state("cc", "k") == b"v1"
+    assert lg2.commit_hash == ch1
+    assert [m.value for m in lg2.get_history("cc", "k")] == [b"v1", b"v0"]
+
+
+def test_recovery_crash_between_state_and_history(tmp_path, org):
+    """A crash after the state commit but before the history commit must
+    replay the missing history on reopen (lowest-savepoint recovery)."""
+    cfg = LedgerConfig(root=str(tmp_path))
+    lg = KVLedger("ch", cfg)
+    lg.commit(ledger_block(lg, org, [rw(writes=[KVWrite("k", b"v0")])]))
+    # simulate the torn commit: block+state applied, history WAL rolled back
+    b1 = ledger_block(lg, org, [
+        rw(reads=[KVRead("k", Version(0, 0))], writes=[KVWrite("k", b"v1")])])
+    hist_wal = os.path.join(str(tmp_path), "ch", "history", "history.wal")
+    before = os.path.getsize(hist_wal)
+    lg.commit(b1)
+    with open(hist_wal, "r+b") as f:
+        f.truncate(before)
+
+    lg2 = KVLedger("ch", cfg)
+    assert lg2.get_state("cc", "k") == b"v1"
+    assert [m.value for m in lg2.get_history("cc", "k")] == [b"v1", b"v0"]
+    assert lg2.historydb.savepoint == 1
+
+
+def test_blockstore_in_memory_mode(org):
+    bs = BlockStore(None)
+    envs = [tx(org, rw(writes=[KVWrite("k", b"v")]))]
+    b0 = build.new_block(0, b"\x00" * 32, envs)
+    bs.add_block(b0)
+    assert bs.height == 1 and bs.root is None
+    assert bs.get_by_number(0).header == b0.header
+    assert bs.get_by_hash(b0.hash()).header.number == 0
+    assert bs.has_txid(envs[0].header().channel_header.txid)

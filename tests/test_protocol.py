@@ -1,0 +1,85 @@
+"""Protocol layer: envelopes, txs, blocks, hashing, txflags."""
+import pytest
+
+from fabric_tpu.bccsp.factory import init_factories, FactoryOpts
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.protocol import (
+    Block, Envelope, KVRead, KVWrite, NsRwSet, Transaction, TxRwSet,
+    TxFlags, ValidationCode, Version, TX_ENDORSER,
+    block_data_hash, block_header_hash,
+)
+from fabric_tpu.protocol import build
+
+
+@pytest.fixture(scope="module", autouse=True)
+def sw_provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture(scope="module")
+def org():
+    return DevOrg("Org1")
+
+
+def make_rwset(n=2):
+    return TxRwSet((NsRwSet(
+        "cc", reads=(KVRead("k0", Version(1, 0)), KVRead("k9", None)),
+        writes=tuple(KVWrite(f"k{i}", f"v{i}".encode()) for i in range(n))),))
+
+
+def test_envelope_roundtrip_and_txid(org):
+    creator = org.new_identity("alice")
+    env = build.endorser_tx("ch", "cc", "1.0", make_rwset(), creator,
+                            [org.new_identity("e1"), org.new_identity("e2")])
+    env2 = Envelope.deserialize(env.serialize())
+    assert env2 == env
+    h = env2.header()
+    assert h.channel_header.type == TX_ENDORSER
+    assert h.channel_header.channel_id == "ch"
+    assert h.channel_header.txid == build.compute_txid(
+        h.signature_header.nonce, h.signature_header.creator)
+    # creator signature covers payload bytes
+    ident = creator  # has verify()
+    assert ident.verify(env2.payload, env2.signature)
+
+
+def test_transaction_endorsements_verify(org):
+    e1, e2 = org.new_identity("e1"), org.new_identity("e2")
+    env = build.endorser_tx("ch", "cc", "1.0", make_rwset(), org.admin, [e1, e2])
+    tx = Transaction.from_dict(env.payload_dict()["data"])
+    (action,) = tx.actions
+    assert len(action.endorsements) == 2
+    for endo, signer in zip(action.endorsements, (e1, e2)):
+        assert endo.endorser == signer.serialize()
+        assert signer.verify(action.endorsed_bytes() + endo.endorser,
+                             endo.signature)
+    # rwset survives the round trip
+    assert action.action.rwset == make_rwset()
+
+
+def test_block_hash_chain(org):
+    envs = [build.endorser_tx("ch", "cc", "1.0", make_rwset(), org.admin,
+                              [org.admin]) for _ in range(3)]
+    b0 = build.new_block(0, b"\x00" * 32, envs[:2])
+    b1 = build.new_block(1, b0.hash(), envs[2:])
+    assert b0.header.data_hash == block_data_hash(b0.data)
+    assert b1.header.previous_hash == block_header_hash(b0.header)
+    rt = Block.deserialize(b1.serialize())
+    assert rt.header == b1.header and rt.data == b1.data
+    # tamper detection
+    b1.data[0] = b1.data[0][:-1] + b"x"
+    assert block_data_hash(b1.data) != b1.header.data_hash
+
+
+def test_txflags_bitmap():
+    f = TxFlags(4)
+    assert not f.all_validated() and f.valid_count() == 0
+    f.set(0, ValidationCode.VALID)
+    f.set(1, ValidationCode.MVCC_READ_CONFLICT)
+    f.set(2, ValidationCode.VALID)
+    f.set(3, ValidationCode.BAD_CREATOR_SIGNATURE)
+    assert f.all_validated() and f.valid_count() == 2
+    assert f.is_valid(0) and not f.is_valid(1)
+    rt = TxFlags.from_bytes(f.to_bytes())
+    assert rt.codes() == f.codes()
+    assert rt.flag(3) == ValidationCode.BAD_CREATOR_SIGNATURE
