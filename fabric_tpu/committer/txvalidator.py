@@ -113,15 +113,8 @@ class TxValidator:
         return (item.scheme, item.pubkey, item.payload, item.signature)
 
     def _deserialize(self, ident_bytes: bytes) -> Optional[Identity]:
-        from fabric_tpu.utils import serde
-        try:
-            mspid = serde.decode(ident_bytes).get("mspid")
-            msp = self.msps.get(mspid)
-            if msp is None:
-                return None
-            return msp.deserialize_identity(ident_bytes)
-        except Exception:
-            return None
+        from fabric_tpu.msp import deserialize_from_msps
+        return deserialize_from_msps(self.msps, ident_bytes)
 
     def _collect_tx(self, tx_num: int, env_bytes: bytes, flags: TxFlags,
                     seen_txids: Dict[str, int],

@@ -162,6 +162,18 @@ class KVLedger:
         if META_TXFLAGS not in block.metadata.items:
             raise ValueError("block metadata missing txflags "
                              "(txvalidator must run first)")
+        # reject wrong-numbered / wrong-parent blocks BEFORE any state
+        # (incl. the commit-hash chain) advances — duplicate or out-of-order
+        # delivery is normal under gossip and must leave the ledger untouched
+        info = self.blockstore.chain_info()
+        if block.header.number != info.height:
+            raise ValueError(
+                f"out-of-order commit: got block {block.header.number}, "
+                f"expected {info.height}")
+        expected_prev = info.current_hash if info.height else b"\x00" * 32
+        if block.header.previous_hash != expected_prev:
+            raise ValueError(
+                f"block {block.header.number} previous_hash mismatch")
         stats = CommitStats(block_num=block.header.number,
                             total_txs=len(block.data))
         flags = TxFlags.from_bytes(block.metadata.items[META_TXFLAGS])

@@ -9,8 +9,8 @@ entire block on one batched TPU dispatch (verify-then-gate, SURVEY.md §7).
 """
 
 from .identity import Identity, SigningIdentity
-from .msp import MSP, MSPConfig, MSPManager, Principal
+from .msp import MSP, MSPConfig, MSPManager, Principal, deserialize_from_msps
 from .cache import CachedMSP
 
 __all__ = ["Identity", "SigningIdentity", "MSP", "MSPConfig", "MSPManager",
-           "Principal", "CachedMSP"]
+           "Principal", "CachedMSP", "deserialize_from_msps"]

@@ -197,3 +197,26 @@ class MSPManager:
     def deserialize_identity(self, data: bytes) -> Identity:
         ident = Identity.deserialize(data)
         return self.get_msp(ident.mspid).deserialize_identity(data)
+
+
+def deserialize_from_msps(msps: Dict[str, "MSP"], ident_bytes: bytes,
+                          validate: bool = False) -> Optional[Identity]:
+    """Shared lenient identity deserialization used by every plane that
+    routes a wire identity to its MSP (txvalidator, msgprocessor, block
+    signature verification).  Returns None — never raises — on unknown
+    mspid, undecodable bytes, or (when validate=True) failed cert-chain
+    validation, mirroring how the reference callers treat deserialization
+    failures as 'identity contributes nothing' (policies/policy.go:372-383).
+    """
+    from fabric_tpu.utils import serde
+    try:
+        mspid = serde.decode(ident_bytes).get("mspid")
+        msp = msps.get(mspid)
+        if msp is None:
+            return None
+        ident = msp.deserialize_identity(ident_bytes)
+        if validate and not msp.is_valid(ident):
+            return None
+        return ident
+    except Exception:
+        return None

@@ -1,0 +1,36 @@
+"""Ordering service plane.
+
+Reference parity (SURVEY.md §2 "Ordering service", §3.4):
+  orderer/common/blockcutter   -> blockcutter.BlockCutter
+  orderer/common/msgprocessor  -> msgprocessor.{StandardChannelProcessor,...}
+  orderer/common/multichannel  -> blockwriter.BlockWriter, registrar.Registrar
+  orderer/consensus/solo       -> consensus.SoloChain
+  orderer/consensus/etcdraft   -> raft.RaftNode + consensus.RaftChain
+  orderer/common/broadcast     -> broadcast.BroadcastHandler
+  common/deliver               -> deliver.DeliverHandler
+"""
+
+from fabric_tpu.orderer.blockcutter import BatchConfig, BlockCutter
+from fabric_tpu.orderer.blockwriter import (
+    BlockWriter,
+    block_signed_bytes,
+    block_signature_items,
+)
+from fabric_tpu.orderer.msgprocessor import (
+    MsgClass,
+    MsgProcessorError,
+    StandardChannelProcessor,
+    classify,
+)
+from fabric_tpu.orderer.consensus import Chain, SoloChain
+from fabric_tpu.orderer.broadcast import BroadcastHandler, BroadcastResponse
+from fabric_tpu.orderer.deliver import DeliverHandler, SeekInfo
+from fabric_tpu.orderer.registrar import ChainSupport, Registrar
+
+__all__ = [
+    "BatchConfig", "BlockCutter", "BlockWriter", "block_signed_bytes",
+    "block_signature_items", "MsgClass", "MsgProcessorError",
+    "StandardChannelProcessor", "classify", "Chain", "SoloChain",
+    "BroadcastHandler", "BroadcastResponse", "DeliverHandler", "SeekInfo",
+    "ChainSupport", "Registrar",
+]

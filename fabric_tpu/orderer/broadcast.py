@@ -1,0 +1,60 @@
+"""Broadcast ingestion: envelope -> filters -> consenter.
+
+Reference parity: orderer/common/broadcast/broadcast.go —
+Handle (:66) reads envelopes off the stream, ProcessMessage (:136)
+classifies + runs msgprocessor filters, then calls processor.Order /
+Configure (:176) on the channel's chain.  Streaming is a transport
+concern here; `handle` takes one envelope and returns a response the
+way each stream iteration does.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from fabric_tpu.orderer.consensus import ChainHaltedError
+from fabric_tpu.orderer.msgprocessor import MsgClass, MsgProcessorError
+from fabric_tpu.protocol import Envelope
+
+STATUS_SUCCESS = 200
+STATUS_BAD_REQUEST = 400
+STATUS_FORBIDDEN = 403
+STATUS_NOT_FOUND = 404
+STATUS_UNAVAILABLE = 503
+
+
+@dataclass(frozen=True)
+class BroadcastResponse:
+    status: int
+    info: str = ""
+
+
+class BroadcastHandler:
+    """broadcast.Handler bound to a registrar of channels."""
+
+    def __init__(self, registrar):
+        self.registrar = registrar
+
+    def handle(self, env: Envelope) -> BroadcastResponse:
+        try:
+            channel_id = env.header().channel_header.channel_id
+        except Exception:
+            return BroadcastResponse(STATUS_BAD_REQUEST,
+                                     "undecodable envelope header")
+        support = self.registrar.get(channel_id)
+        if support is None:
+            return BroadcastResponse(STATUS_NOT_FOUND,
+                                     f"unknown channel {channel_id!r}")
+        try:
+            cls = support.processor.process(env)
+        except MsgProcessorError as e:
+            return BroadcastResponse(STATUS_FORBIDDEN, str(e))
+        try:
+            if cls is MsgClass.CONFIG:
+                support.chain.configure(env)
+            else:
+                support.chain.order(env)
+        except ChainHaltedError as e:
+            return BroadcastResponse(STATUS_UNAVAILABLE, str(e))
+        return BroadcastResponse(STATUS_SUCCESS)

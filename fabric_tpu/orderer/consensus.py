@@ -1,0 +1,128 @@
+"""Consenter chains: the ordering state machines.
+
+Reference parity: orderer/consensus/consensus.go Chain interface
+(Order/Configure/WaitReady/Start/Halt) and orderer/consensus/solo —
+a single-node chain that cuts batches by count/bytes/timeout and hands
+them to the block writer.  The Raft-replicated chain lives in
+fabric_tpu/orderer/raft.py + RaftChain below it in registrar wiring.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional
+
+from fabric_tpu.orderer.blockcutter import BatchConfig, BlockCutter
+from fabric_tpu.orderer.blockwriter import BlockWriter
+from fabric_tpu.protocol import Envelope
+
+
+class ChainHaltedError(Exception):
+    pass
+
+
+class Chain:
+    """consensus.Chain — what broadcast dispatches into."""
+
+    def order(self, env: Envelope) -> None:
+        raise NotImplementedError
+
+    def configure(self, env: Envelope) -> None:
+        raise NotImplementedError
+
+    def start(self) -> None:
+        pass
+
+    def halt(self) -> None:
+        pass
+
+
+class SoloChain(Chain):
+    """Single-consenter dev chain (orderer/consensus/solo/consensus.go).
+
+    Envelopes are cut into blocks synchronously by count/bytes; the batch
+    timeout is enforced either by `tick(now)` (deterministic tests) or by
+    the optional background timer thread started with `start()`.
+    Config envelopes always cut the pending batch first and are written
+    as single-tx config blocks, mirroring solo's main loop.
+    """
+
+    def __init__(self, cutter: BlockCutter, writer: BlockWriter,
+                 on_block: Optional[Callable] = None):
+        self.cutter = cutter
+        self.writer = writer
+        self.on_block = on_block or (lambda block: None)
+        self._lock = threading.RLock()
+        self._halted = False
+        self._timer: Optional[threading.Thread] = None
+        self._batch_deadline: Optional[float] = None
+
+    # -- Chain interface ----------------------------------------------------
+
+    def order(self, env: Envelope) -> None:
+        with self._lock:
+            self._check_running()
+            batches, pending = self.cutter.ordered(env)
+            for batch in batches:
+                self._write(batch)
+            if pending and self._batch_deadline is None:
+                self._batch_deadline = (time.monotonic()
+                                        + self.cutter.config.batch_timeout_s)
+            elif not pending:
+                self._batch_deadline = None
+
+    def configure(self, env: Envelope) -> None:
+        with self._lock:
+            self._check_running()
+            pending = self.cutter.cut()
+            if pending:
+                self._write(pending)
+            self._write([env.serialize()], is_config=True)
+            self._batch_deadline = None
+
+    def tick(self, now: Optional[float] = None) -> bool:
+        """Cut the pending batch if the batch timeout expired; returns
+        whether a block was written."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._halted or self._batch_deadline is None \
+                    or now < self._batch_deadline:
+                return False
+            batch = self.cutter.cut()
+            self._batch_deadline = None
+            if not batch:
+                return False
+            self._write(batch)
+            return True
+
+    def start(self) -> None:
+        if self._timer is not None:
+            return
+        self._halted = False
+
+        def loop():
+            while not self._halted:
+                time.sleep(self.cutter.config.batch_timeout_s / 4)
+                self.tick()
+
+        self._timer = threading.Thread(target=loop, daemon=True)
+        self._timer.start()
+
+    def halt(self) -> None:
+        with self._lock:
+            self._halted = True
+        if self._timer is not None:
+            self._timer.join(timeout=2)
+            self._timer = None
+
+    # -- internals ----------------------------------------------------------
+
+    def _check_running(self) -> None:
+        if self._halted:
+            raise ChainHaltedError("chain is halted")
+
+    def _write(self, batch: List[bytes], is_config: bool = False) -> None:
+        block = self.writer.create_next_block(batch)
+        self.writer.write_block(block, is_config=is_config)
+        self.on_block(block)

@@ -1,0 +1,235 @@
+"""Ordering plane: blockcutter, msgprocessor, blockwriter, solo chain,
+broadcast + deliver (reference: orderer/common/*, common/deliver)."""
+import threading
+
+import pytest
+
+from fabric_tpu.bccsp.factory import init_factories, FactoryOpts
+from fabric_tpu.msp import CachedMSP
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.orderer import (
+    BatchConfig,
+    BlockCutter,
+    BroadcastHandler,
+    DeliverHandler,
+    Registrar,
+    SeekInfo,
+    block_signature_items,
+)
+from fabric_tpu.orderer.deliver import (
+    BEHAVIOR_FAIL_IF_NOT_READY,
+    DeliverError,
+    NotReadyError,
+    SEEK_NEWEST,
+)
+from fabric_tpu.orderer.msgprocessor import MsgProcessorError
+from fabric_tpu.policy import SignedData, parse_policy
+from fabric_tpu.protocol import Envelope, KVWrite, NsRwSet, TxRwSet, build
+from fabric_tpu.protocol.types import META_LAST_CONFIG, TX_CONFIG
+
+
+@pytest.fixture(scope="module", autouse=True)
+def sw_provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture()
+def org():
+    return DevOrg("OrdererOrg")
+
+
+@pytest.fixture()
+def world(org, sw_provider):
+    msps = {"OrdererOrg": CachedMSP(org.msp())}
+    registrar = Registrar()
+    support = registrar.create_channel(
+        "ch", msps, sw_provider,
+        writers_policy=parse_policy("OR('OrdererOrg.member')"),
+        signer=org.new_identity("orderer"),
+        batch_config=BatchConfig(max_message_count=3, batch_timeout_s=0.05))
+    return registrar, support, org
+
+
+def make_env(org, channel_id="ch", payload_note=b"", name="client"):
+    rwset = TxRwSet((NsRwSet("cc", writes=(KVWrite("k", payload_note),)),))
+    return build.endorser_tx(channel_id, "cc", "1.0", rwset,
+                             org.new_identity(name),
+                             [org.new_identity("e")])
+
+
+def config_env(org, channel_id="ch"):
+    return build.signed_envelope(TX_CONFIG, channel_id,
+                                 {"config": {"note": b"cfg"}},
+                                 org.new_identity("admin"))
+
+
+# -- blockcutter ------------------------------------------------------------
+
+
+def test_blockcutter_count_cut(org):
+    cutter = BlockCutter(BatchConfig(max_message_count=2))
+    e = make_env(org)
+    batches, pending = cutter.ordered(e)
+    assert batches == [] and pending
+    batches, pending = cutter.ordered(make_env(org, payload_note=b"2"))
+    assert len(batches) == 1 and len(batches[0]) == 2 and not pending
+
+
+def test_blockcutter_oversize_isolated(org):
+    cfg = BatchConfig(max_message_count=100, preferred_max_bytes=1)
+    cutter = BlockCutter(cfg)
+    batches, pending = cutter.ordered(make_env(org))
+    # larger than preferred -> isolated batch, nothing pending
+    assert len(batches) == 1 and len(batches[0]) == 1 and not pending
+
+
+def test_blockcutter_preferred_bytes_cut(org):
+    e = make_env(org)
+    size = len(e.serialize())
+    cutter = BlockCutter(BatchConfig(max_message_count=100,
+                                     preferred_max_bytes=int(size * 1.5)))
+    cutter.ordered(e)
+    batches, pending = cutter.ordered(make_env(org, payload_note=b"x"))
+    # second message would exceed preferred -> first batch cut, second pends
+    assert len(batches) == 1 and len(batches[0]) == 1 and pending
+
+
+# -- solo chain / broadcast / blockwriter ----------------------------------
+
+
+def test_broadcast_orders_and_cuts(world):
+    registrar, support, org = world
+    handler = BroadcastHandler(registrar)
+    for i in range(3):
+        resp = handler.handle(make_env(org, payload_note=bytes([i])))
+        assert resp.status == 200, resp.info
+    assert support.ledger.height == 1
+    block = support.ledger.get_by_number(0)
+    assert len(block.data) == 3
+
+
+def test_batch_timeout_tick(world):
+    registrar, support, org = world
+    support.chain.order(make_env(org))
+    assert support.ledger.height == 0
+    assert not support.chain.tick(now=0.0)  # deadline not reached
+    import time
+    assert support.chain.tick(now=time.monotonic() + 10)
+    assert support.ledger.height == 1
+    assert len(support.ledger.get_by_number(0).data) == 1
+
+
+def test_config_cuts_pending_and_isolates(world):
+    registrar, support, org = world
+    handler = BroadcastHandler(registrar)
+    handler.handle(make_env(org))
+    resp = handler.handle(config_env(org))
+    assert resp.status == 200, resp.info
+    assert support.ledger.height == 2  # pending batch + config block
+    cfg_block = support.ledger.get_by_number(1)
+    assert len(cfg_block.data) == 1
+    assert cfg_block.metadata.items[META_LAST_CONFIG] == 1
+    # next normal block still points at config block 1
+    for i in range(3):
+        handler.handle(make_env(org, payload_note=bytes([i])))
+    assert support.ledger.get_by_number(2).metadata.items[META_LAST_CONFIG] == 1
+
+
+def test_block_signature_verifies(world, sw_provider):
+    registrar, support, org = world
+    for i in range(3):
+        support.chain.order(make_env(org, payload_note=bytes([i])))
+    block = support.ledger.get_by_number(0)
+    msps = {"OrdererOrg": CachedMSP(org.msp())}
+    items = block_signature_items(block, msps)
+    assert items and len(items) == 1
+    assert bool(sw_provider.batch_verify(items).all())
+    # tampering the header breaks the signature
+    import copy
+    bad = copy.deepcopy(block)
+    bad.header = type(bad.header)(bad.header.number,
+                                  bad.header.previous_hash,
+                                  b"\x00" * 32)
+    bad_items = block_signature_items(bad, msps)
+    assert not bool(sw_provider.batch_verify(bad_items).all())
+
+
+# -- msgprocessor rejections ------------------------------------------------
+
+
+def test_broadcast_rejects(world):
+    registrar, support, org = world
+    handler = BroadcastHandler(registrar)
+
+    unknown = make_env(org, channel_id="nope")
+    assert handler.handle(unknown).status == 404
+
+    stranger = DevOrg("StrangerOrg")
+    resp = handler.handle(make_env(stranger))
+    assert resp.status == 403  # fails Writers sig-filter
+
+    tampered = make_env(org)
+    tampered = Envelope(tampered.payload,
+                        tampered.signature[:-2] + b"\x00\x01")
+    assert handler.handle(tampered).status == 403
+
+
+def test_size_filter(world):
+    registrar, support, org = world
+    support.processor.absolute_max_bytes = 10
+    with pytest.raises(MsgProcessorError):
+        support.processor.process(make_env(org))
+
+
+# -- deliver ----------------------------------------------------------------
+
+
+def test_deliver_range_and_newest(world):
+    registrar, support, org = world
+    for i in range(7):
+        support.chain.order(make_env(org, payload_note=bytes([i])))
+        support.chain.configure(config_env(org)) if False else None
+    # 7 msgs at max_message_count=3 -> 2 full blocks, 1 pending
+    assert support.ledger.height == 2
+    handler = DeliverHandler(registrar)
+    blocks = list(handler.deliver("ch", SeekInfo(start=0, stop=SEEK_NEWEST)))
+    assert [b.header.number for b in blocks] == [0, 1]
+    with pytest.raises(NotReadyError):
+        list(handler.deliver("ch", SeekInfo(
+            start=5, stop=5, behavior=BEHAVIOR_FAIL_IF_NOT_READY)))
+    with pytest.raises(DeliverError):
+        list(handler.deliver("nope", SeekInfo()))
+
+
+def test_deliver_blocks_until_ready(world):
+    registrar, support, org = world
+    handler = DeliverHandler(registrar)
+    got = []
+
+    def consume():
+        for b in handler.deliver("ch", SeekInfo(start=0, stop=0),
+                                 timeout_s=5.0):
+            got.append(b.header.number)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    for i in range(3):
+        support.chain.order(make_env(org, payload_note=bytes([i])))
+    t.join(timeout=5)
+    assert not t.is_alive() and got == [0]
+
+
+def test_deliver_readers_policy(world, sw_provider):
+    registrar, support, org = world
+    support.readers_policy = parse_policy("OR('OrdererOrg.member')")
+    for i in range(3):
+        support.chain.order(make_env(org, payload_note=bytes([i])))
+    handler = DeliverHandler(registrar)
+    with pytest.raises(DeliverError):
+        list(handler.deliver("ch", SeekInfo(start=0, stop=0)))
+    reader = org.new_identity("reader")
+    req = b"seek-request-bytes"
+    signed = SignedData(req, reader.serialize(), reader.sign(req))
+    blocks = list(handler.deliver("ch", SeekInfo(start=0, stop=0),
+                                  signed=signed))
+    assert len(blocks) == 1
