@@ -50,11 +50,15 @@ class BroadcastHandler:
             cls = support.processor.process(env)
         except MsgProcessorError as e:
             return BroadcastResponse(STATUS_FORBIDDEN, str(e))
+        from fabric_tpu.orderer.raft import NotLeaderError
         try:
             if cls is MsgClass.CONFIG:
                 support.chain.configure(env)
             else:
                 support.chain.order(env)
+        except NotLeaderError as e:
+            # SERVICE_UNAVAILABLE + leader hint so clients re-submit there
+            return BroadcastResponse(STATUS_UNAVAILABLE, str(e))
         except ChainHaltedError as e:
             return BroadcastResponse(STATUS_UNAVAILABLE, str(e))
         return BroadcastResponse(STATUS_SUCCESS)

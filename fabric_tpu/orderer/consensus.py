@@ -66,11 +66,7 @@ class SoloChain(Chain):
             batches, pending = self.cutter.ordered(env)
             for batch in batches:
                 self._write(batch)
-            if pending and self._batch_deadline is None:
-                self._batch_deadline = (time.monotonic()
-                                        + self.cutter.config.batch_timeout_s)
-            elif not pending:
-                self._batch_deadline = None
+            self._restart_deadline(bool(batches), pending)
 
     def configure(self, env: Envelope) -> None:
         with self._lock:
@@ -122,7 +118,190 @@ class SoloChain(Chain):
         if self._halted:
             raise ChainHaltedError("chain is halted")
 
+    def _restart_deadline(self, cut_happened: bool, pending: bool) -> None:
+        """The batch timer restarts on every cut (the reference resets its
+        timer whenever a batch is cut); it only keeps running for an
+        already-pending batch when nothing was cut."""
+        if not pending:
+            self._batch_deadline = None
+        elif cut_happened or self._batch_deadline is None:
+            self._batch_deadline = (time.monotonic()
+                                    + self.cutter.config.batch_timeout_s)
+
     def _write(self, batch: List[bytes], is_config: bool = False) -> None:
         block = self.writer.create_next_block(batch)
         self.writer.write_block(block, is_config=is_config)
         self.on_block(block)
+
+
+# ---------------------------------------------------------------------------
+# Raft-replicated chain (orderer/consensus/etcdraft/chain.go equivalent)
+
+META_RAFT_INDEX = "raft_index"
+
+
+class RaftChain(Chain):
+    """Crash-fault-tolerant ordering over fabric_tpu.orderer.raft.
+
+    Design deviation from the reference (etcdraft/chain.go:378,782): the
+    leader proposes the *cut batch* (serialized envelopes + config flag),
+    not a pre-built block; every node deterministically builds + signs the
+    block at apply time.  Same total order => same block numbers and data
+    hashes on every node, with no in-flight block-number tracking and no
+    leader-change block reconstruction.
+
+    Replay idempotency: each block records the raft entry index that
+    produced it; on restart, re-delivered committed entries at or below
+    the recovered index are skipped (the ledger *is* the applied-state
+    checkpoint, mirroring SURVEY.md §5 checkpoint/resume).
+    """
+
+    def __init__(self, node, cutter: BlockCutter, writer: BlockWriter,
+                 on_block: Optional[Callable] = None):
+        from fabric_tpu.utils import serde as _serde
+        self._serde = _serde
+        self.node = node
+        self.cutter = cutter
+        self.writer = writer
+        self.on_block = on_block or (lambda block: None)
+        self._lock = threading.RLock()
+        self._halted = False
+        self._batch_deadline: Optional[float] = None
+        self._last_applied = self._recover_applied_index()
+        self.catchup_target: Optional[dict] = None  # set on snapshot install
+        self._held_entries: List = []  # entries arriving while catching up
+        node.snapshot_data = self._snapshot_state
+
+    def _recover_applied_index(self) -> int:
+        lg = self.writer.ledger
+        if lg.height == 0:
+            return 0
+        tip = lg.get_by_number(lg.height - 1)
+        return int(tip.metadata.items.get(META_RAFT_INDEX, 0))
+
+    def _snapshot_state(self, index: int) -> bytes:
+        # called from node.maybe_compact() AFTER process_ready applied all
+        # entries <= index, so _last_applied/height describe state AT index
+        return self._serde.encode({
+            "raft_index": self._last_applied,
+            "height": self.writer.height,
+        })
+
+    # -- Chain interface ----------------------------------------------------
+
+    def order(self, env: Envelope) -> None:
+        with self._lock:
+            self._check_running()
+            self._check_leader()  # followers redirect Submit (chain.go:378)
+            batches, pending = self.cutter.ordered(env)
+            for batch in batches:
+                self._propose(batch, is_config=False)
+            self._restart_deadline(bool(batches), pending)
+
+    def configure(self, env: Envelope) -> None:
+        with self._lock:
+            self._check_running()
+            self._check_leader()
+            pending = self.cutter.cut()
+            if pending:
+                self._propose(pending, is_config=False)
+            self._propose([env.serialize()], is_config=True)
+            self._batch_deadline = None
+
+    def _check_leader(self) -> None:
+        from fabric_tpu.orderer import raft as raftmod
+        if self.node.role != raftmod.LEADER:
+            raise raftmod.NotLeaderError(self.node.leader_id)
+
+    def tick_batch(self, now: Optional[float] = None) -> bool:
+        """Cut + propose the pending batch when the batch timeout fires."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if self._halted or self._batch_deadline is None \
+                    or now < self._batch_deadline:
+                return False
+            batch = self.cutter.cut()
+            self._batch_deadline = None
+            if not batch:
+                return False
+            self._propose(batch, is_config=False)
+            return True
+
+    def halt(self) -> None:
+        with self._lock:
+            self._halted = True
+
+    def _check_running(self) -> None:
+        if self._halted:
+            raise ChainHaltedError("chain is halted")
+
+    _restart_deadline = SoloChain._restart_deadline
+
+    # -- raft plumbing -------------------------------------------------------
+
+    def _propose(self, batch, is_config: bool) -> None:
+        self.node.propose(self._serde.encode(
+            {"cfg": is_config, "batch": list(batch)}))
+
+    def process_ready(self):
+        """Drain the raft node: apply committed entries to the ledger and
+        return the outbound messages for the cluster transport to send."""
+        from fabric_tpu.orderer import raft as raftmod
+        r = self.node.take_ready()
+        with self._lock:
+            for e in r.committed:
+                if e.kind == raftmod.ENTRY_SNAPSHOT:
+                    self._on_snapshot_entry(e)
+                elif e.kind == raftmod.ENTRY_NORMAL:
+                    self._apply(e)
+                # ENTRY_CONF is applied inside the raft node (membership)
+        # compact only after the entries above hit the ledger, so the
+        # snapshot's app state matches its raft index
+        self.node.maybe_compact()
+        return r
+
+    def _apply(self, entry) -> None:
+        if self.catchup_target is not None:
+            # ledger is behind the snapshot: hold entries until the missing
+            # blocks arrive (replication), else block numbers would skew
+            self._held_entries.append(entry)
+            return
+        if entry.index <= self._last_applied:
+            return  # replayed on restart; ledger already has the block
+        d = self._serde.decode(entry.data)
+        block = self.writer.create_next_block(d["batch"])
+        block.metadata.items[META_RAFT_INDEX] = entry.index
+        self.writer.write_block(block, is_config=d["cfg"])
+        self._last_applied = entry.index
+        self.on_block(block)
+
+    def _on_snapshot_entry(self, e) -> None:
+        """A snapshot was installed: this node is behind the compacted log
+        and must catch up its *ledger* from a peer (the reference's
+        orderer/common/cluster/replication.go pull path)."""
+        state = self._serde.decode(e.data) if e.data else {}
+        self._last_applied = int(state.get("raft_index", e.index))
+        if int(state.get("height", 0)) > self.writer.ledger.height:
+            self.catchup_target = state
+
+    def catch_up(self, blocks) -> None:
+        """Install blocks fetched from a peer (replication.go equivalent)."""
+        with self._lock:
+            for block in blocks:
+                if block.header.number < self.writer.ledger.height:
+                    continue
+                self.writer.ledger.add_block(block)
+            self.writer._next_number = self.writer.ledger.height
+            info = self.writer.ledger.chain_info()
+            self.writer._prev_hash = info.current_hash
+            self.writer._last_config = self.writer._recover_last_config()
+            # the installed tip's raft index supersedes the snapshot's, or
+            # re-delivered entries would re-apply as duplicate blocks
+            self._last_applied = max(self._last_applied,
+                                     self._recover_applied_index())
+            if self.catchup_target and \
+                    self.writer.ledger.height >= self.catchup_target["height"]:
+                self.catchup_target = None
+                held, self._held_entries = self._held_entries, []
+                for entry in held:
+                    self._apply(entry)

@@ -188,7 +188,6 @@ def test_deliver_range_and_newest(world):
     registrar, support, org = world
     for i in range(7):
         support.chain.order(make_env(org, payload_note=bytes([i])))
-        support.chain.configure(config_env(org)) if False else None
     # 7 msgs at max_message_count=3 -> 2 full blocks, 1 pending
     assert support.ledger.height == 2
     handler = DeliverHandler(registrar)
