@@ -113,6 +113,14 @@ class BlockWriter:
         self._prev_hash = block.hash()
         return block
 
+    def resync(self) -> None:
+        """Re-derive position from the ledger after out-of-band appends
+        (raft catch-up replication writes blocks directly to the store)."""
+        info = self.ledger.chain_info()
+        self._next_number = info.height
+        self._prev_hash = info.current_hash if info.height else b"\x00" * 32
+        self._last_config = self._recover_last_config()
+
     @property
     def height(self) -> int:
         return self._next_number

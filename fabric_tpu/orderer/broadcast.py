@@ -15,6 +15,7 @@ from typing import Optional
 
 from fabric_tpu.orderer.consensus import ChainHaltedError
 from fabric_tpu.orderer.msgprocessor import MsgClass, MsgProcessorError
+from fabric_tpu.orderer.raft import NotLeaderError
 from fabric_tpu.protocol import Envelope
 
 STATUS_SUCCESS = 200
@@ -50,7 +51,6 @@ class BroadcastHandler:
             cls = support.processor.process(env)
         except MsgProcessorError as e:
             return BroadcastResponse(STATUS_FORBIDDEN, str(e))
-        from fabric_tpu.orderer.raft import NotLeaderError
         try:
             if cls is MsgClass.CONFIG:
                 support.chain.configure(env)

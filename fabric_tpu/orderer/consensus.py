@@ -171,6 +171,19 @@ class RaftChain(Chain):
         self.catchup_target: Optional[dict] = None  # set on snapshot install
         self._held_entries: List = []  # entries arriving while catching up
         node.snapshot_data = self._snapshot_state
+        # crash window: snapshot installed but catch_up never ran.  The
+        # node's persisted snapshot state knows the cluster ledger height;
+        # if our ledger is shorter we must re-enter catch-up, else entries
+        # after snap_index would land at wrong block numbers.
+        if node.snap_data:
+            try:
+                state = self._serde.decode(node.snap_data)
+                if int(state.get("height", 0)) > self.writer.ledger.height:
+                    self._last_applied = max(self._last_applied,
+                                             int(state.get("raft_index", 0)))
+                    self.catchup_target = state
+            except ValueError:
+                pass  # snapshot from a raw node with opaque app state
 
     def _recover_applied_index(self) -> int:
         lg = self.writer.ledger
@@ -238,6 +251,19 @@ class RaftChain(Chain):
     _restart_deadline = SoloChain._restart_deadline
 
     # -- raft plumbing -------------------------------------------------------
+    # RaftNode has no internal locking; every access — propose (via
+    # order/configure), transport-driven step, clock-driven tick, and the
+    # ready drain — must hold self._lock.  Transports call chain.step, not
+    # node.step.
+
+    def step(self, msg) -> None:
+        with self._lock:
+            self.node.step(msg)
+
+    def tick(self) -> None:
+        """Advance the raft election/heartbeat clock."""
+        with self._lock:
+            self.node.tick()
 
     def _propose(self, batch, is_config: bool) -> None:
         self.node.propose(self._serde.encode(
@@ -247,17 +273,19 @@ class RaftChain(Chain):
         """Drain the raft node: apply committed entries to the ledger and
         return the outbound messages for the cluster transport to send."""
         from fabric_tpu.orderer import raft as raftmod
-        r = self.node.take_ready()
         with self._lock:
+            r = self.node.take_ready()
             for e in r.committed:
                 if e.kind == raftmod.ENTRY_SNAPSHOT:
                     self._on_snapshot_entry(e)
                 elif e.kind == raftmod.ENTRY_NORMAL:
                     self._apply(e)
                 # ENTRY_CONF is applied inside the raft node (membership)
-        # compact only after the entries above hit the ledger, so the
-        # snapshot's app state matches its raft index
-        self.node.maybe_compact()
+            # compact only after the entries above hit the ledger — and
+            # never while catching up, when _last_applied/height lag the
+            # raft applied index and would bake stale state into the snap
+            if self.catchup_target is None:
+                self.node.maybe_compact()
         return r
 
     def _apply(self, entry) -> None:
@@ -291,10 +319,7 @@ class RaftChain(Chain):
                 if block.header.number < self.writer.ledger.height:
                     continue
                 self.writer.ledger.add_block(block)
-            self.writer._next_number = self.writer.ledger.height
-            info = self.writer.ledger.chain_info()
-            self.writer._prev_hash = info.current_hash
-            self.writer._last_config = self.writer._recover_last_config()
+            self.writer.resync()
             # the installed tip's raft index supersedes the snapshot's, or
             # re-delivered entries would re-apply as duplicate blocks
             self._last_applied = max(self._last_applied,

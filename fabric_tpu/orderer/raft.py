@@ -513,8 +513,12 @@ class RaftNode:
         if new_entries:
             self._persist_entries(new_entries)
         last_new = m.index + len(m.entries)
-        if m.commit > self.commit_index:
-            self.commit_index = min(m.commit, last_new, self.last_index())
+        # clamp BOTH ways: never past what this message proves replicated,
+        # never backwards on duplicated/reordered deliveries
+        new_commit = max(self.commit_index,
+                         min(m.commit, last_new, self.last_index()))
+        if new_commit != self.commit_index:
+            self.commit_index = new_commit
             self._persist_commit()
         self._send(Message(MSG_APP_RESP, self.id, m.frm, self.term,
                            index=last_new))
