@@ -1,0 +1,23 @@
+"""Chaincode plane: contract runtime, simulation stub, lifecycle.
+
+Reference parity (SURVEY.md §2 "Endorsement side"):
+  core/chaincode (gRPC FSM runtime)   -> runtime.ChaincodeRegistry (in-proc)
+  shim GetState/PutState/...          -> stub.ChaincodeStub
+  core/chaincode/lifecycle            -> lifecycle.LifecycleContract/_cache
+
+TPU-native redesign note: the reference launches chaincode as separate
+Docker/external-builder processes speaking a gRPC state-machine protocol
+(core/chaincode/handler.go).  Here contracts execute in-process against a
+read-committed simulator — the process boundary bought isolation for
+untrusted Go binaries, not performance, and the simulation results (rwsets)
+are byte-identical either way.  An external-runner hook stays available via
+runtime.ExternalContract for out-of-process contracts.
+"""
+
+from .stub import ChaincodeStub, SimulationError
+from .runtime import Contract, ChaincodeDefinition, ChaincodeRegistry, ExternalContract
+from .lifecycle import LIFECYCLE_NS, LifecycleContract, LifecyclePolicyProvider
+
+__all__ = ["ChaincodeStub", "SimulationError", "Contract",
+           "ChaincodeDefinition", "ChaincodeRegistry", "ExternalContract",
+           "LIFECYCLE_NS", "LifecycleContract", "LifecyclePolicyProvider"]

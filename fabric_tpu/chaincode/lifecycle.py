@@ -1,0 +1,129 @@
+"""_lifecycle system chaincode: chaincode definitions as consensus state.
+
+Reference parity: core/chaincode/lifecycle/{lifecycle,cache}.go — org
+approvals and committed definitions live in the `_lifecycle` namespace of
+the channel state, so they replicate through ordinary ordering + commit;
+the validator's plugin dispatcher reads each namespace's endorsement
+policy from that state (plugindispatcher/dispatcher.go:102 via the
+lifecycle cache).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from fabric_tpu.chaincode.runtime import ChaincodeDefinition, Contract
+from fabric_tpu.chaincode.stub import ChaincodeStub, SimulationError
+from fabric_tpu.ledger.statedb import StateDB
+from fabric_tpu.policy import SignaturePolicy
+from fabric_tpu.utils import serde
+
+LIFECYCLE_NS = "_lifecycle"
+
+
+def _def_key(name: str) -> str:
+    return f"namespaces/fields/{name}/definition"
+
+
+def _approval_key(name: str, sequence: int, mspid: str) -> str:
+    return f"namespaces/fields/{name}/approvals/{sequence}/{mspid}"
+
+
+class LifecycleContract(Contract):
+    """The `_lifecycle` contract: approve_for_org / commit / query.
+
+    approve: records the calling org's approval of (name, sequence, ...).
+    commit : requires approvals recorded for the majority of `msp_ids`
+             (lifecycle's default LifecycleEndorsement majority policy),
+             then writes the definition.
+    """
+
+    def __init__(self, msp_ids: List[str]):
+        self.msp_ids = sorted(msp_ids)
+
+    def invoke(self, stub: ChaincodeStub, fn: str, args: List[bytes]) -> bytes:
+        if fn == "approve_for_org":
+            return self._approve(stub, *args)
+        if fn == "commit":
+            return self._commit(stub, *args)
+        if fn == "query_definition":
+            return self._query(stub, *args)
+        raise SimulationError(f"unknown lifecycle function {fn!r}")
+
+    def _approve(self, stub: ChaincodeStub, name: bytes, version: bytes,
+                 sequence: bytes, policy: bytes = b"",
+                 mspid: bytes = b"") -> bytes:
+        mspid_s = mspid.decode() or self._creator_mspid(stub)
+        seq = int(sequence)
+        stub.put_state(_approval_key(name.decode(), seq, mspid_s),
+                       serde.encode({"version": version.decode(),
+                                     "policy": policy}))
+        return b"approved"
+
+    def _commit(self, stub: ChaincodeStub, name: bytes, version: bytes,
+                sequence: bytes, policy: bytes = b"") -> bytes:
+        name_s, seq = name.decode(), int(sequence)
+        want = serde.encode({"version": version.decode(), "policy": policy})
+        approvals = 0
+        for mspid in self.msp_ids:
+            got = stub.get_state(_approval_key(name_s, seq, mspid))
+            if got == want:
+                approvals += 1
+        if approvals <= len(self.msp_ids) // 2:
+            raise SimulationError(
+                f"insufficient approvals for {name_s} seq {seq}: "
+                f"{approvals}/{len(self.msp_ids)}")
+        prev = stub.get_state(_def_key(name_s))
+        if prev is not None and serde.decode(prev)["sequence"] >= seq:
+            raise SimulationError(f"sequence {seq} already committed")
+        stub.put_state(_def_key(name_s), serde.encode({
+            "version": version.decode(), "policy": policy, "sequence": seq}))
+        return b"committed"
+
+    def _query(self, stub: ChaincodeStub, name: bytes) -> bytes:
+        got = stub.get_state(_def_key(name.decode()))
+        if got is None:
+            raise SimulationError(f"no definition for {name.decode()!r}")
+        return got
+
+    @staticmethod
+    def _creator_mspid(stub: ChaincodeStub) -> str:
+        try:
+            return serde.decode(stub.creator)["mspid"]
+        except Exception:
+            raise SimulationError("cannot derive creator mspid")
+
+
+class LifecyclePolicyProvider:
+    """policy_for(namespace) backed by committed _lifecycle state — the
+    validator-side lifecycle cache (lifecycle/cache.go) feeding the plugin
+    dispatcher.  Falls back to `default` (channel majority-endorsement)."""
+
+    def __init__(self, db: StateDB, default: Optional[SignaturePolicy] = None,
+                 system_policies: Optional[Dict[str, SignaturePolicy]] = None):
+        self.db = db
+        self.default = default
+        self.system = dict(system_policies or {})
+
+    def set_policy(self, namespace: str, policy: SignaturePolicy) -> None:
+        """Static override for system namespaces (e.g. _lifecycle itself)."""
+        self.system[namespace] = policy
+
+    def policy_for(self, namespace: str) -> Optional[SignaturePolicy]:
+        if namespace in self.system:
+            return self.system[namespace]
+        vv = self.db.get(LIFECYCLE_NS, _def_key(namespace))
+        if vv is not None:
+            raw = serde.decode(vv.value).get("policy", b"")
+            if raw:
+                return SignaturePolicy.deserialize(raw)
+            return self.default
+        return None  # undefined chaincode: validator flags INVALID_CHAINCODE
+
+    def definition_for(self, namespace: str) -> Optional[ChaincodeDefinition]:
+        vv = self.db.get(LIFECYCLE_NS, _def_key(namespace))
+        if vv is None:
+            return None
+        d = serde.decode(vv.value)
+        return ChaincodeDefinition(namespace, d["version"],
+                                   d.get("policy", b""), d["sequence"])

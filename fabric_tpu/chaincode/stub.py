@@ -1,0 +1,133 @@
+"""Transaction simulation stub — the chaincode's view of the ledger.
+
+Reference parity: the shim-side ChaincodeStubInterface (GetState/PutState/
+DelState/GetStateByRange) plus the peer-side lock-based tx simulator
+(core/ledger/kvledger/txmgmt/txmgr/lockbasedtxmgr) that records every read
+with its committed version and stages writes, producing the TxRwSet that
+endorsers sign and the MVCC validator later checks
+(txmgmt/validation/validator.go:83).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from fabric_tpu.ledger.statedb import StateDB
+from fabric_tpu.protocol.types import (
+    KVRead,
+    KVWrite,
+    NsRwSet,
+    RangeQueryInfo,
+    TxRwSet,
+)
+
+
+class SimulationError(Exception):
+    pass
+
+
+class _NsBuilder:
+    def __init__(self):
+        self.reads: Dict[str, KVRead] = {}
+        self.writes: Dict[str, KVWrite] = {}
+        self.range_queries: List[RangeQueryInfo] = []
+
+
+class ChaincodeStub:
+    """One transaction's simulation context over committed state.
+
+    Reads record the committed version (for MVCC); writes stage in the
+    rwset and are read-your-own-writes within this simulation only.
+    """
+
+    def __init__(self, db: StateDB, namespace: str,
+                 channel_id: str = "", txid: str = "",
+                 creator: bytes = b"", registry=None):
+        self._db = db
+        self._ns = namespace
+        self.channel_id = channel_id
+        self.txid = txid
+        self.creator = creator
+        self._registry = registry  # for cc2cc invoke
+        self._builders: Dict[str, _NsBuilder] = {}
+        self._done = False
+
+    def _b(self, ns: Optional[str] = None) -> _NsBuilder:
+        ns = self._ns if ns is None else ns
+        return self._builders.setdefault(ns, _NsBuilder())
+
+    # -- shim surface -------------------------------------------------------
+
+    def get_state(self, key: str) -> Optional[bytes]:
+        self._check_open()
+        b = self._b()
+        if key in b.writes:  # read-your-writes
+            w = b.writes[key]
+            return None if w.is_delete else w.value
+        vv = self._db.get(self._ns, key)
+        if key not in b.reads:  # first read wins (version pinning)
+            b.reads[key] = KVRead(key, None if vv is None else vv.version)
+        return None if vv is None else vv.value
+
+    def put_state(self, key: str, value: bytes) -> None:
+        self._check_open()
+        if not key:
+            raise SimulationError("empty key")
+        self._b().writes[key] = KVWrite(key, value)
+
+    def del_state(self, key: str) -> None:
+        self._check_open()
+        self._b().writes[key] = KVWrite(key, is_delete=True)
+
+    def get_state_by_range(self, start_key: str, end_key: str,
+                           limit: int = 0) -> List[Tuple[str, bytes]]:
+        """Records a RangeQueryInfo with raw reads; validation replays the
+        same scan at commit time (rangequery_validator.go, phantom reads)."""
+        self._check_open()
+        results = []
+        reads = []
+        exhausted = True
+        for key, vv in self._db.range_scan(self._ns, start_key, end_key):
+            if limit and len(results) >= limit:
+                exhausted = False
+                break
+            reads.append(KVRead(key, vv.version))
+            results.append((key, vv.value))
+        self._b().range_queries.append(RangeQueryInfo(
+            start_key, end_key, exhausted, tuple(reads)))
+        return results
+
+    def invoke_chaincode(self, chaincode_id: str, fn: str,
+                         args: List[bytes]) -> bytes:
+        """cc2cc invocation: the callee simulates into THIS rwset under its
+        own namespace (core/chaincode handler cc2cc semantics)."""
+        self._check_open()
+        if self._registry is None:
+            raise SimulationError("no chaincode registry for cc2cc")
+        return self._registry.invoke_into(self, chaincode_id, fn, args)
+
+    # -- result -------------------------------------------------------------
+
+    def rwset(self) -> TxRwSet:
+        self._done = True
+        ns_sets = []
+        for ns in sorted(self._builders):
+            b = self._builders[ns]
+            ns_sets.append(NsRwSet(
+                ns,
+                reads=tuple(b.reads[k] for k in sorted(b.reads)),
+                writes=tuple(b.writes[k] for k in sorted(b.writes)),
+                range_queries=tuple(b.range_queries)))
+        return TxRwSet(tuple(ns_sets))
+
+    def _check_open(self) -> None:
+        if self._done:
+            raise SimulationError("simulation already finalized")
+
+    # -- namespace-scoped view for cc2cc -----------------------------------
+
+    def scoped(self, namespace: str) -> "ChaincodeStub":
+        view = ChaincodeStub.__new__(ChaincodeStub)
+        view.__dict__.update(self.__dict__)
+        view._ns = namespace
+        return view

@@ -1,0 +1,115 @@
+"""The endorser service: ProcessProposal.
+
+Reference parity: core/endorser/endorser.go:296 ProcessProposal →
+:178 SimulateProposal → ESCC endorse (core/handlers/endorsement/builtin/
+default_endorsement.go:36), with the proposal-creator signature check from
+core/endorser/msgvalidation.go and the ACL check from core/aclmgmt.
+
+Signing stays host-side (private keys never touch the TPU); the single
+proposal-creator verify here is immediate, not batched — endorsement is a
+low-volume interactive path, unlike commit-side block validation.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from fabric_tpu.chaincode import ChaincodeRegistry, ChaincodeStub, SimulationError
+from fabric_tpu.endorser.proposal import (
+    Proposal,
+    ProposalResponse,
+    SignedProposal,
+)
+from fabric_tpu.ledger.statedb import StateDB
+from fabric_tpu.msp import SigningIdentity, deserialize_from_msps
+from fabric_tpu.policy import PolicyEvaluator, SignaturePolicy, SignedData
+from fabric_tpu.protocol.build import compute_txid, endorse
+from fabric_tpu.protocol.types import ChaincodeAction, TransactionAction
+
+logger = logging.getLogger("fabric_tpu.endorser")
+
+
+class EndorserError(Exception):
+    pass
+
+
+class Endorser:
+    """One peer's endorser service bound to a channel's state."""
+
+    def __init__(self, channel_id: str, db: StateDB,
+                 registry: ChaincodeRegistry,
+                 msps: Dict[str, object], provider,
+                 signer: SigningIdentity,
+                 proposal_acl: Optional[SignaturePolicy] = None):
+        self.channel_id = channel_id
+        self.db = db
+        self.registry = registry
+        self.msps = msps
+        self.signer = signer
+        self.proposal_acl = proposal_acl
+        self.evaluator = PolicyEvaluator(msps, provider)
+
+    def process_proposal(self, sp: SignedProposal) -> ProposalResponse:
+        """endorser.go:296.  Errors map to a non-200 response, never an
+        exception — the reference returns a ProposalResponse with an error
+        status to the client in all failure modes."""
+        try:
+            prop, creator = self._validate(sp)
+            status, payload, rwset = self._simulate(prop, creator)
+            if status != 200:
+                return ProposalResponse(status, payload.decode(), b"", None)
+            action = ChaincodeAction(
+                prop.chaincode_id,
+                self._version_of(prop.chaincode_id),
+                rwset, response_payload=payload)
+            ta = TransactionAction(prop.hash(), action)
+            endorsed = ta.endorsed_bytes()
+            # ESCC: sign endorsed-bytes || endorser identity
+            e = endorse(ta, self.signer)
+            return ProposalResponse(200, "", endorsed, e)
+        except (EndorserError, SimulationError) as err:
+            logger.info("[%s] proposal rejected: %s", self.channel_id, err)
+            return ProposalResponse(500, str(err), b"", None)
+
+    # -- validation (msgvalidation.go) --------------------------------------
+
+    def _validate(self, sp: SignedProposal):
+        try:
+            prop = sp.proposal()
+        except Exception as e:
+            raise EndorserError(f"undecodable proposal: {e}") from e
+        ch = prop.header.channel_header
+        sh = prop.header.signature_header
+        if ch.channel_id != self.channel_id:
+            raise EndorserError(
+                f"proposal for channel {ch.channel_id!r}, serving "
+                f"{self.channel_id!r}")
+        if ch.txid != compute_txid(sh.nonce, sh.creator):
+            raise EndorserError("txid does not bind nonce+creator")
+        creator = deserialize_from_msps(self.msps, sh.creator, validate=True)
+        if creator is None:
+            raise EndorserError("unknown or invalid creator identity")
+        if not creator.verify(sp.proposal_bytes, sp.signature):
+            raise EndorserError("bad proposal signature")
+        if self.proposal_acl is not None:
+            sd = SignedData(sp.proposal_bytes, sh.creator, sp.signature)
+            if not self.evaluator.evaluate_signed_data(self.proposal_acl, [sd]):
+                raise EndorserError("creator fails proposal ACL policy")
+        return prop, sh.creator
+
+    # -- simulation (endorser.go:178) ---------------------------------------
+
+    def _simulate(self, prop: Proposal, creator: bytes):
+        stub = ChaincodeStub(self.db, prop.chaincode_id,
+                             channel_id=self.channel_id,
+                             txid=prop.header.channel_header.txid,
+                             creator=creator, registry=self.registry)
+        status, payload = self.registry.execute(
+            stub, prop.chaincode_id, prop.fn, list(prop.args))
+        return status, payload, stub.rwset()
+
+    def _version_of(self, chaincode_id: str) -> str:
+        d = self.registry.definition(chaincode_id)
+        return d.version if d else "0"

@@ -176,14 +176,7 @@ class RaftChain(Chain):
         # if our ledger is shorter we must re-enter catch-up, else entries
         # after snap_index would land at wrong block numbers.
         if node.snap_data:
-            try:
-                state = self._serde.decode(node.snap_data)
-                if int(state.get("height", 0)) > self.writer.ledger.height:
-                    self._last_applied = max(self._last_applied,
-                                             int(state.get("raft_index", 0)))
-                    self.catchup_target = state
-            except ValueError:
-                pass  # snapshot from a raw node with opaque app state
+            self._maybe_enter_catchup(node.snap_data, fallback_index=0)
 
     def _recover_applied_index(self) -> int:
         lg = self.writer.ledger
@@ -307,8 +300,21 @@ class RaftChain(Chain):
         """A snapshot was installed: this node is behind the compacted log
         and must catch up its *ledger* from a peer (the reference's
         orderer/common/cluster/replication.go pull path)."""
-        state = self._serde.decode(e.data) if e.data else {}
-        self._last_applied = int(state.get("raft_index", e.index))
+        self._maybe_enter_catchup(e.data, fallback_index=e.index)
+
+    def _maybe_enter_catchup(self, state_bytes: bytes,
+                             fallback_index: int) -> None:
+        """Decode chain snapshot state; if the cluster ledger is ahead of
+        ours, enter catch-up.  Tolerates opaque/non-dict app state (raw
+        RaftNode snapshots) by doing nothing."""
+        try:
+            state = self._serde.decode(state_bytes) if state_bytes else {}
+        except ValueError:
+            return
+        if not isinstance(state, dict):
+            return
+        self._last_applied = max(
+            self._last_applied, int(state.get("raft_index", fallback_index)))
         if int(state.get("height", 0)) > self.writer.ledger.height:
             self.catchup_target = state
 

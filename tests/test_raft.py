@@ -266,6 +266,13 @@ class ChainNet(Net):
             for m in live:
                 self.chains[m.to].step(m)  # transports go through the chain
 
+    def tick_all(self, k=1):
+        for _ in range(k):
+            for nid, chain in self.chains.items():
+                if nid not in self.dropped:
+                    chain.tick()  # the clock goes through the chain too
+            self.pump()
+
 
 def chain_cluster(n=3, tmp=None, max_message_count=2, snapshot_interval=0):
     from fabric_tpu.ledger.blkstorage import BlockStore
