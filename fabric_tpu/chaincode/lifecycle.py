@@ -51,9 +51,10 @@ class LifecycleContract(Contract):
         raise SimulationError(f"unknown lifecycle function {fn!r}")
 
     def _approve(self, stub: ChaincodeStub, name: bytes, version: bytes,
-                 sequence: bytes, policy: bytes = b"",
-                 mspid: bytes = b"") -> bytes:
-        mspid_s = mspid.decode() or self._creator_mspid(stub)
+                 sequence: bytes, policy: bytes = b"") -> bytes:
+        # the approval is bound to the SUBMITTER's org — never an argument,
+        # or any org could forge the others' approvals
+        mspid_s = self._creator_mspid(stub)
         seq = int(sequence)
         stub.put_state(_approval_key(name.decode(), seq, mspid_s),
                        serde.encode({"version": version.decode(),

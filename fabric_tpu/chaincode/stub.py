@@ -37,7 +37,10 @@ class ChaincodeStub:
     """One transaction's simulation context over committed state.
 
     Reads record the committed version (for MVCC); writes stage in the
-    rwset and are read-your-own-writes within this simulation only.
+    rwset.  get_state sees the simulation's own staged writes;
+    get_state_by_range reads COMMITTED state only — same limitation as
+    the reference simulator, whose range/rich queries never reflect the
+    transaction's own uncommitted writes.
     """
 
     def __init__(self, db: StateDB, namespace: str,
@@ -82,7 +85,9 @@ class ChaincodeStub:
     def get_state_by_range(self, start_key: str, end_key: str,
                            limit: int = 0) -> List[Tuple[str, bytes]]:
         """Records a RangeQueryInfo with raw reads; validation replays the
-        same scan at commit time (rangequery_validator.go, phantom reads)."""
+        same scan at commit time (rangequery_validator.go, phantom reads).
+        Committed state only — this simulation's staged writes are NOT
+        visible to range scans (reference simulator limitation kept)."""
         self._check_open()
         results = []
         reads = []

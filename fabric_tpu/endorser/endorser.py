@@ -57,9 +57,7 @@ class Endorser:
         status to the client in all failure modes."""
         try:
             prop, creator = self._validate(sp)
-            status, payload, rwset = self._simulate(prop, creator)
-            if status != 200:
-                return ProposalResponse(status, payload.decode(), b"", None)
+            payload, rwset = self._simulate(prop, creator)
             action = ChaincodeAction(
                 prop.chaincode_id,
                 self._version_of(prop.chaincode_id),
@@ -72,6 +70,12 @@ class Endorser:
         except (EndorserError, SimulationError) as err:
             logger.info("[%s] proposal rejected: %s", self.channel_id, err)
             return ProposalResponse(500, str(err), b"", None)
+        except Exception as err:
+            # malformed wire input (e.g. non-bytes header fields) must not
+            # crash the request path — the contract is response, not raise
+            logger.warning("[%s] proposal processing error: %s",
+                           self.channel_id, err)
+            return ProposalResponse(500, f"internal error: {err}", b"", None)
 
     # -- validation (msgvalidation.go) --------------------------------------
 
@@ -106,9 +110,9 @@ class Endorser:
                              channel_id=self.channel_id,
                              txid=prop.header.channel_header.txid,
                              creator=creator, registry=self.registry)
-        status, payload = self.registry.execute(
+        _, payload = self.registry.execute(
             stub, prop.chaincode_id, prop.fn, list(prop.args))
-        return status, payload, stub.rwset()
+        return payload, stub.rwset()
 
     def _version_of(self, chaincode_id: str) -> str:
         d = self.registry.definition(chaincode_id)

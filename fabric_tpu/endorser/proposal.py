@@ -98,9 +98,17 @@ def assemble_transaction(sp: SignedProposal,
     must match bit-for-bit; the envelope reuses the proposal's nonce so
     txid stays bound to the original proposal."""
     prop = sp.proposal()
-    ok = [r for r in responses if r.status == 200]
+    bad = [r for r in responses if r.status != 200]
+    if bad:
+        # any failed response aborts client-side (CreateSignedTx rejects
+        # non-200): submitting under-endorsed txs burns ordering work just
+        # to fail policy at commit
+        raise ResponseMismatchError(
+            f"{len(bad)}/{len(responses)} endorsers failed: "
+            f"{bad[0].message!r}")
+    ok = list(responses)
     if not ok:
-        raise ResponseMismatchError("no successful proposal responses")
+        raise ResponseMismatchError("no proposal responses")
     payloads = {r.payload for r in ok}
     if len(payloads) != 1:
         raise ResponseMismatchError(

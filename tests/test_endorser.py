@@ -286,3 +286,40 @@ def test_read_your_writes_and_version_pinning(world):
     ns = rw.ns_rwsets[0]
     assert ns.reads[0].key == "p" and ns.reads[0].version is not None
     assert ns.writes[0].value == b"2"
+
+
+def test_lifecycle_approval_cannot_be_forged(world):
+    """An extra arg to approve_for_org must NOT let one org record
+    another org's approval (approvals bind to the submitter's MSP)."""
+    pol = b""
+    client = world.orgs[0].new_identity("mallory")  # Org1
+    for forged_org in (b"Org2", b"Org1"):
+        sp = signed_proposal("ch", LIFECYCLE_NS, "approve_for_org",
+                             [b"victim", b"1.0", b"1", pol, forged_org],
+                             client)
+        resp = world.endorsers[0].process_proposal(sp)
+        assert resp.status == 500  # extra arg rejected outright
+
+
+def test_malformed_proposal_returns_500_not_crash(world):
+    from fabric_tpu.endorser.proposal import SignedProposal
+    from fabric_tpu.utils import serde
+    # header with a non-bytes nonce: compute_txid would TypeError
+    raw = serde.encode({
+        "header": {"channel_header": {"type": "endorser_transaction",
+                                      "channel_id": "ch", "txid": "x",
+                                      "epoch": 0, "timestamp": 0},
+                   "signature_header": {"creator": b"junk", "nonce": 7}},
+        "chaincode_id": "cc", "fn": "put", "args": []})
+    resp = world.endorsers[0].process_proposal(SignedProposal(raw, b"sig"))
+    assert resp.status == 500
+
+
+def test_all_endorsers_must_succeed(world):
+    """A single failed response aborts assembly client-side."""
+    sp = signed_proposal("ch", "cc", "get", [b"never-set-key"], world.client)
+    good = ProposalResponse(200, "", b"x", None)
+    bad = world.endorsers[0].process_proposal(sp)
+    assert bad.status == 500
+    with pytest.raises(ResponseMismatchError):
+        assemble_transaction(sp, [good, bad], world.client)
