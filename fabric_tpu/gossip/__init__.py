@@ -1,0 +1,26 @@
+"""Gossip plane: membership, election, state transfer, delivery.
+
+Reference parity (SURVEY.md §2 "Gossip / data dissemination"):
+  gossip/comm        -> comm.InProcTransport / comm.TcpTransport
+  gossip/discovery   -> discovery.Discovery (alive msgs, expiry)
+  gossip/election    -> election.LeaderElection
+  gossip/state       -> state.GossipState (payload buffer + anti-entropy)
+  internal/peer/gossip/mcs.go -> mcs.MessageCryptoService
+  blocksprovider/deliveryclient -> blocksprovider.BlocksProvider
+
+TPU-native notes: block dissemination fan-out stays host-side (network
+I/O), but every signature the plane checks — orderer block signatures and
+peer message signatures — is emitted as batchable VerifyItems so a node
+verifies a whole catch-up window in one TPU dispatch
+(blocksprovider.verify_window)."""
+
+from .comm import InProcNetwork, TcpTransport
+from .discovery import Discovery, Peer
+from .election import LeaderElection
+from .mcs import MessageCryptoService
+from .state import GossipState
+from .blocksprovider import BlocksProvider
+
+__all__ = ["InProcNetwork", "TcpTransport", "Discovery", "Peer",
+           "LeaderElection", "MessageCryptoService", "GossipState",
+           "BlocksProvider"]

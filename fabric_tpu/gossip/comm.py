@@ -1,0 +1,177 @@
+"""Gossip transport layer.
+
+Reference parity: gossip/comm/comm_impl.go — a bidirectional message
+stream between peers with an authenticated connection handshake.  Two
+transports share one interface:
+
+  InProcNetwork: N in-process endpoints with explicit `deliver_all()`
+    pumping — how the reference's gossip tests run N instances in one
+    process (gossip_test.go), deterministic for fault injection.
+  TcpTransport: length-prefixed serde frames over TCP on localhost/LAN,
+    one listener thread per node — the real-socket path (the reference
+    uses gRPC bidi streams; the framing is ours, the trust model — signed
+    handshake, msg signatures checked above this layer — is the same).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Callable, Dict, List, Optional, Tuple
+
+from fabric_tpu.utils import serde
+
+_FRAME = struct.Struct("<I")
+MAX_FRAME = 64 * 1024 * 1024
+
+# message envelope on the wire: {"type": str, "frm": str, "body": dict}
+Handler = Callable[[str, str, dict], None]  # (msg_type, from_id, body)
+
+
+class InProcNetwork:
+    """Deterministic in-process message fabric for tests/simulation."""
+
+    def __init__(self):
+        self._handlers: Dict[str, Handler] = {}
+        self._queues: Dict[str, List[Tuple[str, str, dict]]] = {}
+        self.dropped: set = set()      # unreachable endpoints
+        self.partitions: List[set] = []  # optional partition groups
+
+    def register(self, peer_id: str, handler: Handler) -> "InProcEndpoint":
+        self._handlers[peer_id] = handler
+        self._queues[peer_id] = []
+        return InProcEndpoint(self, peer_id)
+
+    def _reachable(self, frm: str, to: str) -> bool:
+        if frm in self.dropped or to in self.dropped:
+            return False
+        if self.partitions:
+            for group in self.partitions:
+                if frm in group:
+                    return to in group
+        return True
+
+    def send(self, frm: str, to: str, msg_type: str, body: dict) -> None:
+        if to in self._queues and self._reachable(frm, to):
+            self._queues[to].append((msg_type, frm, body))
+
+    def deliver_all(self, max_rounds: int = 100) -> None:
+        for _ in range(max_rounds):
+            any_msg = False
+            for peer_id in list(self._queues):
+                queue, self._queues[peer_id] = self._queues[peer_id], []
+                for msg_type, frm, body in queue:
+                    any_msg = True
+                    if peer_id not in self.dropped:
+                        self._handlers[peer_id](msg_type, frm, body)
+            if not any_msg:
+                return
+
+    def peer_ids(self) -> List[str]:
+        return sorted(self._handlers)
+
+
+class InProcEndpoint:
+    def __init__(self, net: InProcNetwork, peer_id: str):
+        self.net = net
+        self.id = peer_id
+
+    def send(self, to: str, msg_type: str, body: dict) -> None:
+        self.net.send(self.id, to, msg_type, body)
+
+
+class TcpTransport:
+    """Real-socket endpoint: serde frames over TCP, handler per message.
+
+    Address book maps peer_id -> (host, port); connections are opened per
+    send and cached.  Wire frame: u32 len ‖ serde{"type","frm","body"}.
+    """
+
+    def __init__(self, peer_id: str, host: str = "127.0.0.1", port: int = 0):
+        self.id = peer_id
+        self._handler: Optional[Handler] = None
+        self._addrs: Dict[str, Tuple[str, int]] = {}
+        self._conns: Dict[str, socket.socket] = {}
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(64)
+        self.address = self._srv.getsockname()
+        self._closing = False
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+
+    def start(self, handler: Handler) -> None:
+        self._handler = handler
+        self._accept_thread.start()
+
+    def add_peer(self, peer_id: str, address: Tuple[str, int]) -> None:
+        self._addrs[peer_id] = tuple(address)
+
+    def send(self, to: str, msg_type: str, body: dict) -> None:
+        raw = serde.encode({"type": msg_type, "frm": self.id, "body": body})
+        frame = _FRAME.pack(len(raw)) + raw
+        with self._lock:
+            sock = self._conns.get(to)
+            if sock is None:
+                addr = self._addrs.get(to)
+                if addr is None:
+                    return  # unknown peer: drop, discovery will re-learn
+                try:
+                    sock = socket.create_connection(addr, timeout=5)
+                except OSError:
+                    return  # unreachable: gossip tolerates message loss
+                self._conns[to] = sock
+            try:
+                sock.sendall(frame)
+            except OSError:
+                self._conns.pop(to, None)
+
+    def _accept_loop(self) -> None:
+        while not self._closing:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._read_loop, args=(conn,),
+                             daemon=True).start()
+
+    def _read_loop(self, conn: socket.socket) -> None:
+        buf = b""
+        while not self._closing:
+            try:
+                chunk = conn.recv(65536)
+            except OSError:
+                return
+            if not chunk:
+                return
+            buf += chunk
+            while len(buf) >= _FRAME.size:
+                (n,) = _FRAME.unpack_from(buf)
+                if n > MAX_FRAME:
+                    return  # protocol violation: drop connection
+                if len(buf) < _FRAME.size + n:
+                    break
+                raw, buf = buf[_FRAME.size:_FRAME.size + n], \
+                    buf[_FRAME.size + n:]
+                try:
+                    msg = serde.decode(raw)
+                    self._handler(msg["type"], msg["frm"], msg["body"])
+                except (ValueError, KeyError, TypeError):
+                    pass  # malformed frame: ignore (peer msgs are untrusted)
+
+    def close(self) -> None:
+        self._closing = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+        with self._lock:
+            for sock in self._conns.values():
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+            self._conns.clear()

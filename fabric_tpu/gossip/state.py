@@ -1,0 +1,120 @@
+"""Gossip state transfer: ordered block delivery + anti-entropy.
+
+Reference parity: gossip/state/state.go — deliverPayloads (:547) drains
+an out-of-order payload buffer strictly in block order into the
+committer (commitBlock :781 -> coordinator.StoreBlock), and antiEntropy
+(:591) asks peers for the [our_height, their_height) range when gaps
+persist.  Block payloads arriving via gossip are MCS-verified before
+buffering.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional
+
+from fabric_tpu.protocol import Block
+
+logger = logging.getLogger("fabric_tpu.gossip.state")
+
+MSG_BLOCK = "gossip.block"
+MSG_STATE_REQ = "gossip.state_req"
+MSG_STATE_RESP = "gossip.state_resp"
+
+MAX_BUFFER = 256          # payload buffer cap (state.go buffer size role)
+MAX_RANGE_PER_REQ = 32    # anti-entropy batch (state.go defAntiEntropyBatchSize)
+
+
+class GossipState:
+    """One channel's block intake: buffer -> verify -> commit in order."""
+
+    def __init__(self, endpoint, discovery, committer, mcs=None,
+                 fanout: int = 3):
+        self.endpoint = endpoint
+        self.discovery = discovery
+        self.committer = committer  # needs .height and .store_block(block)
+        self.mcs = mcs
+        self.fanout = fanout
+        self._buffer: Dict[int, Block] = {}
+
+    # -- intake -------------------------------------------------------------
+
+    def add_block(self, block: Block, gossip: bool = True) -> None:
+        """Local intake from the deliver client (leader peer); optionally
+        fan out to other peers."""
+        self._buffer_block(block)
+        if gossip:
+            self._gossip_block(block)
+        self._drain()
+
+    def handle(self, msg_type: str, frm: str, body: dict) -> None:
+        if msg_type == MSG_BLOCK:
+            self._on_block_msg(body)
+        elif msg_type == MSG_STATE_REQ:
+            self._on_state_req(frm, body)
+        elif msg_type == MSG_STATE_RESP:
+            for raw in body.get("blocks", []):
+                self._on_block_msg({"block": raw})
+        self._drain()
+
+    def _on_block_msg(self, body: dict) -> None:
+        try:
+            block = Block.deserialize(body["block"])
+        except (KeyError, ValueError, TypeError):
+            return
+        if self.mcs is not None and not self.mcs.verify_block(block):
+            logger.warning("rejected gossiped block %s: bad orderer sig",
+                           getattr(block.header, "number", "?"))
+            return
+        self._buffer_block(block)
+
+    def _buffer_block(self, block: Block) -> None:
+        num = block.header.number
+        if num < self.committer.height or len(self._buffer) >= MAX_BUFFER:
+            return
+        self._buffer[num] = block
+
+    def _gossip_block(self, block: Block) -> None:
+        raw = block.serialize()
+        for to in self.discovery.alive_ids()[:self.fanout]:
+            self.endpoint.send(to, MSG_BLOCK, {"block": raw})
+
+    # -- ordered drain into the committer (deliverPayloads) ------------------
+
+    def _drain(self) -> None:
+        while self.committer.height in self._buffer:
+            block = self._buffer.pop(self.committer.height)
+            self.committer.store_block(block)
+
+    # -- anti-entropy (state.go:591) -----------------------------------------
+
+    def anti_entropy_tick(self) -> None:
+        """If we have buffered blocks ahead of a gap (or just suspect
+        lag), ask a random-ish alive peer for the missing range."""
+        height = self.committer.height
+        want_upto = max(self._buffer) + 1 if self._buffer else height
+        peers = self.discovery.alive_ids()
+        if not peers:
+            return
+        # ask even when no gap is visible — peers answer with their tip
+        to = peers[height % len(peers)]
+        self.endpoint.send(to, MSG_STATE_REQ,
+                           {"from": height,
+                            "to": max(want_upto, height + MAX_RANGE_PER_REQ)})
+
+    def _on_state_req(self, frm: str, body: dict) -> None:
+        try:
+            start = int(body["from"])
+            stop = min(int(body["to"]), start + MAX_RANGE_PER_REQ)
+        except (KeyError, TypeError, ValueError):
+            return
+        blocks = []
+        store = self.committer.ledger.blockstore
+        for num in range(start, min(stop, store.height)):
+            blocks.append(store.get_by_number(num).serialize())
+        if blocks:
+            self.endpoint.send(frm, MSG_STATE_RESP, {"blocks": blocks})
+
+    @property
+    def buffered(self) -> List[int]:
+        return sorted(self._buffer)
