@@ -70,8 +70,16 @@ class GossipState:
 
     def _buffer_block(self, block: Block) -> None:
         num = block.header.number
-        if num < self.committer.height or len(self._buffer) >= MAX_BUFFER:
+        if num < self.committer.height or num in self._buffer:
             return
+        if len(self._buffer) >= MAX_BUFFER:
+            # full: never drop the immediately-drainable block — evict the
+            # highest buffered number instead (anti-entropy re-fetches it),
+            # so far-future blocks cannot wedge the buffer.
+            evict = max(self._buffer)
+            if num >= evict:
+                return
+            del self._buffer[evict]
         self._buffer[num] = block
 
     def _gossip_block(self, block: Block) -> None:

@@ -230,7 +230,13 @@ class RaftChain(Chain):
             self._batch_deadline = None
             if not batch:
                 return False
-            self._propose(batch, is_config=False)
+            from fabric_tpu.orderer import raft as raftmod
+            try:
+                self._propose(batch, is_config=False)
+            except raftmod.NotLeaderError:
+                # deposed between the deadline being set and firing: the
+                # batch is discarded (clients retry against the new leader)
+                return False
             return True
 
     def halt(self) -> None:
@@ -268,6 +274,13 @@ class RaftChain(Chain):
         from fabric_tpu.orderer import raft as raftmod
         with self._lock:
             r = self.node.take_ready()
+            if r.lost_leadership:
+                # discard the pending batch and stop the batch timer
+                # (reference etcdraft chain.go:604-607 becomeFollower):
+                # stale envelopes must not be proposed if leadership is
+                # later regained, and the timer path must not fire.
+                self.cutter.cut()
+                self._batch_deadline = None
             for e in r.committed:
                 if e.kind == raftmod.ENTRY_SNAPSHOT:
                     self._on_snapshot_entry(e)
@@ -289,6 +302,10 @@ class RaftChain(Chain):
             return
         if entry.index <= self._last_applied:
             return  # replayed on restart; ledger already has the block
+        if not entry.data:
+            # leader-change no-op entry (raft _become_leader): no block
+            self._last_applied = entry.index
+            return
         d = self._serde.decode(entry.data)
         block = self.writer.create_next_block(d["batch"])
         block.metadata.items[META_RAFT_INDEX] = entry.index

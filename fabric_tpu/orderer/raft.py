@@ -428,9 +428,17 @@ class RaftNode:
         self._elapsed = 0
         self.next_index = {n: self.last_index() + 1 for n in self.nodes}
         self.match_index = {n: 0 for n in self.nodes}
-        self.match_index[self.id] = self.last_index()
         self._ready.became_leader = True
+        # Append an empty entry for the new term (etcd/raft becomeLeader):
+        # without it, the §5.4.2 current-term commit guard in _maybe_commit
+        # would leave a deposed leader's replicated entries uncommitted
+        # until new client traffic arrives — stalling idle channels.
+        e = Entry(self.term, self.last_index() + 1, b"", ENTRY_NORMAL)
+        self.log.append(e)
+        self._persist_entries([e])
+        self.match_index[self.id] = e.index
         self._broadcast_append()
+        self._maybe_commit()  # single-node cluster commits immediately
 
     def _quorum(self, count: int) -> bool:
         return count > len(self.nodes) // 2

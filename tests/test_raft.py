@@ -31,7 +31,8 @@ class Net:
             for n in self.nodes.values():
                 r = n.take_ready()
                 self.committed[n.id].extend(
-                    e for e in r.committed if e.kind == ENTRY_NORMAL)
+                    e for e in r.committed
+                    if e.kind == ENTRY_NORMAL and e.data)
                 n.maybe_compact()  # post-apply, like the chain run loop
                 msgs.extend(r.messages)
             live = [m for m in msgs
