@@ -1,9 +1,20 @@
 """Headline benchmark: batched ECDSA-P256 signature verification on TPU.
 
-Driver metric (BASELINE.json): sig-verifies/sec vs the CPU software provider
-(the reference's bccsp/sw path, /root/reference/bccsp/sw/ecdsa.go:41 — here
-approximated by OpenSSL via `cryptography`, which is *faster* than Go's
+Driver metric (BASELINE.json): sig-verifies/sec + block-validation p50
+latency (10k-tx block, 3 endorsers) vs the CPU software provider (the
+reference's bccsp/sw path, /root/reference/bccsp/sw/ecdsa.go:41 —
+approximated by OpenSSL via `cryptography`, which is faster than Go's
 crypto/ecdsa, making the comparison conservative).
+
+Round-2 honesty upgrades (VERDICT.md weak #2/#7):
+  - reports BOTH baselines: single-core OpenSSL and all-core OpenSSL
+    (process pool, mirroring validatorPoolSize = NumCPU,
+    /root/reference/core/peer/config.go:251-253); vs_baseline keeps the
+    round-1 definition (single-core) and vs_allcore is reported alongside;
+  - measures p50 block-validation latency through the actual
+    verify-then-gate pipeline (10k txs x (1 creator + 3 endorsement) sigs);
+  - enables the persistent compilation cache and warms the kernel before
+    timing (first-dispatch latency reported separately).
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -13,12 +24,17 @@ from __future__ import annotations
 
 import hashlib
 import json
+import multiprocessing
 import os
 import random
+import statistics
 import sys
 import time
 
 import numpy as np
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/fabric_tpu_xla"))
 
 
 def gen_cases(n_distinct: int, n_keys: int = 8):
@@ -43,13 +59,12 @@ def gen_cases(n_distinct: int, n_keys: int = 8):
     return cases
 
 
-def bench_cpu_openssl(cases, seconds: float = 2.0) -> float:
-    """OpenSSL ECDSA-P256 verifies/sec on this host (the SW-provider stand-in)."""
+def _cpu_worker(args):
+    der_sigs, seconds = args
     from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.asymmetric.utils import encode_dss_signature
+    from cryptography.hazmat.primitives.serialization import load_der_public_key
     from cryptography.hazmat.primitives import hashes
-
-    sigs = [(c[5], encode_dss_signature(c[2], c[3]), c[6]) for c in cases]
+    sigs = [(load_der_public_key(pk), sig, msg) for pk, sig, msg in der_sigs]
     n = 0
     t0 = time.perf_counter()
     while time.perf_counter() - t0 < seconds:
@@ -57,6 +72,21 @@ def bench_cpu_openssl(cases, seconds: float = 2.0) -> float:
         pub.verify(sig, msg, ec.ECDSA(hashes.SHA256()))
         n += 1
     return n / (time.perf_counter() - t0)
+
+
+def bench_cpu_openssl(cases, seconds: float = 2.0, procs: int = 1) -> float:
+    """OpenSSL ECDSA-P256 verifies/sec across `procs` processes."""
+    from cryptography.hazmat.primitives.asymmetric.utils import encode_dss_signature
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat)
+
+    der = [(c[5].public_bytes(Encoding.DER, PublicFormat.SubjectPublicKeyInfo),
+            encode_dss_signature(c[2], c[3]), c[6]) for c in cases]
+    if procs == 1:
+        return _cpu_worker((der, seconds))
+    with multiprocessing.Pool(procs) as pool:
+        rates = pool.map(_cpu_worker, [(der, seconds)] * procs)
+    return sum(rates)
 
 
 def bench_tpu(cases, batch: int, iters: int = 5):
@@ -67,37 +97,115 @@ def bench_tpu(cases, batch: int, iters: int = 5):
     tiled = (cases * reps)[:batch]
     qx, qy, r, s, e, _, _ = zip(*tiled)
     args = [p256.ints_to_words(list(v)) for v in (qx, qy, r, s, e)]
-    fn = jax.jit(p256.verify_words)
+
+    if jax.default_backend() == "cpu":
+        from fabric_tpu.ops import ecp256
+        fn = lambda *a: ecp256.verify_words_xla(*a)
+    elif os.environ.get("FABRIC_TPU_PALLAS") == "1":
+        from fabric_tpu.ops import p256_pallas
+        fn = lambda *a: p256_pallas.verify_words(*a)
+    else:
+        from fabric_tpu.ops import bignum as bn, ecp256
+        tab = ecp256.comb_table_f32()
+        jf = jax.jit(ecp256.verify_body, static_argnames=("require_low_s",))
+
+        def fn(*a):
+            limbs = [bn.words_be_to_limbs(v) for v in a]
+            return jf(*limbs, tab, require_low_s=True)
+
     t0 = time.perf_counter()
     out = fn(*args)
-    out.block_until_ready()
+    jax.block_until_ready(out)
     compile_and_first = time.perf_counter() - t0
     assert bool(np.asarray(out).all()), "benchmark signatures must all verify"
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-    out.block_until_ready()
+    jax.block_until_ready(out)
     dt = (time.perf_counter() - t0) / iters
     return batch / dt, dt, compile_and_first
 
 
+def bench_block_p50(provider, n_tx: int = 10000, endorsers: int = 3,
+                    reps: int = 3):
+    """p50 latency of the verify-then-gate block pipeline.
+
+    Measurement point parity: TxValidator.Validate wall time
+    (/root/reference/core/committer/txvalidator/v20/validator.go:262-263),
+    here fabric_tpu TxValidator.validate over one n_tx-transaction block
+    with 1 creator + `endorsers` endorsement signatures per tx.
+    """
+    from fabric_tpu.committer.txvalidator import PolicyRegistry, TxValidator
+    from fabric_tpu.msp import CachedMSP
+    from fabric_tpu.msp.ca import DevOrg
+    from fabric_tpu.policy import parse_policy
+    from fabric_tpu.protocol import KVWrite, NsRwSet, TxRwSet, build
+
+    org = DevOrg("BenchOrg")
+    msps = {"BenchOrg": CachedMSP(org.msp())}
+    creator = org.new_identity("client")
+    endorser_ids = [org.new_identity(f"e{i}") for i in range(endorsers)]
+    envs = []
+    for i in range(n_tx):
+        rwset = TxRwSet((NsRwSet("cc", writes=(
+            KVWrite(f"k{i}", b"v"),)),))
+        envs.append(build.endorser_tx("bench", "cc", "1.0", rwset,
+                                      creator, endorser_ids).serialize())
+    blk = build.new_block(1, b"prev", envs)
+    policy = parse_policy(
+        "OutOf(%d%s)" % (endorsers,
+                         "".join(f", 'BenchOrg.member'"
+                                 for _ in range(endorsers))))
+    registry = PolicyRegistry(default=policy)
+    validator = TxValidator("bench", msps, provider, registry)
+    times = []
+    for _ in range(reps + 1):
+        t0 = time.perf_counter()
+        vr = validator.validate(blk)
+        times.append(time.perf_counter() - t0)
+    times = times[1:]  # drop the compile/warmup rep
+    return statistics.median(times), vr
+
+
 def main():
     batch = int(os.environ.get("BENCH_BATCH", "16384"))
+    ncpu = os.cpu_count() or 1
     cases = gen_cases(256)
-    cpu_rate = bench_cpu_openssl(cases)
+    cpu_rate_1 = bench_cpu_openssl(cases, procs=1)
+    cpu_rate_all = bench_cpu_openssl(cases, seconds=1.0, procs=ncpu)
     tpu_rate, step_s, compile_s = bench_tpu(cases, batch)
+
+    detail = {
+        "batch": batch,
+        "tpu_step_ms": round(step_s * 1e3, 2),
+        "cpu_openssl_1core_sigs_per_sec": round(cpu_rate_1, 1),
+        "cpu_openssl_allcore_sigs_per_sec": round(cpu_rate_all, 1),
+        "cpu_cores": ncpu,
+        "vs_allcore": round(tpu_rate / cpu_rate_all, 2),
+        "compile_plus_first_s": round(compile_s, 2),
+        "device": str(__import__("jax").devices()[0]),
+        "kernel": ("pallas" if os.environ.get("FABRIC_TPU_PALLAS") == "1"
+                   else "xla-windowed"),
+    }
+
+    if os.environ.get("BENCH_SKIP_BLOCK") != "1":
+        try:
+            from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+            provider = init_factories(FactoryOpts(default="JAXTPU"))
+            n_tx = int(os.environ.get("BENCH_BLOCK_TXS", "10000"))
+            p50, vr = bench_block_p50(provider, n_tx=n_tx)
+            detail["block_p50_s"] = round(p50, 3)
+            detail["block_txs"] = n_tx
+            detail["block_sigs"] = n_tx * 4
+        except Exception as exc:  # keep the headline number robust
+            detail["block_p50_error"] = str(exc)[:200]
+
     result = {
         "metric": "ecdsa_p256_sig_verifies_per_sec",
         "value": round(tpu_rate, 1),
         "unit": "sigs/s",
-        "vs_baseline": round(tpu_rate / cpu_rate, 2),
-        "detail": {
-            "batch": batch,
-            "tpu_step_ms": round(step_s * 1e3, 2),
-            "cpu_openssl_sigs_per_sec": round(cpu_rate, 1),
-            "compile_plus_first_s": round(compile_s, 2),
-            "device": str(__import__("jax").devices()[0]),
-        },
+        "vs_baseline": round(tpu_rate / cpu_rate_1, 2),
+        "detail": detail,
     }
     print(json.dumps(result))
 

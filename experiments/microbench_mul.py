@@ -1,0 +1,206 @@
+"""Microbenchmarks to locate the field-mul bottleneck on TPU v5e.
+
+Compares:
+  1. current scan-CIOS Montgomery mul (bignum.Mont.mul)
+  2. fully parallel schoolbook (int32, 12-bit limbs) + separated reduction
+  3. f32 schoolbook with 8-bit limbs (VPU FMA rate probe)
+  4. raw VPU int32 vs f32 multiply throughput
+  5. MXU int8 constant-matmul rate ((B,32)@(32,64))
+"""
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+from fabric_tpu.ops import bignum as bn
+
+B = 16384
+ITERS = 20
+
+
+def timeit(fn, *args, iters=ITERS):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+P256 = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+mont = bn.Mont(P256, "p")
+
+rng = np.random.default_rng(0)
+vals = [int.from_bytes(rng.bytes(32), "big") % P256 for _ in range(B)]
+a_np = bn.ints_to_limbs(vals)
+b_np = bn.ints_to_limbs(vals[::-1])
+a = jnp.asarray(a_np)
+b = jnp.asarray(b_np)
+
+
+# --- 1. current scan CIOS ---
+@jax.jit
+def cur_mul(a, b):
+    x = a
+    for _ in range(8):  # chain 8 muls to amortize dispatch
+        x = mont.mul(x, b)
+    return x
+
+t = timeit(cur_mul, a, b)
+print(f"scan-CIOS mul: {t/8*1e6:.1f} us/mul  ({B/(t/8)/1e9:.2f} G modmul/s)")
+
+
+# --- 2. parallel schoolbook int32 + separated Montgomery reduction ---
+L = bn.N_LIMBS  # 22
+MASK = bn.LIMB_MASK
+p_l = np.asarray(bn.int_to_limbs(P256), dtype=np.int32)
+R = 1 << (L * 12)
+pinv = (-pow(P256, -1, R)) % R
+pinv_l = np.asarray(bn.int_to_limbs(pinv), dtype=np.int32)
+
+
+def wide_mul(a, b, nb=L):
+    # out[k] = sum_{i+j=k} a_i*b_j ; a is (L,B), b (nb,B) or (nb,1)
+    rows = []
+    for i in range(a.shape[0]):
+        rows.append(a[i][None, :] * b)  # (nb, B)
+    # pad rows into (L+nb, B)
+    tot = a.shape[0] + b.shape[0]
+    out = jnp.zeros((tot,) + a.shape[1:], jnp.int32)
+    for i, r in enumerate(rows):
+        out = out.at[i:i + b.shape[0]].add(r)
+    return out
+
+
+def wide_mul2(a, b):
+    # alternative: einsum into (i,j,B) then shift-sum via padding
+    tt = a[:, None, :] * b[None, :, :]  # (L, nb, B)
+    nb = b.shape[0]
+    cols = []
+    for i in range(a.shape[0]):
+        cols.append(jnp.pad(tt[i], ((i, a.shape[0] + nb - nb - i), (0, 0))))
+    return functools.reduce(jnp.add, cols)
+
+
+def carry_scan(x, n_out):
+    return bn.carry_prop(x, n_out)
+
+
+def pmul(a, b):
+    t = wide_mul2(a, b)                     # (44,B)-ish limbs < 2^29
+    t_lo = carry_scan(t[:L], L + 1)         # carries beyond kept
+    # m = t_lo * pinv mod R  (low L limbs)
+    m_w = wide_mul2(t_lo[:L], jnp.asarray(pinv_l)[:, None] + jnp.zeros_like(t_lo[:L]))
+    m = carry_scan(m_w[:L], L)              # truncated mod R (approx; test only)
+    u = t + wide_mul2(m, jnp.asarray(p_l)[:, None] + jnp.zeros_like(m))[:t.shape[0]]
+    u_c = carry_scan(u, t.shape[0] + 1)
+    return u_c[L:L + L]
+
+
+@jax.jit
+def par_mul(a, b):
+    x = a
+    for _ in range(8):
+        x = pmul(x, b)
+    return x
+
+t = timeit(par_mul, a, b)
+print(f"parallel int32 schoolbook: {t/8*1e6:.1f} us/mul  ({B/(t/8)/1e9:.2f} G modmul/s)")
+
+
+# --- 3. f32 8-bit-limb schoolbook (33 limbs) wide mul only ---
+L8 = 33
+af = jnp.asarray(rng.integers(0, 256, (L8, B)), jnp.float32)
+bf = jnp.asarray(rng.integers(0, 256, (L8, B)), jnp.float32)
+
+
+def wide_mul_f32(a, b):
+    tt = a[:, None, :] * b[None, :, :]
+    cols = []
+    for i in range(L8):
+        cols.append(jnp.pad(tt[i], ((i, L8 - i), (0, 0))))
+    return functools.reduce(jnp.add, cols)
+
+
+@jax.jit
+def f32_mul(a, b):
+    x = a
+    for _ in range(8):
+        x = wide_mul_f32(x, b)[:L8] % 256.0
+    return x
+
+t = timeit(f32_mul, af, bf)
+print(f"f32 schoolbook wide-mul (33 limbs, no reduction): {t/8*1e6:.1f} us/mul ({B/(t/8)/1e9:.2f} G/s)")
+
+
+# --- int32 wide mul only (no reduction) for direct comparison ---
+@jax.jit
+def i32_widemul(a, b):
+    x = a
+    for _ in range(8):
+        x = wide_mul2(x, b)[:L] & MASK
+    return x
+
+t = timeit(i32_widemul, a, b)
+print(f"int32 schoolbook wide-mul only (22 limbs): {t/8*1e6:.1f} us/mul ({B/(t/8)/1e9:.2f} G/s)")
+
+
+# --- 4. raw VPU rates ---
+x32 = jnp.asarray(rng.integers(0, 1 << 20, (1024, B)), jnp.int32)
+xf = x32.astype(jnp.float32)
+
+
+@jax.jit
+def raw_i32(x):
+    for _ in range(64):
+        x = x * x & 0xFFFFF
+    return x
+
+
+@jax.jit
+def raw_f32(x):
+    for _ in range(64):
+        x = x * 1.000001 + 0.5
+    return x
+
+t = timeit(raw_i32, x32)
+ops = 64 * 1024 * B
+print(f"raw int32 mul: {ops/t/1e12:.2f} T op/s")
+t = timeit(raw_f32, xf)
+print(f"raw f32 fma:  {ops/t/1e12:.2f} T op/s")
+
+# --- 5. MXU int8 constant matmul ---
+a8 = jnp.asarray(rng.integers(-127, 127, (B, 64)), jnp.int8)
+w8 = jnp.asarray(rng.integers(-127, 127, (64, 128)), jnp.int8)
+
+
+@jax.jit
+def mxu_i8(a, w):
+    x = a
+    out = jnp.zeros((B, 128), jnp.int32)
+    for _ in range(32):
+        out = out + lax.dot_general(x, w, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.int32)
+    return out
+
+t = timeit(mxu_i8, a8, w8)
+ops = 32 * B * 64 * 128 * 2
+print(f"MXU int8 (B,64)@(64,128): {ops/t/1e12:.2f} T op/s")
+
+# bf16 for reference
+abf = jnp.asarray(rng.standard_normal((B, 256)), jnp.bfloat16)
+wbf = jnp.asarray(rng.standard_normal((256, 256)), jnp.bfloat16)
+
+
+@jax.jit
+def mxu_bf16(a, w):
+    out = jnp.zeros((B, 256), jnp.float32)
+    for _ in range(32):
+        out = out + lax.dot_general(a, w, (((1,), (0,)), ((), ())),
+                                    preferred_element_type=jnp.float32)
+    return out
+
+t = timeit(mxu_bf16, abf, wbf)
+ops = 32 * B * 256 * 256 * 2
+print(f"MXU bf16 (B,256)@(256,256): {ops/t/1e12:.2f} T op/s")

@@ -1,0 +1,118 @@
+"""Break down where time goes inside p256.verify_words on TPU."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+from fabric_tpu.ops import bignum as bn
+from fabric_tpu.ops import p256
+from fabric_tpu.ops.weierstrass import ShortCurve
+
+B = 16384
+curve = p256.curve
+fp, fn = curve.fp, curve.fn
+
+rng = np.random.default_rng(0)
+vals = [int.from_bytes(rng.bytes(32), "big") % p256.P for _ in range(B)]
+a = jnp.asarray(bn.ints_to_limbs(vals))
+b = jnp.asarray(bn.ints_to_limbs(vals[::-1]))
+
+
+def timeit(fn_, *args, iters=5):
+    out = fn_(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn_(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+# 1. single dbl / add
+P = curve.to_jacobian(a, b)
+
+
+@jax.jit
+def do_dbl(P):
+    x = P
+    for _ in range(8):
+        x = curve.dbl(x)
+    return x
+
+
+@jax.jit
+def do_add(P):
+    x = P
+    for _ in range(8):
+        x = curve.add(x, P)
+    return x
+
+t = timeit(do_dbl, P)
+print(f"dbl: {t/8*1e6:.1f} us")
+t = timeit(do_add, P)
+print(f"add (complete): {t/8*1e6:.1f} us")
+
+
+# 2. mul inside a lax.scan vs unrolled
+@jax.jit
+def scan_mul(a, b):
+    def body(x, _):
+        return fp.mul(x, b), None
+    out, _ = lax.scan(body, a, None, length=64)
+    return out
+
+
+@jax.jit
+def unroll_mul(a, b):
+    x = a
+    for _ in range(64):
+        x = fp.mul(x, b)
+    return x
+
+t = timeit(scan_mul, a, b)
+print(f"mul in lax.scan:  {t/64*1e6:.2f} us/mul")
+t = timeit(unroll_mul, a, b)
+print(f"mul unrolled x64: {t/64*1e6:.2f} us/mul")
+
+
+# 3. one shamir ladder iteration (scan of 8)
+G = curve.to_jacobian(
+    jnp.broadcast_to(jnp.asarray(curve.g_m[0]), (bn.N_LIMBS, B)),
+    jnp.broadcast_to(jnp.asarray(curve.g_m[1]), (bn.N_LIMBS, B)))
+GQ = curve.add(G, P)
+bits = jnp.asarray(rng.integers(0, 2, (8, 2, B)), jnp.int32)
+
+
+@jax.jit
+def ladder8(P, bits):
+    def body(acc, bb):
+        b1, b2 = bb[0], bb[1]
+        acc = curve.dbl(acc)
+        t_ = curve.select_point(b1 != 0, G, curve.infinity((B,)))
+        t_ = curve.select_point((b1 == 0) & (b2 != 0), P, t_)
+        t_ = curve.select_point((b1 != 0) & (b2 != 0), GQ, t_)
+        acc = curve.add(acc, t_)
+        return acc, None
+    acc, _ = lax.scan(body, P, bits)
+    return acc
+
+t = timeit(ladder8, P, bits)
+print(f"ladder iter (in scan): {t/8*1e6:.1f} us  -> x256 = {t/8*256*1e3:.1f} ms")
+
+# 4. full shamir
+u1 = jnp.asarray(bn.ints_to_limbs([v % p256.N for v in vals]))
+u2 = jnp.asarray(bn.ints_to_limbs([v % p256.N for v in vals[::-1]]))
+sham = jax.jit(lambda u1, u2, Q: curve.shamir(u1, u2, Q))
+t = timeit(sham, u1, u2, P, iters=3)
+print(f"full shamir: {t*1e3:.1f} ms")
+
+# 5. scalar inversion (pow_const scan)
+inv_fn = jax.jit(lambda x: fn.inv(x))
+t = timeit(inv_fn, a)
+print(f"fn.inv (Fermat): {t*1e3:.1f} ms")
+
+# 6. full verify for reference
+qx, qy, r, s, e = (jnp.asarray(np.zeros((8, B), np.uint32)),) * 5
+vw = jax.jit(p256.verify_words)
+t = timeit(vw, qx, qy, r, s, e, iters=3)
+print(f"full verify_words: {t*1e3:.1f} ms -> {B/t:.0f} sigs/s")

@@ -1,0 +1,163 @@
+"""Prototype: scan-free field mul (unrolled CIOS + flat carry resolve).
+
+Validates numerics vs bignum.Mont and measures a ladder-like scan body.
+"""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+from fabric_tpu.ops import bignum as bn
+
+L = bn.N_LIMBS
+MASK = bn.LIMB_MASK
+LB = bn.LIMB_BITS
+
+P256 = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+mont = bn.Mont(P256, "p")
+p_np = mont.p_limbs.astype(np.int32)
+n0inv = np.int32(mont.n0inv)
+
+
+# ---- flat carry resolution -------------------------------------------------
+
+def _split_round(x):
+    """One redundant carry round; preserves value; handles negative limbs."""
+    c = x >> LB
+    r = x & MASK
+    return r + jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+    # NOTE: drops carry out of the top limb — caller must guarantee headroom.
+
+
+def resolve(x, n_out):
+    """(L,B) limbs with |l| < 2^30 -> canonical limbs in [0, 2^12).
+
+    Three split rounds bring limbs to [-1, 2^12+1] with carries in {-1,0,1},
+    then a ternary Kogge-Stone prefix computes exact carries. Flat (no scans).
+    """
+    Lx = x.shape[0]
+    if Lx < n_out:
+        x = jnp.concatenate([x, jnp.zeros((n_out - Lx,) + x.shape[1:], x.dtype)], axis=0)
+    x = _split_round(x)
+    x = _split_round(x)
+    x = _split_round(x)
+    # per-position carry map on incoming c in {-1,0,1}
+    fm1 = (x - 1) >> LB
+    f0 = x >> LB
+    f1 = (x + 1) >> LB
+
+    def compose(g, f):
+        gm1, g0, g1 = g
+        out = []
+        for fx in f:
+            out.append(jnp.where(fx < 0, gm1, jnp.where(fx > 0, g1, g0)))
+        return tuple(out)
+
+    # prefix composition, KS doubling; F_i = f_i . f_{i-1} . ... . f_0
+    F = (fm1, f0, f1)
+    n = x.shape[0]
+    shift = 1
+    while shift < n:
+        # identity-padded shift down
+        def sh(a, fill):
+            pad = jnp.full((shift,) + a.shape[1:], fill, a.dtype)
+            return jnp.concatenate([pad, a[:-shift]], axis=0)
+        G = (sh(F[0], -1), sh(F[1], 0), sh(F[2], 1))
+        F = compose(F, G)
+        shift *= 2
+    # carry into position i = F_{i-1}(0)
+    carry = jnp.concatenate([jnp.zeros_like(F[1][:1]), F[1][:-1]], axis=0)
+    return (x + carry) & MASK
+
+
+def flat_mul(a, b):
+    """Unrolled CIOS; same math as Mont.mul, zero scans."""
+    bshape = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    b = jnp.broadcast_to(b, (L,) + bshape)
+    a = jnp.broadcast_to(a, (L,) + bshape)
+    p_col = jnp.asarray(p_np.reshape(L, *([1] * len(bshape))))
+    acc = a * 0 + b * 0
+    for i in range(L):
+        acc = acc + a[i] * b
+        m = (acc[0] * n0inv) & MASK
+        acc = acc + m * p_col
+        c0 = acc[0] >> LB
+        top = jnp.zeros((1,) + acc.shape[1:], acc.dtype)
+        acc = jnp.concatenate([acc[1:2] + c0, acc[2:], top], axis=0)
+    return resolve(acc, L)
+
+
+# ---- numerics check --------------------------------------------------------
+rng = np.random.default_rng(1)
+B = 16384
+vals_a = [int.from_bytes(rng.bytes(32), "big") % (2 * P256) for _ in range(64)]
+vals_b = [int.from_bytes(rng.bytes(32), "big") % (2 * P256) for _ in range(64)]
+a64 = jnp.asarray(bn.ints_to_limbs(vals_a))
+b64 = jnp.asarray(bn.ints_to_limbs(vals_b))
+ref = mont.mul(a64, b64)
+got = flat_mul(a64, b64)
+ok = np.array_equal(np.asarray(ref), np.asarray(got))
+print("flat_mul matches Mont.mul:", ok)
+assert ok
+
+# negative-limb resolve check (sub-style input)
+x = np.asarray(bn.ints_to_limbs(vals_a)) - np.asarray(bn.ints_to_limbs(vals_b))
+want = [(va - vb) % (1 << (12 * L)) for va, vb in zip(vals_a, vals_b)]
+neg_ok = []
+got2 = resolve(jnp.asarray(x), L)
+g2 = np.asarray(got2)
+for i, w in enumerate(want):
+    v = 0
+    for j in reversed(range(L)):
+        v = (v << 12) | int(g2[j, i])
+    neg_ok.append(v == w if vals_a[i] >= vals_b[i] else v == (vals_a[i] - vals_b[i]) % (1 << 264))
+print("resolve handles negatives:", all(neg_ok))
+assert all(neg_ok)
+
+
+# ---- perf: mul-chain inside an outer scan (the ladder context) -------------
+a = jnp.asarray(bn.ints_to_limbs([v % P256 for v in (vals_a * 256)[:B]]))
+b = jnp.asarray(bn.ints_to_limbs([v % P256 for v in (vals_b * 256)[:B]]))
+
+
+def timeit(fn_, *args, iters=5):
+    out = fn_(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn_(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+@jax.jit
+def ladder_flat(a, b):
+    def body(acc, _):
+        x = acc
+        for _ in range(24):  # ~one ladder iteration's worth of muls
+            x = flat_mul(x, b)
+        return x, None
+    out, _ = lax.scan(body, a, None, length=8)
+    return out
+
+
+@jax.jit
+def ladder_scan_mul(a, b):
+    def body(acc, _):
+        x = acc
+        for _ in range(24):
+            x = mont.mul(x, b)
+        return x, None
+    out, _ = lax.scan(body, a, None, length=8)
+    return out
+
+t0 = time.perf_counter()
+r = ladder_flat(a, b); jax.block_until_ready(r)
+print(f"flat compile+first: {time.perf_counter()-t0:.1f}s")
+t = timeit(ladder_flat, a, b)
+print(f"flat mul in outer scan: {t/8/24*1e6:.2f} us/mul -> ladder-iter {t/8*1e3:.2f} ms")
+t0 = time.perf_counter()
+r = ladder_scan_mul(a, b); jax.block_until_ready(r)
+print(f"scan compile+first: {time.perf_counter()-t0:.1f}s")
+t = timeit(ladder_scan_mul, a, b)
+print(f"scan mul in outer scan: {t/8/24*1e6:.2f} us/mul -> ladder-iter {t/8*1e3:.2f} ms")

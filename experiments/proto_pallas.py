@@ -1,0 +1,127 @@
+"""Can Pallas run on axon, and how fast is an in-VMEM CIOS mul chain?"""
+import time, functools
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fabric_tpu.ops import bignum as bn
+
+L = bn.N_LIMBS
+MASK = bn.LIMB_MASK
+LB = bn.LIMB_BITS
+P256 = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+mont = bn.Mont(P256, "p")
+p_np = mont.p_limbs.astype(np.int32)
+n0inv = int(mont.n0inv)
+
+
+def _split_round(x):
+    c = x >> LB
+    r = x & MASK
+    return r + jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+
+
+def resolve(x, n_out):
+    Lx = x.shape[0]
+    if Lx < n_out:
+        x = jnp.concatenate([x, jnp.zeros((n_out - Lx,) + x.shape[1:], x.dtype)], axis=0)
+    elif Lx > n_out:
+        raise ValueError("cannot drop limbs")
+    x = _split_round(x)
+    x = _split_round(x)
+    x = _split_round(x)
+    fm1, f0, f1 = (x - 1) >> LB, x >> LB, (x + 1) >> LB
+
+    def compose(g, f):
+        gm1, g0, g1 = g
+        return tuple(jnp.where(fx < 0, gm1, jnp.where(fx > 0, g1, g0)) for fx in f)
+
+    F = (fm1, f0, f1)
+    shift = 1
+    n = x.shape[0]
+    while shift < n:
+        def sh(a, fill):
+            pad = jnp.full((shift,) + a.shape[1:], fill, a.dtype)
+            return jnp.concatenate([pad, a[:-shift]], axis=0)
+        F = compose(F, (sh(F[0], -1), sh(F[1], 0), sh(F[2], 1)))
+        shift *= 2
+    carry = jnp.concatenate([jnp.zeros_like(F[1][:1]), F[1][:-1]], axis=0)
+    return (x + carry) & MASK
+
+
+def flat_mul(a, b, p_col):
+    acc = a * 0 + b * 0
+    for i in range(L):
+        acc = acc + a[i] * b
+        m = (acc[0] * np.int32(n0inv)) & MASK
+        acc = acc + m * p_col
+        c0 = acc[0] >> LB
+        acc = jnp.concatenate(
+            [acc[1:2] + c0, acc[2:], jnp.zeros((1,) + acc.shape[1:], acc.dtype)], axis=0)
+    return resolve(acc, L)
+
+
+TILE = 512
+NMUL = 24
+NITER = 8
+
+
+def kernel(p_ref, a_ref, b_ref, out_ref):
+    p_col = p_ref[:]
+    a = a_ref[:]
+    b = b_ref[:]
+
+    def body(i, x):
+        y = x
+        for _ in range(NMUL):
+            y = flat_mul(y, b, p_col)
+        return y
+
+    out_ref[:] = lax.fori_loop(0, NITER, body, a)
+
+
+B = 16384
+rng = np.random.default_rng(0)
+vals = [int.from_bytes(rng.bytes(32), "big") % P256 for _ in range(B)]
+a = jnp.asarray(bn.ints_to_limbs(vals))
+b = jnp.asarray(bn.ints_to_limbs(vals[::-1]))
+
+
+@jax.jit
+def run(a, b):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((L, B), jnp.int32),
+        grid=(B // TILE,),
+        in_specs=[
+            pl.BlockSpec((L, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((L, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((L, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((L, TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+    )(jnp.asarray(p_np.reshape(L, 1)), a, b)
+
+
+t0 = time.perf_counter()
+out = run(a, b)
+jax.block_until_ready(out)
+print(f"pallas compile+first: {time.perf_counter()-t0:.1f}s")
+
+# correctness vs Mont.mul chain
+x = a[:, :64]
+for _ in range(NMUL * NITER):
+    x = mont.mul(x, b[:, :64])
+ok = np.array_equal(np.asarray(x), np.asarray(out)[:, :64])
+print("pallas matches Mont.mul chain:", ok)
+
+t0 = time.perf_counter()
+iters = 5
+for _ in range(iters):
+    out = run(a, b)
+jax.block_until_ready(out)
+t = (time.perf_counter() - t0) / iters
+nmul_total = NMUL * NITER
+print(f"pallas mul: {t/nmul_total*1e6:.2f} us/batched-mul "
+      f"({B*nmul_total/t/1e9:.2f} G modmul/s) total {t*1e3:.1f} ms")

@@ -1,0 +1,154 @@
+"""Iteration 2: unshifted-acc CIOS, relaxed limbs (no KS per mul), K-stacked muls."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from fabric_tpu.ops import bignum as bn
+
+L = bn.N_LIMBS          # 22
+MASK = bn.LIMB_MASK
+LB = bn.LIMB_BITS
+P256 = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+mont = bn.Mont(P256, "p")
+p_np = mont.p_limbs.astype(np.int32)
+n0inv = np.int32(int(mont.n0inv))
+
+
+def split2(x):
+    """Two carry-split rounds: limbs |.| < 2^30 -> [0, 2^12 + 2^7)."""
+    for _ in range(2):
+        c = x >> LB
+        x = (x & MASK) + jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+    return x
+
+
+def mul_relaxed(a, b, p_col):
+    """CIOS with unshifted 2L-limb accumulator; relaxed in/out (< 2^13)."""
+    sh = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+    acc = jnp.zeros((2 * L,) + sh, jnp.int32)
+    for i in range(L):
+        t = lax.dynamic_slice_in_dim(acc, i, L, 0) + a[i] * b
+        m = (t[0] * n0inv) & MASK
+        t = t + m * p_col
+        carry = t[0] >> LB
+        t = lax.dynamic_update_slice_in_dim(t, t[1:2] + carry, 1, 0)
+        acc = lax.dynamic_update_slice_in_dim(acc, t, i, 0)
+    hi = acc[L:]
+    return split2(hi)
+
+
+B_TILE = 512
+NMUL = 24
+NITER = 8
+
+
+def kernel(p_ref, a_ref, b_ref, out_ref):
+    p_col = p_ref[:]
+    a = a_ref[:]
+    b = b_ref[:]
+
+    def body(i, x):
+        y = x
+        for _ in range(NMUL):
+            y = mul_relaxed(y, b, p_col)
+        return y
+
+    out_ref[:] = lax.fori_loop(0, NITER, body, a)
+
+
+B = 16384
+rng = np.random.default_rng(0)
+vals = [int.from_bytes(rng.bytes(32), "big") % P256 for _ in range(B)]
+a = jnp.asarray(bn.ints_to_limbs(vals))
+bb = jnp.asarray(bn.ints_to_limbs(vals[::-1]))
+
+
+@jax.jit
+def run(a, b):
+    return pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((L, B), jnp.int32),
+        grid=(B // B_TILE,),
+        in_specs=[
+            pl.BlockSpec((L, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((L, B_TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((L, B_TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((L, B_TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+    )(jnp.asarray(p_np.reshape(L, 1)), a, bb)
+
+
+t0 = time.perf_counter()
+out = run(a, bb)
+jax.block_until_ready(out)
+print(f"compile+first: {time.perf_counter()-t0:.1f}s")
+
+# correctness: compare values mod p (relaxed representation)
+x = a[:, :32]
+for _ in range(NMUL * NITER):
+    x = mont.mul(x, bb[:, :32])
+ref_ints = bn.limbs_to_ints(np.asarray(x))
+got_ints = bn.limbs_to_ints(np.asarray(out)[:, :32])
+ok = all((g - r) % P256 == 0 for g, r in zip(got_ints, ref_ints))
+print("matches mod p:", ok)
+
+iters = 5
+t0 = time.perf_counter()
+for _ in range(iters):
+    out = run(a, bb)
+jax.block_until_ready(out)
+t = (time.perf_counter() - t0) / iters
+nm = NMUL * NITER
+print(f"relaxed mul: {t/nm*1e6:.2f} us/batched-mul ({t/nm/32*1e6:.3f} us/tile-mul) total {t*1e3:.1f} ms")
+
+# ---- K-stacked variant: 4 independent muls as (22, 4, 512) ----
+K = 4
+
+
+def kernel_k(p_ref, a_ref, b_ref, out_ref):
+    p_col = p_ref[:].reshape(L, 1, 1)
+    a = a_ref[:]
+    b = b_ref[:]
+
+    def body(i, x):
+        y = x
+        for _ in range(NMUL):
+            y = mul_relaxed(y, b, p_col)
+        return y
+
+    out_ref[:] = lax.fori_loop(0, NITER, body, a)
+
+
+@jax.jit
+def run_k(a, b):
+    return pl.pallas_call(
+        kernel_k,
+        out_shape=jax.ShapeDtypeStruct((L, K, B // K), jnp.int32),
+        grid=(B // K // B_TILE,),
+        in_specs=[
+            pl.BlockSpec((L, 1), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((L, K, B_TILE), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
+            pl.BlockSpec((L, K, B_TILE), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((L, K, B_TILE), lambda i: (0, 0, i), memory_space=pltpu.VMEM),
+    )(jnp.asarray(p_np.reshape(L, 1)), a, b)
+
+
+ak = a.reshape(L, K, B // K)
+bk = bb.reshape(L, K, B // K)
+t0 = time.perf_counter()
+outk = run_k(ak, bk)
+jax.block_until_ready(outk)
+print(f"K-stacked compile+first: {time.perf_counter()-t0:.1f}s")
+got_ints = bn.limbs_to_ints(np.asarray(outk).reshape(L, B)[:, :32])
+ok = all((g - r) % P256 == 0 for g, r in zip(got_ints, ref_ints))
+print("K-stacked matches mod p:", ok)
+t0 = time.perf_counter()
+for _ in range(iters):
+    outk = run_k(ak, bk)
+jax.block_until_ready(outk)
+t = (time.perf_counter() - t0) / iters
+print(f"K-stacked: {t/nm*1e6:.2f} us/batched-mul-equivalent total {t*1e3:.1f} ms")

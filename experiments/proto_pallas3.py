@@ -1,0 +1,131 @@
+"""Micro-profile Pallas/Mosaic primitive costs on (L, 512) int32 tiles."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+L = 22
+MASK = 4095
+LB = 12
+B = 16384
+B_TILE = 512
+REP = 64
+
+
+def bench(name, body_fn, n_ops=REP, shape=(L, B)):
+    def kernel(a_ref, out_ref):
+        out_ref[:] = lax.fori_loop(0, 4, lambda i, x: body_fn(x), a_ref[:])
+
+    @jax.jit
+    def run(a):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct(shape, jnp.int32),
+            grid=(shape[-1] // B_TILE,),
+            in_specs=[pl.BlockSpec(shape[:-1] + (B_TILE,),
+                                   lambda i: (0,) * (len(shape) - 1) + (i,),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(shape[:-1] + (B_TILE,),
+                                   lambda i: (0,) * (len(shape) - 1) + (i,),
+                                   memory_space=pltpu.VMEM),
+        )(a)
+
+    a = jnp.asarray(np.random.default_rng(0).integers(0, 4096, shape), jnp.int32)
+    try:
+        out = run(a)
+        jax.block_until_ready(out)
+    except Exception as e:
+        print(f"{name}: UNSUPPORTED ({str(e).splitlines()[0][:80]})")
+        return
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = run(a)
+    jax.block_until_ready(out)
+    t = (time.perf_counter() - t0) / 10
+    per_op = t / (4 * n_ops)
+    lanes = np.prod(shape)
+    print(f"{name}: {per_op*1e9:.0f} ns/op  ({lanes*4*n_ops*10/t/1e12/10:.2f} T lane-op/s)")
+
+
+# 1. plain elementwise mul
+def _chain_mul(x):
+    for _ in range(REP):
+        x = (x * 3) & 0xFFFFF
+    return x
+bench("elementwise mul (22,B)", _chain_mul, REP)
+
+# 2. row-broadcast mul (a_i * b pattern)
+def _row_mul(x):
+    for i in range(REP):
+        x = x * x[i % L] & 0xFFFFF
+    return x
+bench("row-broadcast mul", _row_mul, REP)
+
+# 3. concat-shift down one sublane
+def _concat_shift(x):
+    for _ in range(REP):
+        x = jnp.concatenate([x[1:], x[:1]], axis=0)
+    return x
+bench("concat rotate 1 sublane", _concat_shift, REP)
+
+# 4. pltpu.roll
+def _roll(x):
+    for _ in range(REP):
+        x = pltpu.roll(x, 1, 0)
+    return x
+bench("pltpu.roll 1 sublane", _roll, REP)
+
+# 5. where select
+def _where(x):
+    m = x[0] > 100
+    for _ in range(REP):
+        x = jnp.where(m[None, :], x, x + 1)
+    return x
+bench("jnp.where select", _where, REP)
+
+# 6. split round (mask+shift+concat+add)
+def _split(x):
+    for _ in range(REP // 4):
+        c = x >> LB
+        x = (x & MASK) + jnp.concatenate([jnp.zeros_like(c[:1]), c[:-1]], axis=0)
+    return x
+bench("split round (4 ops)", _split, REP // 4)
+
+# 7. shift-right / and
+def _shmask(x):
+    for _ in range(REP):
+        x = (x >> 1) & MASK | x
+    return x
+bench("shift+and+or (3ops)", _shmask, REP)
+
+# 8. f32 mul for comparison
+def bench_f32():
+    def body(x):
+        for _ in range(REP):
+            x = x * 1.5 - x
+        return x
+
+    def kernel(a_ref, out_ref):
+        out_ref[:] = lax.fori_loop(0, 4, lambda i, x: body(x), a_ref[:])
+
+    @jax.jit
+    def run(a):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((L, B), jnp.float32),
+            grid=(B // B_TILE,),
+            in_specs=[pl.BlockSpec((L, B_TILE), lambda i: (0, i), memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((L, B_TILE), lambda i: (0, i), memory_space=pltpu.VMEM),
+        )(a)
+    a = jnp.asarray(np.random.default_rng(0).random((L, B)), jnp.float32)
+    out = run(a); jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(10):
+        out = run(a)
+    jax.block_until_ready(out)
+    t = (time.perf_counter() - t0) / 10
+    print(f"f32 mul-sub (2op): {t/(4*REP)*1e9:.0f} ns/op ({L*B*4*REP*2*10/t/1e12/10:.2f} T lane-op/s)")
+
+bench_f32()

@@ -1,0 +1,71 @@
+"""Verify the suspicious 7us/dbl top-level measurement with output checking."""
+import time
+import numpy as np
+import jax, jax.numpy as jnp
+
+from fabric_tpu.ops import bignum as bn, p256
+from fabric_tpu.ops.weierstrass import ShortCurve
+
+curve = p256.curve
+fp = curve.fp
+B = 16384
+rng = np.random.default_rng(0)
+
+# real curve points: k*G for random k (host-computed via python ints)
+P_int = p256.P
+
+
+def ec_add(p1, p2):
+    if p1 is None: return p2
+    if p2 is None: return p1
+    x1, y1 = p1; x2, y2 = p2
+    if x1 == x2 and (y1 + y2) % P_int == 0: return None
+    if p1 == p2:
+        lam = (3 * x1 * x1 + p256.A) * pow(2 * y1, -1, P_int) % P_int
+    else:
+        lam = (y2 - y1) * pow(x2 - x1, -1, P_int) % P_int
+    x3 = (lam * lam - x1 - x2) % P_int
+    return x3, (lam * (x1 - x3) - y1) % P_int
+
+
+def ec_mul(k, pt):
+    acc = None
+    while k:
+        if k & 1: acc = ec_add(acc, pt)
+        pt = ec_add(pt, pt)
+        k >>= 1
+    return acc
+
+
+G = (p256.GX, p256.GY)
+pts = [ec_mul(rng.integers(1, 1 << 60), G) for _ in range(64)]
+xs = [p[0] for p in pts] * (B // 64)
+ys = [p[1] for p in pts] * (B // 64)
+x_m = fp.to_mont(jnp.asarray(bn.ints_to_limbs(xs)))
+y_m = fp.to_mont(jnp.asarray(bn.ints_to_limbs(ys)))
+Pj = curve.to_jacobian(x_m, y_m)
+
+import sys
+for chain in (8, 32):
+    @jax.jit
+    def do_dbl(P, n=chain):
+        x = P
+        for _ in range(n):
+            x = curve.dbl(x)
+        return x
+    tc = time.perf_counter()
+    out = do_dbl(Pj)
+    jax.block_until_ready(out)
+    print(f"chain={chain} compile+first {time.perf_counter()-tc:.1f}s"); sys.stdout.flush()
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = do_dbl(Pj)
+    jax.block_until_ready(out)
+    t = (time.perf_counter() - t0) / 5
+    # verify first element: dbl^chain == 2^chain * P
+    X, Y, Z = [np.asarray(fp.from_mont(c))[:, 0] for c in out]
+    zi = pow(bn.limbs_to_ints(np.asarray(fp.from_mont(out[2]))[:, :1])[0], -1, P_int)
+    Xi = bn.limbs_to_ints(np.asarray(fp.from_mont(out[0]))[:, :1])[0]
+    want = ec_mul(1 << chain, pts[0])
+    got_x = Xi * zi * zi % P_int
+    print(f"chain={chain}: {t/chain*1e6:.2f} us/dbl  correct={got_x == want[0]}")

@@ -71,16 +71,36 @@ class JaxTpuProvider(prov.Provider):
         if key not in self._fns:
             import jax
             if scheme == SCHEME_P256:
-                from fabric_tpu.ops import p256
+                import os
+                low_s = self.require_low_s
                 if self.mesh is not None:
                     from fabric_tpu.parallel import mesh as meshmod
                     f = meshmod.sharded_p256_verify(self.mesh, self.require_low_s)
                     self._fns[key] = lambda *a: f(*a)[0]
+                elif os.environ.get("FABRIC_TPU_PALLAS") == "1":
+                    # experimental fused kernel (see ops/p256_pallas.py)
+                    from fabric_tpu.ops import p256_pallas
+                    self._fns[key] = lambda *a: p256_pallas.verify_words(
+                        *a, require_low_s=low_s)
                 else:
-                    jf = jax.jit(p256.verify_words,
-                                 static_argnames=("require_low_s",))
-                    low_s = self.require_low_s
-                    self._fns[key] = lambda *a: jf(*a, require_low_s=low_s)
+                    # round-2 windowed flat path (ops/ecp256).  On CPU the
+                    # big scan bodies hit an XLA:CPU compile pathology, so
+                    # run eagerly there (per-primitive jits, see flatfield).
+                    from fabric_tpu.ops import ecp256
+                    if jax.default_backend() == "cpu":
+                        self._fns[key] = lambda *a: ecp256.verify_words_xla(
+                            *a, require_low_s=low_s)
+                    else:
+                        jf = jax.jit(ecp256.verify_body,
+                                     static_argnames=("require_low_s",))
+                        from fabric_tpu.ops import bignum as _bn
+                        tab = ecp256.comb_table_f32()
+
+                        def run(qx, qy, r, s, e, _jf=jf, _tab=tab):
+                            args = [_bn.words_be_to_limbs(v)
+                                    for v in (qx, qy, r, s, e)]
+                            return _jf(*args, _tab, require_low_s=low_s)
+                        self._fns[key] = run
             elif scheme == SCHEME_ED25519:
                 from fabric_tpu.ops import ed25519
                 if self.mesh is not None:

@@ -27,20 +27,44 @@ class BlockCommitResult:
 
 
 class Committer:
-    def __init__(self, ledger: KVLedger, validator: TxValidator):
+    def __init__(self, ledger: KVLedger, validator: TxValidator,
+                 bundle_source=None, provider=None):
         self.ledger = ledger
         self.validator = validator
+        self.bundle_source = bundle_source
+        self.provider = provider
         # wire the duplicate-txid oracle to the block store
         self.validator.ledger_has_txid = ledger.blockstore.has_txid
 
     def store_block(self, block: Block) -> BlockCommitResult:
-        """Validate (verify-then-gate) and commit one block."""
+        """Validate (verify-then-gate) and commit one block.
+
+        Committed config blocks are applied to the channel bundle AFTER the
+        commit (core/peer: channel config takes effect at the block
+        boundary), so the config tx itself is validated under the previous
+        configuration — matching configtx/validator.go sequencing.
+        """
         from fabric_tpu.protocol.txflags import TxFlags
         from fabric_tpu.protocol.types import META_TXFLAGS
 
         vr = self.validator.validate(block)
         stats = self.ledger.commit(block)
         final = TxFlags.from_bytes(block.metadata.items[META_TXFLAGS])
+        if self.bundle_source is not None:
+            from fabric_tpu.config import ConfigError, apply_config_block
+            from fabric_tpu.protocol.txflags import ValidationCode
+            try:
+                apply_config_block(self.bundle_source, block,
+                                   self.provider
+                                   or self.validator.provider)
+            except ConfigError as exc:
+                logger.warning("config block %d rejected at commit: %s",
+                               block.header.number, exc)
+            except Exception:
+                # the block is already committed; a config-plane failure
+                # must not make the caller believe the commit failed
+                logger.exception("config application failed for block %d",
+                                 block.header.number)
         return BlockCommitResult(vr, stats, final)
 
     @property

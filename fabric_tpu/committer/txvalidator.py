@@ -98,14 +98,32 @@ class TxValidator:
 
     def __init__(self, channel_id: str, msps: Dict[str, object], provider,
                  policies: PolicyRegistry,
-                 ledger_has_txid=None):
+                 ledger_has_txid=None, bundle_source=None):
         self.channel_id = channel_id
-        self.msps = msps
+        self._static_msps = msps
         self.provider = provider
         self.policies = policies
-        self.evaluator = PolicyEvaluator(msps, provider)
+        self.bundle_source = bundle_source
         # blkstorage-backed duplicate-txid oracle (validator.go dedup vs ledger)
         self.ledger_has_txid = ledger_has_txid or (lambda txid: False)
+
+    @property
+    def msps(self):
+        """MSP set for the block being validated.  Snapshotted once per
+        validate() call: all txs of one block must be judged under ONE
+        config or peers could produce divergent validity bitmaps when a
+        bundle swap races a long validation (the reference pins the bundle
+        per block too, core/peer/peer.go:332-371)."""
+        snap = getattr(self, "_msps_snapshot", None)
+        if snap is not None:
+            return snap
+        if self.bundle_source is not None:
+            return self.bundle_source.current().msps
+        return self._static_msps
+
+    @property
+    def evaluator(self):
+        return PolicyEvaluator(self.msps, self.provider)
 
     # -- pass 1: structural + collect ---------------------------------------
 
@@ -229,6 +247,14 @@ class TxValidator:
     # -- the block entry point (validator.go:181) ---------------------------
 
     def validate(self, block: Block) -> ValidationResult:
+        self._msps_snapshot = (self.bundle_source.current().msps
+                               if self.bundle_source is not None else None)
+        try:
+            return self._validate_inner(block)
+        finally:
+            self._msps_snapshot = None
+
+    def _validate_inner(self, block: Block) -> ValidationResult:
         n = len(block.data)
         flags = TxFlags(n)
 

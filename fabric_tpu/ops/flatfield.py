@@ -1,0 +1,462 @@
+"""Scan-free 256-bit modular arithmetic for TPU kernels (Pallas & XLA).
+
+Round-2 replacement for the hot paths of bignum.Mont: the round-1 kernel
+spent its time on nested lax.scan loop overhead (a 22-step CIOS scan inside
+carry scans inside the 256-iteration ladder scan).  Every op here is a flat
+composition of elementwise/broadcast int32 ops on (L, ...) limb arrays —
+no lax.scan, no while_loop, no gather/scatter — so the same code lowers
+both through XLA (CPU tests, fallback) and through Mosaic inside a Pallas
+kernel (fabric_tpu/ops/p256_pallas.py).
+
+Layout: limbs-first int32 arrays (L, B), 12-bit limbs, L=22 (264 bits),
+identical to bignum (results interchangeable; same R = 2^264, same n0inv).
+
+Representations:
+  canonical: limbs in [0, 2^12), value in [0, p)
+  relaxed:   limbs in (-2^7, 2^12 + 2^7), value in [0, 2p)
+mul/mod_add/mod_sub take and return relaxed values; canon()/is_zero()/eq()
+resolve exactly via a ternary Kogge-Stone carry prefix (O(log L) depth,
+handles borrows), never a scan.
+
+Numerical contract of mul (fused-m CIOS, fully unrolled):
+  operands: limbs |l| < 2^13, values < 16p  ->  output value < 2p.
+  (CIOS bound: out < p + a*b/R; a*b <= (16p)^2 = 256 p^2 < R*p since
+   p < 2^256 = R/256.)
+Int32 overflow: per-step per-limb additions are a_i*b_j + m*p_j with
+|a_i|,|b_j| < 2^13, m,p_j < 2^12: < 2^26 + 2^24; a limb accumulates through
+at most L=22 steps plus carry rows: < 22 * 1.1*2^26 + 2^19 < 2^31.  OK.
+
+Reference semantics target: the ECDSA verify math reached from
+/root/reference/bccsp/sw/ecdsa.go:41 (Go big.Int there; limbed int32 here).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from . import bignum as bn
+
+L = bn.N_LIMBS            # 22
+LB = bn.LIMB_BITS         # 12
+MASK = bn.LIMB_MASK       # 0xFFF
+
+
+# ---------------------------------------------------------------------------
+# Constant materialization hook
+#
+# Pallas kernels may not close over concrete arrays — constants must arrive
+# through refs.  Every (L,)-limb constant in this module funnels through
+# const_col(); a Pallas wrapper installs a hook that (pass 1) records the
+# distinct constants while tracing the same math under jax.make_jaxpr, then
+# (pass 2) serves them as rows of a single VMEM "constant pool" input.
+# ---------------------------------------------------------------------------
+
+_CONST_HOOK = None
+
+
+def set_const_hook(hook):
+    """Install hook(flat_np_int32_of_len_L) -> jnp (L,); returns previous."""
+    global _CONST_HOOK
+    prev = _CONST_HOOK
+    _CONST_HOOK = hook
+    return prev
+
+
+def const_col(limbs_np, ndim: int):
+    """(L,)-ish numpy limb constant -> jnp array shaped (L, 1, ..1) for
+    broadcasting against (L, B...) arrays, via the hook if installed."""
+    flat = np.ascontiguousarray(limbs_np, dtype=np.int32).reshape(-1)
+    arr = jnp.asarray(flat) if _CONST_HOOK is None else _CONST_HOOK(flat)
+    return arr.reshape((flat.shape[0],) + (1,) * (ndim - 1))
+
+
+# ---------------------------------------------------------------------------
+# Per-primitive jit cache (CPU/eager path)
+#
+# XLA:CPU compiles one huge LLVM function per jitted graph; the full flat
+# verify (~1M ops) takes minutes to compile.  Eager execution instead pays
+# per-op dispatch on ~300 ops per field-mul.  Sweet spot: jit each FIELD
+# PRIMITIVE (mul, add, compare...) as its own small program and drive the
+# curve layer eagerly from Python.  Inside a trace (jit/pallas) the
+# primitives inline as before — the wrapper only activates on concrete
+# arrays.
+# ---------------------------------------------------------------------------
+
+_PRIM_CACHE: dict = {}
+
+
+def _is_concrete(*arrays) -> bool:
+    import jax.core
+    return not any(isinstance(a, jax.core.Tracer) for a in arrays)
+
+
+def _prim_jit(key, fn):
+    jf = _PRIM_CACHE.get(key)
+    if jf is None:
+        import jax
+        jf = jax.jit(fn)
+        _PRIM_CACHE[key] = jf
+    return jf
+
+
+# ---------------------------------------------------------------------------
+# Flat carry machinery
+# ---------------------------------------------------------------------------
+
+def _pad_axis0(x, before: int, after: int, fill=0):
+    """jnp.pad along axis 0 only — used instead of concatenate towers:
+    XLA:CPU's algebraic simplifier loops on concat(slice(concat(...)))
+    chains, while pad(slice) folds cleanly."""
+    cfg = ((before, after),) + ((0, 0),) * (x.ndim - 1)
+    return jnp.pad(x, cfg, constant_values=fill)
+
+
+def shift_up(x):
+    """Limbs one position toward the MSB; the top limb is dropped (callers
+    guarantee it carries nothing)."""
+    return _pad_axis0(x[:x.shape[0] - 1], 1, 0)
+
+
+def split_rounds(x, rounds: int = 2):
+    """Value-preserving carry-save rounds (arithmetic shift: borrows OK)."""
+    for _ in range(rounds):
+        x = (x & MASK) + shift_up(x >> LB)
+    return x
+
+
+def _ks_prefix(x):
+    """Ternary Kogge-Stone carry prefix for limbs in [-1, 2^12 + 1].
+
+    Returns the per-position carry map F_i = f_i . ... . f_0 as a 3-tuple
+    (F(-1), F(0), F(1)); each f(c) = floor((l + c) / 2^LB) in {-1, 0, 1}.
+    """
+    F = ((x - 1) >> LB, x >> LB, (x + 1) >> LB)
+
+    def compose(g, f):
+        gm1, g0, g1 = g
+        return tuple(jnp.where(fx < 0, gm1, jnp.where(fx > 0, g1, g0)) for fx in f)
+
+    n = x.shape[0]
+    shift = 1
+    while shift < n:
+        def sh(a, fill):
+            return _pad_axis0(a[:a.shape[0] - shift], shift, 0, fill)
+        F = compose(F, (sh(F[0], -1), sh(F[1], 0), sh(F[2], 1)))
+        shift *= 2
+    return F
+
+
+def _split_keep_top(x, rounds: int):
+    """Carry-save rounds that never split the top limb (no drops): exact
+    for any value, positive or negative.  Low limbs end in [-1, 2^12 + 1];
+    the top limb accumulates its incoming carries unchanged."""
+    for _ in range(rounds):
+        n = x.shape[0]
+        low = _pad_axis0(x[:n - 1] & MASK, 0, 1) + _pad_axis0(x[n - 1:], n - 1, 0)
+        carries = _pad_axis0(x[:n - 1] >> LB, 1, 0)
+        x = low + carries
+    return x
+
+
+def resolve(x):
+    """Exact canonicalization of limbs |l| < 2^30 whose value is
+    non-negative and fits x.shape[0] limbs -> limbs in [0, 2^12).
+
+    One pad limb is appended internally so transient top borrows (possible
+    with relaxed negative limbs) resolve exactly, then dropped: for an
+    in-contract value the padded top resolves to zero.  No (1, B)-shaped
+    intermediates anywhere (Mosaic/libtpu mishandle dim-1 buffers)."""
+    x = _pad_axis0(x, 0, 1)
+    n = x.shape[0]
+    x = _split_keep_top(x, 3)
+    low = x[:n - 1]
+    F = _ks_prefix(low)
+    carry_in = _pad_axis0(F[1][:n - 2], 1, 0)
+    return (low + carry_in) & MASK
+
+
+def is_negative(x):
+    """(B,) bool: the value represented by limbs |l| < 2^30 is negative.
+
+    Computes only the signed top (original top limb + carry out of the
+    lower limbs) — negative iff the value is."""
+    x = _pad_axis0(x, 0, 1)
+    n = x.shape[0]
+    x = _split_keep_top(x, 3)
+    low = x[:n - 1]
+    F = _ks_prefix(low)
+    # positive indices only: Mosaic lowers negative value-indexing to an
+    # unsupported dynamic_slice
+    return (x[n - 1] + F[1][n - 2]) < 0
+
+
+# ---------------------------------------------------------------------------
+# Modulus context
+# ---------------------------------------------------------------------------
+
+class FlatMod:
+    """Flat Montgomery context for an odd modulus p < 2^256, R = 2^264."""
+
+    def __init__(self, modulus: int, name: str = ""):
+        if modulus % 2 == 0 or modulus >= (1 << 256):
+            raise ValueError("modulus must be odd and < 2^256")
+        self.p = modulus
+        self.name = name
+        self.R = 1 << (L * LB)
+        self.n0inv = np.int32((-pow(modulus, -1, 1 << LB)) % (1 << LB))
+        self.p_np = bn.int_to_limbs(modulus).astype(np.int32)
+        self.p2_np = bn.int_to_limbs(2 * modulus).astype(np.int32)
+        self.r2_int = (self.R * self.R) % modulus
+        self.one_int = self.R % modulus
+
+    # -- constant helpers ---------------------------------------------------
+
+    def _col(self, limbs_np, ndim):
+        return const_col(limbs_np, ndim)
+
+    def const_mont(self, x: int) -> np.ndarray:
+        """(L, 1) canonical limbs of x in Montgomery form (numpy)."""
+        return bn.int_to_limbs((x % self.p) * self.R % self.p).reshape(L, 1)
+
+    def one_bc(self, bshape):
+        base = const_col(bn.int_to_limbs(self.one_int), len(bshape) + 1)
+        return jnp.broadcast_to(base, (L,) + tuple(bshape)).astype(jnp.int32)
+
+    def zero_bc(self, bshape):
+        return jnp.zeros((L,) + tuple(bshape), jnp.int32)
+
+    # -- core multiply (fused-m CIOS, unrolled, scan-free) -------------------
+
+    def mul(self, a, b):
+        if _is_concrete(a, b):
+            return _prim_jit(("mul", self.p), self._mul_impl)(
+                jnp.asarray(a, jnp.int32), jnp.asarray(b, jnp.int32))
+        return self._mul_impl(a, b)
+
+    def _mul_impl(self, a, b):
+        a = jnp.asarray(a, jnp.int32)
+        b = jnp.asarray(b, jnp.int32)
+        bshape = jnp.broadcast_shapes(a.shape[1:], b.shape[1:])
+        b = jnp.broadcast_to(b, (L,) + bshape)
+        p_col = self._col(self.p_np, len(bshape) + 1)
+        zero = jnp.zeros((1,) + bshape, jnp.int32)
+        acc = jnp.zeros((L,) + bshape, jnp.int32)
+        c_row = jnp.zeros(bshape, jnp.int32)
+        b0 = b[0]
+        for i in range(L):
+            ai = a[i]
+            t0 = acc[0] + c_row + ai * b0
+            m = (t0 * self.n0inv) & MASK
+            acc = acc + ai * b + m * p_col
+            c_row = (acc[0] + c_row) >> LB
+            acc = _pad_axis0(acc[1:], 0, 1)
+        acc = acc + _pad_axis0(c_row[None], 0, L - 1)
+        return split_rounds(acc, 2)
+
+    def sqr(self, a):
+        return self.mul(a, a)
+
+    # -- normalized add/sub (outputs < 2p, relaxed limbs) --------------------
+
+    def _cond_sub_2p(self, s):
+        """s in [0, 4p) relaxed -> value mod'd into [0, 2p)."""
+        p2 = self._col(self.p2_np, s.ndim)
+        d = s - p2
+        neg = is_negative(d)
+        return jnp.where(neg[None], s, split_rounds(d, 2))
+
+    def mod_add(self, a, b):
+        """(a + b) for values < 2p each -> < 2p."""
+        if _is_concrete(a, b):
+            return _prim_jit(("mod_add", self.p), self._mod_add_impl)(a, b)
+        return self._mod_add_impl(a, b)
+
+    def _mod_add_impl(self, a, b):
+        return self._cond_sub_2p(split_rounds(jnp.asarray(a) + jnp.asarray(b), 2))
+
+    def mod_sub(self, a, b):
+        """(a - b) mod 2p-window for values < 2p each -> < 2p."""
+        if _is_concrete(a, b):
+            return _prim_jit(("mod_sub", self.p), self._mod_sub_impl)(a, b)
+        return self._mod_sub_impl(a, b)
+
+    def _mod_sub_impl(self, a, b):
+        p2 = self._col(self.p2_np, jnp.asarray(a).ndim)
+        return self._cond_sub_2p(
+            split_rounds(jnp.asarray(a) + p2 - jnp.asarray(b), 2))
+
+    def neg(self, a):
+        """(-a) for value < 2p -> < 2p."""
+        if _is_concrete(a):
+            return _prim_jit(("neg", self.p), self._neg_impl)(a)
+        return self._neg_impl(a)
+
+    def _neg_impl(self, a):
+        p2 = self._col(self.p2_np, jnp.asarray(a).ndim)
+        return self._cond_sub_2p(split_rounds(p2 - jnp.asarray(a), 2))
+
+    def mul_small(self, a, k: int):
+        """a * k for 0 <= k <= 8, value < 2p in, < 2p out."""
+        if _is_concrete(a):
+            return _prim_jit(("mul_small", self.p, k),
+                             lambda x: self._mul_small_impl(x, k))(a)
+        return self._mul_small_impl(a, k)
+
+    def _mul_small_impl(self, a, k: int):
+        if not 0 <= k <= 8:
+            raise ValueError("k out of range")
+        if k == 0:
+            return self.zero_bc(jnp.asarray(a).shape[1:])
+        s = split_rounds(jnp.asarray(a) * k, 2)
+        # s < 2kp: halve the bound each step by conditionally subtracting
+        # 2p * 2^t for t = ceil(log2 k)-1 .. 0:  < 2^(t+2) p -> < 2^(t+1) p.
+        t = (k - 1).bit_length() - 1
+        while t >= 0:
+            sub = self._col(bn.int_to_limbs(2 * self.p * (1 << t)).astype(np.int32),
+                            s.ndim)
+            d = s - sub
+            neg = is_negative(d)
+            s = jnp.where(neg[None], s, split_rounds(d, 2))
+            t -= 1
+        return s
+
+    # -- conversions / predicates -------------------------------------------
+
+    def to_mont(self, a):
+        return self.mul(a, const_col(bn.int_to_limbs(self.r2_int), 2))
+
+    def from_mont(self, a):
+        one = np.zeros((L,), dtype=np.int32)
+        one[0] = 1
+        out = self.mul(a, const_col(one, 2))
+        return self.canon(out)
+
+    def canon(self, a):
+        """Relaxed (< 2p) -> canonical [0, p) limbs."""
+        if _is_concrete(a):
+            return _prim_jit(("canon", self.p), self._canon_impl)(a)
+        return self._canon_impl(a)
+
+    def _canon_impl(self, a):
+        r = resolve(a)
+        p_l = self._col(self.p_np, r.ndim)
+        d = r - p_l
+        neg = is_negative(d)
+        return jnp.where(neg[None], r, resolve(jnp.where(neg[None], r, d)))
+
+    def is_zero(self, a):
+        """value(a) == 0 mod p for relaxed a < 2p: (B,) bool."""
+        if _is_concrete(a):
+            return _prim_jit(("is_zero", self.p), self._is_zero_impl)(a)
+        return self._is_zero_impl(a)
+
+    def _is_zero_impl(self, a):
+        r = resolve(a)
+        p_l = self._col(self.p_np, r.ndim)
+        return jnp.all(r == 0, axis=0) | jnp.all(r == p_l, axis=0)
+
+    def eq(self, a, b):
+        return self.is_zero(self.mod_sub(a, b))
+
+    def select(self, cond, a, b):
+        return jnp.where(cond[None], a, b)
+
+    # -- exponentiation ------------------------------------------------------
+
+    def pow_const(self, a, e: int, window: int = 4):
+        """a^e for a fixed python-int exponent; flat windowed ladder.
+
+        ~(bits + bits/window) muls, fully unrolled: use where flat graphs
+        are acceptable (inside Pallas kernels or modest exponents).
+        """
+        if e < 0:
+            raise ValueError("negative exponent")
+        bshape = jnp.asarray(a).shape[1:]
+        if e == 0:
+            return self.one_bc(bshape)
+        tab = [self.one_bc(bshape), jnp.asarray(a)]
+        for k in range(2, 1 << window):
+            tab.append(self.mul(tab[k - 1], a))
+        digits = []
+        x = e
+        while x:
+            digits.append(x & ((1 << window) - 1))
+            x >>= window
+        digits.reverse()
+        acc = tab[digits[0]]
+        for d in digits[1:]:
+            for _ in range(window):
+                acc = self.sqr(acc)
+            if d:
+                acc = self.mul(acc, tab[d])
+        return acc
+
+    def pow_const_scan(self, a, e: int, window: int = 4):
+        """pow_const with the window loop as a lax.scan over the exponent's
+        digit array: same math, small traced graph.  For XLA contexts; the
+        Pallas kernel unrolls its own fori_loop version instead (lax.scan
+        digit consumption works there too, but the kernel prefers explicit
+        scratch-backed digit reads)."""
+        import jax.numpy as _jnp
+        from jax import lax as _lax
+        if e <= 0:
+            return self.pow_const(a, e, window)
+        bshape = _jnp.asarray(a).shape[1:]
+        tab = [self.one_bc(bshape), _jnp.asarray(a)]
+        for k in range(2, 1 << window):
+            tab.append(self.mul(tab[k - 1], a))
+        digits = []
+        x = e
+        while x:
+            digits.append(x & ((1 << window) - 1))
+            x >>= window
+        digits.reverse()
+        acc = tab[digits[0]]
+        tab_arr = _jnp.stack(tab)          # (16, L, B)
+
+        def body(acc, d):
+            for _ in range(window):
+                acc = self.sqr(acc)
+            ent = tab_arr[0]
+            for k in range(1, 1 << window):
+                ent = _jnp.where(d == k, tab_arr[k], ent)
+            return self.mul(acc, ent), None
+
+        acc, _ = _lax.scan(body, acc,
+                           _jnp.asarray(digits[1:], dtype=_jnp.int32))
+        return acc
+
+    def inv(self, a):
+        """a^(p-2) (Fermat; p prime). inv(0) = 0."""
+        if _is_concrete(a):
+            # eager: python-unrolled windows over jitted primitives
+            return self.pow_const(a, self.p - 2)
+        return self.pow_const_scan(a, self.p - 2)
+
+
+# ---------------------------------------------------------------------------
+# Canonical-limb comparisons (range checks on inputs)
+# ---------------------------------------------------------------------------
+
+def lt_const(x, c: int):
+    """(L', B) limbs (|l| < 2^13) < python int c -> (B,) bool."""
+    def impl(x):
+        c_l = const_col(bn.int_to_limbs(c, x.shape[0]), x.ndim)
+        return is_negative(x - c_l)
+    if _is_concrete(x):
+        return _prim_jit(("lt_const", c, x.shape[0]), impl)(x)
+    return impl(x)
+
+
+def eq_const(x, c: int):
+    r = resolve(x)
+    c_l = const_col(bn.int_to_limbs(c, x.shape[0]), x.ndim)
+    return jnp.all(r == c_l, axis=0)
+
+
+def is_zero_limbs(x):
+    if _is_concrete(x):
+        return _prim_jit(("is_zero_limbs",),
+                         lambda y: jnp.all(resolve(y) == 0, axis=0))(x)
+    return jnp.all(resolve(x) == 0, axis=0)

@@ -33,10 +33,22 @@ class BatchConfig:
 class BlockCutter:
     """One channel's receiver (blockcutter.go receiver struct)."""
 
-    def __init__(self, config: BatchConfig):
-        self.config = config
+    def __init__(self, config: BatchConfig, config_source=None):
+        self._static_config = config
+        # optional callable returning the live BatchConfig (channel bundle);
+        # committed config changes to batch limits then take effect on the
+        # next ordered envelope, like the reference re-reads SharedConfig
+        self._config_source = config_source
         self._pending: List[bytes] = []
         self._pending_bytes = 0
+
+    @property
+    def config(self) -> BatchConfig:
+        if self._config_source is not None:
+            cfg = self._config_source()
+            if cfg is not None:
+                return cfg
+        return self._static_config
 
     def ordered(self, env: Envelope) -> Tuple[List[List[bytes]], bool]:
         """Enqueue one envelope; returns (cut_batches, pending_remaining).

@@ -54,13 +54,43 @@ class StandardChannelProcessor:
     def __init__(self, channel_id: str, msps: Dict[str, object], provider,
                  writers_policy: SignaturePolicy,
                  absolute_max_bytes: int = 10 * 1024 * 1024,
-                 now=None):
+                 now=None, bundle_source=None):
         self.channel_id = channel_id
-        self.msps = msps
-        self.writers_policy = writers_policy
-        self.absolute_max_bytes = absolute_max_bytes
-        self.evaluator = PolicyEvaluator(msps, provider)
+        self._static_msps = msps
+        self._static_writers = writers_policy
+        self._static_max_bytes = absolute_max_bytes
+        self.provider = provider
+        self.bundle_source = bundle_source
         self._now = now or (lambda: datetime.datetime.now(datetime.timezone.utc))
+
+    # -- live config resolution (channelconfig bundle when attached) --------
+
+    @property
+    def msps(self):
+        if self.bundle_source is not None:
+            return self.bundle_source.current().msps
+        return self._static_msps
+
+    @property
+    def writers_policy(self):
+        if self.bundle_source is not None:
+            b = self.bundle_source.current()
+            return b.policy("Writers") or self._static_writers
+        return self._static_writers
+
+    @property
+    def absolute_max_bytes(self):
+        if self.bundle_source is not None:
+            return self.bundle_source.current().batch.absolute_max_bytes
+        return self._static_max_bytes
+
+    @absolute_max_bytes.setter
+    def absolute_max_bytes(self, v):
+        self._static_max_bytes = v
+
+    @property
+    def evaluator(self):
+        return PolicyEvaluator(self.msps, self.provider)
 
     def process(self, env: Envelope, raw_size: Optional[int] = None) -> MsgClass:
         """Admit or raise. Returns the message class for routing.
@@ -89,6 +119,17 @@ class StandardChannelProcessor:
                 f"message larger than AbsoluteMaxBytes "
                 f"({self.absolute_max_bytes})")
         self._sig_filter(env, sh.creator)
+        if cls is MsgClass.CONFIG and self.bundle_source is not None:
+            # config-plane validation BEFORE ordering (reference:
+            # msgprocessor ProcessConfigUpdateMsg -> configtx validation);
+            # malformed/unauthorized config updates are rejected here, not
+            # written as config blocks.
+            from fabric_tpu.config import ConfigError, validate_config_update
+            try:
+                validate_config_update(self.bundle_source.current(), env,
+                                       self.provider)
+            except ConfigError as exc:
+                raise MsgProcessorError(f"config update rejected: {exc}")
         return cls
 
     # -- individual rules ---------------------------------------------------

@@ -9,6 +9,7 @@ deliver traffic to them.
 
 from __future__ import annotations
 
+import logging
 import threading
 from typing import Callable, Dict, Optional
 
@@ -21,24 +22,39 @@ from fabric_tpu.policy import PolicyEvaluator, SignaturePolicy, SignedData
 from fabric_tpu.protocol import Block
 
 
+logger = logging.getLogger("fabric_tpu.orderer.registrar")
+
+
 class ChainSupport:
     """chainsupport.go ChainSupport: everything one channel needs."""
 
     def __init__(self, channel_id: str, ledger: BlockStore,
                  processor: StandardChannelProcessor, cutter: BlockCutter,
                  writer: BlockWriter, chain_factory: Callable[..., Chain],
-                 readers_policy: Optional[SignaturePolicy] = None):
+                 readers_policy: Optional[SignaturePolicy] = None,
+                 bundle_source=None):
         self.channel_id = channel_id
         self.ledger = ledger
         self.processor = processor
         self.cutter = cutter
         self.writer = writer
         self.readers_policy = readers_policy
+        self.bundle_source = bundle_source
         self._tip_cond = threading.Condition()
         self.chain = chain_factory(cutter=cutter, writer=writer,
                                    on_block=self._on_block)
 
     def _on_block(self, block: Block) -> None:
+        if self.bundle_source is not None:
+            # orderer-side config application: a written config block
+            # atomically swaps the channel bundle (the reference updates the
+            # bundle in multichannel BlockWriter for config blocks).
+            try:
+                from fabric_tpu.config import apply_config_block
+                apply_config_block(self.bundle_source, block,
+                                   self.processor.provider)
+            except Exception:
+                logger.exception("config block application failed")
         with self._tip_cond:
             self._tip_cond.notify_all()
 
@@ -50,15 +66,21 @@ class ChainSupport:
                 lambda: self.ledger.height >= height, timeout=timeout_s)
 
     def authorize_read(self, signed: Optional[SignedData]) -> None:
-        """deliver/acl.go sessionAC equivalent: Readers policy check."""
-        if self.readers_policy is None:
+        """deliver/acl.go sessionAC equivalent: Readers policy check,
+        re-resolved from the live bundle on every call (the reference
+        re-evaluates the ACL on config changes, deliver/acl.go)."""
+        readers = self.readers_policy
+        if self.bundle_source is not None:
+            readers = (self.bundle_source.current().policy("Readers")
+                       or readers)
+        if readers is None:
             return
         from fabric_tpu.orderer.deliver import DeliverError
         if signed is None:
             raise DeliverError("deliver request not signed and channel "
                                "enforces a Readers policy")
         if not self.processor.evaluator.evaluate_signed_data(
-                self.readers_policy, [signed]):
+                readers, [signed]):
             raise DeliverError("deliver request does not satisfy channel "
                                "Readers policy")
 
@@ -76,8 +98,8 @@ class Registrar:
                        signer=None, batch_config: Optional[BatchConfig] = None,
                        ledger: Optional[BlockStore] = None,
                        genesis: Optional[Block] = None,
-                       chain_factory: Callable[..., Chain] = SoloChain
-                       ) -> ChainSupport:
+                       chain_factory: Callable[..., Chain] = SoloChain,
+                       bundle_source=None) -> ChainSupport:
         with self._lock:
             if channel_id in self._channels:
                 raise ValueError(f"channel {channel_id!r} already exists")
@@ -85,13 +107,24 @@ class Registrar:
             if genesis is not None and ledger.height == 0:
                 ledger.add_block(genesis)
             cfg = batch_config or BatchConfig()
-            cutter = BlockCutter(cfg)
+            config_source = None
+            if bundle_source is not None:
+                def config_source(_src=bundle_source):
+                    b = _src.current().batch
+                    return BatchConfig(
+                        max_message_count=b.max_message_count,
+                        absolute_max_bytes=b.absolute_max_bytes,
+                        preferred_max_bytes=b.preferred_max_bytes,
+                        batch_timeout_s=getattr(b, "timeout_s", 2.0))
+            cutter = BlockCutter(cfg, config_source=config_source)
             writer = BlockWriter(channel_id, ledger, signer)
             processor = StandardChannelProcessor(
                 channel_id, msps, provider, writers_policy,
-                absolute_max_bytes=cfg.absolute_max_bytes)
+                absolute_max_bytes=cfg.absolute_max_bytes,
+                bundle_source=bundle_source)
             support = ChainSupport(channel_id, ledger, processor, cutter,
-                                   writer, chain_factory, readers_policy)
+                                   writer, chain_factory, readers_policy,
+                                   bundle_source=bundle_source)
             self._channels[channel_id] = support
             return support
 

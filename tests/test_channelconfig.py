@@ -1,0 +1,177 @@
+"""Channel-config plane: bundles, config txs, live rotation.
+
+Reference behaviors covered (VERDICT.md missing #1):
+  - config-tx validation: sequence rule + Admins policy authorization
+    (common/configtx/validator.go),
+  - msgprocessor rejects malformed/unauthorized config updates before
+    ordering (orderer/common/msgprocessor ProcessConfigUpdateMsg),
+  - a committed config block atomically swaps the bundle: rotating an
+    org's MSP admits the new org's txs and rejects the old org's
+    (common/channelconfig/bundle.go consumption at each use).
+"""
+import pytest
+
+from fabric_tpu.bccsp.factory import init_factories, FactoryOpts
+from fabric_tpu.config import (
+    Bundle,
+    BundleSource,
+    ChannelConfig,
+    ConfigError,
+    OrgConfig,
+    build_config_envelope,
+    default_policies,
+    validate_config_update,
+)
+from fabric_tpu.msp import CachedMSP
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.orderer import BatchConfig, BlockCutter, Registrar
+from fabric_tpu.orderer.msgprocessor import MsgProcessorError
+from fabric_tpu.policy import SignedData, parse_policy
+from fabric_tpu.protocol import KVWrite, NsRwSet, TxRwSet, build
+
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+def org_config(dev: DevOrg) -> OrgConfig:
+    mc = dev.msp_config()
+    return OrgConfig(mspid=dev.mspid,
+                     root_certs=tuple(mc.root_certs_pem),
+                     admins=tuple(mc.admin_certs_pem),
+                     intermediate_certs=tuple(mc.intermediate_certs_pem),
+                     crls=tuple(mc.crls_pem))
+
+
+@pytest.fixture()
+def orgs():
+    return DevOrg("Org1"), DevOrg("Org2"), DevOrg("Org3")
+
+
+def make_config(channel_id, devs, sequence):
+    mspids = [d.mspid for d in devs]
+    return ChannelConfig(
+        channel_id=channel_id,
+        sequence=sequence,
+        orgs=tuple(org_config(d) for d in devs),
+        policies=default_policies(mspids),
+    )
+
+
+def test_bundle_materializes_msps_and_policies(orgs):
+    o1, o2, _ = orgs
+    cfg = make_config("ch", [o1, o2], 0)
+    b = Bundle(cfg)
+    assert set(b.msps) == {"Org1", "Org2"}
+    assert b.policy("Admins") is not None
+    assert b.has_capability("V2_0")
+    # serde roundtrip is exact
+    assert ChannelConfig.deserialize(cfg.serialize()).to_dict() == cfg.to_dict()
+
+
+def test_config_update_sequence_and_admins(orgs, provider):
+    o1, o2, o3 = orgs
+    src = BundleSource(Bundle(make_config("ch", [o1, o2], 0)))
+
+    # good update: sequence 1, signed by both admins (majority of 2)
+    new_cfg = make_config("ch", [o1, o2, o3], 1)
+    env = build_config_envelope(new_cfg, [o1.admin, o2.admin])
+    got = validate_config_update(src.current(), env, provider)
+    assert [o.mspid for o in got.orgs] == ["Org1", "Org2", "Org3"]
+
+    # wrong sequence
+    bad_seq = build_config_envelope(make_config("ch", [o1, o2, o3], 5),
+                                    [o1.admin, o2.admin])
+    with pytest.raises(ConfigError, match="sequence"):
+        validate_config_update(src.current(), bad_seq, provider)
+
+    # not enough admins (1 of 2 < majority)
+    under = build_config_envelope(new_cfg, [o1.admin])
+    with pytest.raises(ConfigError, match="Admins"):
+        validate_config_update(src.current(), under, provider)
+
+    # non-admin signer
+    member_signed = build_config_envelope(new_cfg, [o1.new_identity("m"),
+                                                    o2.new_identity("m2")])
+    with pytest.raises(ConfigError, match="Admins"):
+        validate_config_update(src.current(), member_signed, provider)
+
+    # sequence regression guard on the source itself
+    src.update(Bundle(got))
+    with pytest.raises(ConfigError, match="regression"):
+        src.update(Bundle(make_config("ch", [o1], 1)))
+
+
+def test_config_rotation_through_ordering(orgs, provider):
+    """End-to-end: config tx ordered through a solo chain rotates Org2->Org3;
+    afterwards Org3 txs are admitted and Org2 txs rejected by the writers
+    filter, and the deliver ACL honors the new Readers policy."""
+    o1, o2, o3 = orgs
+    genesis_cfg = make_config("ch", [o1, o2], 0)
+    src = BundleSource(Bundle(genesis_cfg))
+
+    registrar = Registrar()
+    support = registrar.create_channel(
+        "ch", None, provider,
+        writers_policy=None,
+        signer=o1.new_identity("orderer"),
+        batch_config=BatchConfig(max_message_count=1),
+        bundle_source=src)
+
+    def normal_env(dev):
+        rwset = TxRwSet((NsRwSet("cc", writes=(KVWrite("k", b"v"),)),))
+        return build.endorser_tx("ch", "cc", "1.0", rwset,
+                                 dev.new_identity("client"),
+                                 [dev.new_identity("e")])
+
+    # Org2 writes fine before rotation; Org3 is unknown
+    assert support.processor.process(normal_env(o2)).name == "NORMAL"
+    with pytest.raises(MsgProcessorError):
+        support.processor.process(normal_env(o3))
+
+    # order the rotation config tx (Org1 + Org2 admins authorize)
+    new_cfg = make_config("ch", [o1, o3], 1)
+    cfg_env = build_config_envelope(new_cfg, [o1.admin, o2.admin])
+    assert support.processor.process(cfg_env).name == "CONFIG"
+    support.chain.configure(cfg_env)   # solo: cuts + writes a config block
+
+    assert src.current().sequence == 1
+    assert set(src.current().msps) == {"Org1", "Org3"}
+
+    # post-rotation admission flips
+    assert support.processor.process(normal_env(o3)).name == "NORMAL"
+    with pytest.raises(MsgProcessorError):
+        support.processor.process(normal_env(o2))
+
+    # deliver ACL follows the new Readers policy
+    ident3 = o3.new_identity("reader")
+    payload = b"seekinfo"
+    sd3 = SignedData(payload, ident3.serialize(), ident3.sign(payload))
+    support.authorize_read(sd3)  # no raise
+    ident2 = o2.new_identity("reader")
+    sd2 = SignedData(payload, ident2.serialize(), ident2.sign(payload))
+    from fabric_tpu.orderer.deliver import DeliverError
+    with pytest.raises(DeliverError):
+        support.authorize_read(sd2)
+
+
+def test_unauthorized_config_rejected_at_admission(orgs, provider):
+    o1, o2, o3 = orgs
+    src = BundleSource(Bundle(make_config("ch", [o1, o2], 0)))
+    registrar = Registrar()
+    support = registrar.create_channel(
+        "ch", None, provider, writers_policy=None,
+        signer=o1.new_identity("orderer"),
+        batch_config=BatchConfig(max_message_count=1),
+        bundle_source=src)
+    # unknown-org signer: rejected (fails creator deserialization)
+    rogue = build_config_envelope(make_config("ch", [o3], 1), [o3.admin])
+    with pytest.raises(MsgProcessorError):
+        support.processor.process(rogue)
+    # known member but not admin: rejected by the config plane specifically
+    sneaky = build_config_envelope(make_config("ch", [o1, o3], 1),
+                                   [o2.new_identity("m")])
+    with pytest.raises(MsgProcessorError, match="config update rejected"):
+        support.processor.process(sneaky)
+    assert src.current().sequence == 0

@@ -1,0 +1,171 @@
+"""Windowed flat-field P-256 verify (round-2 kernel): differential tests.
+
+Oracle chain: OpenSSL (cryptography) semantics == old shamir-ladder path
+(ops/p256.verify_words, itself differentially tested in test_p256.py) ==
+new windowed flat path (ops/ecp256) == Pallas kernel (TPU only).
+
+Also stress-tests the flat field layer at adversarial values (limb
+patterns that maximize carry ripple, values straddling k*p boundaries).
+"""
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+import jax
+
+from fabric_tpu.ops import bignum as bn
+from fabric_tpu.ops import ecp256 as ec
+from fabric_tpu.ops import flatfield as ff
+from fabric_tpu.ops import p256
+
+from cryptography.hazmat.primitives.asymmetric import ec as cec
+from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
+from cryptography.hazmat.primitives import hashes
+
+
+def to_l(vals):
+    return np.asarray(bn.ints_to_limbs(vals), np.int32)
+
+
+def from_l_signed(a):
+    arr = np.asarray(a)
+    return [sum(int(arr[i, b]) << (12 * i) for i in range(arr.shape[0]))
+            for b in range(arr.shape[1])]
+
+
+# ---------------------------------------------------------------------------
+# flat field layer
+# ---------------------------------------------------------------------------
+
+P = ec.P
+
+
+def test_flatfield_mul_matches_ints_stress():
+    rng = random.Random(11)
+    vals = ([rng.randrange(P) for _ in range(16)] +
+            [0, 1, 2, P - 1, P - 2, P, P + 1, 2 * P - 1,
+             (1 << 256) - 1, (1 << 252) - 1, 0xFFF,
+             int("0" + "FFF" * 21, 16)])
+    a = to_l(vals)
+    b = to_l(list(reversed(vals)))
+    Rinv = pow(ec.fp.R, -1, P)
+    got = from_l_signed(ec.fp.mul(a, b))
+    for g, x, y in zip(got, vals, reversed(vals)):
+        assert (g - x * y * Rinv) % P == 0
+        assert 0 <= g < 2 * P
+    # chained: relaxed-limb inputs
+    c = ec.fp.mul(a, b)
+    got2 = from_l_signed(ec.fp.mul(c, c))
+    for g, g1 in zip(got2, got):
+        assert (g - g1 * g1 * Rinv) % P == 0
+
+
+def test_flatfield_carry_ripple_exactness():
+    # values engineered so carries ripple across the whole limb array
+    cases = [(1 << 252) - 1, (1 << 252), (1 << 252) + 1,
+             int("FFF" * 22, 16) % (1 << 264) - 1]
+    x = to_l([c % (1 << 264) for c in cases])
+    x0 = np.array(x)
+    x0[0] += 1
+    r = from_l_signed(ff.resolve(np.asarray(x0)))
+    for g, c in zip(r, cases):
+        assert g == (c % (1 << 264)) + 1
+
+
+def test_flatfield_comparisons():
+    N = ec.N
+    xs = to_l([0, 1, N - 1, N, N + 1, P - 1, P, 2 * P - 1])
+    lt = np.asarray(ff.lt_const(xs, N))
+    assert list(lt) == [True, True, True, False, False, False, False, False]
+    z = to_l([0, P, 2 * P - 2, 1])
+    iz = np.asarray(ec.fp.is_zero(z))
+    assert list(iz) == [True, True, False, False]
+
+
+def test_flatfield_mod_ops_bounds():
+    rng = random.Random(5)
+    vals_a = [rng.randrange(2 * P) for _ in range(32)]
+    vals_b = [rng.randrange(2 * P) for _ in range(32)]
+    a, b = to_l(vals_a), to_l(vals_b)
+    for op, ref in [(ec.fp.mod_add(a, b), [x + y for x, y in zip(vals_a, vals_b)]),
+                    (ec.fp.mod_sub(a, b), [x - y for x, y in zip(vals_a, vals_b)]),
+                    (ec.fp.mul_small(a, 8), [x * 8 for x in vals_a]),
+                    (ec.fp.neg(a), [-x for x in vals_a])]:
+        got = from_l_signed(op)
+        for g, w in zip(got, ref):
+            assert (g - w) % P == 0
+            assert 0 <= g < 2 * P
+        arr = np.asarray(op)
+        assert arr.max() < (1 << 13) and arr.min() > -(1 << 7)
+
+
+# ---------------------------------------------------------------------------
+# full verify differential
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cases():
+    rng = random.Random(77)
+    keys = [cec.generate_private_key(cec.SECP256R1()) for _ in range(3)]
+    out = []
+    for i in range(12):
+        key = keys[i % 3]
+        pub = key.public_key().public_numbers()
+        msg = rng.randbytes(40)
+        digest = int.from_bytes(hashlib.sha256(msg).digest(), "big")
+        r, s = decode_dss_signature(key.sign(msg, cec.ECDSA(hashes.SHA256())))
+        if s > p256.HALF_N:
+            s = p256.N - s
+        good = i % 3 != 2
+        if not good:
+            digest = (digest + 1) % (1 << 256)
+        out.append([pub.x, pub.y, r, s, digest, good])
+    x, y, r, s, e = out[0][:5]
+    out += [
+        [x, y, 0, s, e, False],                 # r = 0
+        [x, y, r, 0, e, False],                 # s = 0
+        [x, y, p256.N, s, e, False],            # r = n
+        [x, y, r, p256.N, e, False],            # s = n
+        [x, y, r, p256.N - s, e, False],        # high-S rejected
+        [x + 1, y, r, s, e, False],             # off-curve Q
+        [x, y, p256.N - 1, s, e, False],        # in-range wrong r
+        [0, 0, r, s, e, False],                 # Q = (0,0) off-curve
+        [x, y, 1, 1, 0, False],                 # degenerate-ish values
+    ]
+    return out
+
+
+def _args(cases):
+    qx, qy, r, s, e, _ = zip(*cases)
+    return [np.asarray(p256.ints_to_words(list(v)))
+            for v in (qx, qy, r, s, e)]
+
+
+def test_windowed_matches_reference_and_old_path(cases):
+    want = [bool(c[5]) for c in cases]
+    args = _args(cases)
+    new = list(np.asarray(ec.verify_words_xla(*args)))
+    assert new == want
+    old = list(np.asarray(p256.verify_words(*args)))
+    assert new == old
+
+
+def test_low_s_flag_parity(cases):
+    x, y, r, s, e, _ = cases[0]
+    high_s = p256.N - s
+    args = _args([[x, y, r, high_s, e, None]])
+    assert not bool(np.asarray(ec.verify_words_xla(*args))[0])
+    relaxed = np.asarray(ec.verify_words_xla(*args, require_low_s=False))
+    assert bool(relaxed[0])
+
+
+@pytest.mark.skipif(jax.default_backend() == "cpu",
+                    reason="Pallas kernel requires TPU")
+def test_pallas_matches_xla(cases):
+    from fabric_tpu.ops import p256_pallas
+    args = _args(cases)
+    xla = list(np.asarray(ec.verify_words_xla(*args)))
+    pl_out = list(np.asarray(p256_pallas.verify_words(*args)))
+    assert pl_out == xla
