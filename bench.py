@@ -107,11 +107,13 @@ def bench_tpu(cases, batch: int, iters: int = 5):
     else:
         from fabric_tpu.ops import bignum as bn, ecp256
         tab = ecp256.comb_table_f32()
-        jf = jax.jit(ecp256.verify_body, static_argnames=("require_low_s",))
 
-        def fn(*a):
-            limbs = [bn.words_be_to_limbs(v) for v in a]
-            return jf(*limbs, tab, require_low_s=True)
+        # the words->limbs conversion must live INSIDE the jit: eagerly it
+        # costs dozens of tunneled device dispatches per call
+        def whole(qx, qy, r, s, e):
+            limbs = [bn.words_be_to_limbs(v) for v in (qx, qy, r, s, e)]
+            return ecp256.verify_body(*limbs, tab, require_low_s=True)
+        fn = jax.jit(whole)
 
     t0 = time.perf_counter()
     out = fn(*args)
@@ -150,7 +152,7 @@ def bench_block_p50(provider, n_tx: int = 10000, endorsers: int = 3,
         rwset = TxRwSet((NsRwSet("cc", writes=(
             KVWrite(f"k{i}", b"v"),)),))
         envs.append(build.endorser_tx("bench", "cc", "1.0", rwset,
-                                      creator, endorser_ids).serialize())
+                                      creator, endorser_ids))
     blk = build.new_block(1, b"prev", envs)
     policy = parse_policy(
         "OutOf(%d%s)" % (endorsers,

@@ -91,16 +91,17 @@ class JaxTpuProvider(prov.Provider):
                         self._fns[key] = lambda *a: ecp256.verify_words_xla(
                             *a, require_low_s=low_s)
                     else:
-                        jf = jax.jit(ecp256.verify_body,
-                                     static_argnames=("require_low_s",))
                         from fabric_tpu.ops import bignum as _bn
                         tab = ecp256.comb_table_f32()
 
-                        def run(qx, qy, r, s, e, _jf=jf, _tab=tab):
+                        # words->limbs conversion inside the jit: eager
+                        # conversion costs tunneled dispatches per call
+                        def whole(qx, qy, r, s, e, _tab=tab):
                             args = [_bn.words_be_to_limbs(v)
                                     for v in (qx, qy, r, s, e)]
-                            return _jf(*args, _tab, require_low_s=low_s)
-                        self._fns[key] = run
+                            return ecp256.verify_body(
+                                *args, _tab, require_low_s=low_s)
+                        self._fns[key] = jax.jit(whole)
             elif scheme == SCHEME_ED25519:
                 from fabric_tpu.ops import ed25519
                 if self.mesh is not None:

@@ -1,0 +1,12 @@
+"""Authenticated communication plane (the reference's internal/pkg/comm).
+
+SecureChannel: mutually authenticated AEAD connections bound to MSP
+identities; RpcServer/RpcConnection: unary + streaming + one-way RPC on
+top — the transport under Broadcast/Deliver/cluster/gossip.
+"""
+
+from .secure import HandshakeError, SecureChannel, SecureServer, dial
+from .rpc import RpcConnection, RpcError, RpcServer, connect
+
+__all__ = ["SecureChannel", "SecureServer", "HandshakeError", "dial",
+           "RpcConnection", "RpcServer", "RpcError", "connect"]

@@ -1,0 +1,234 @@
+"""Request/response + one-way messaging over SecureChannel.
+
+The service plane of the framework: Broadcast/Deliver/Endorser/cluster
+RPCs all speak this little protocol, the role the reference gives gRPC
+(/root/reference/internal/pkg/comm/server.go, orderer/common/cluster/comm.go:116).
+
+Frames (inside the encrypted channel) are serde dicts:
+  {"kind": "req",  "id": n, "method": str, "body": dict}
+  {"kind": "resp", "id": n, "ok": bool, "body": dict | "error": str}
+  {"kind": "cast", "method": str, "body": dict}      (one-way)
+Responses may be streamed: {"kind": "stream", "id": n, "body": dict,
+"done": bool} — used by Deliver.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Dict, Optional
+
+from fabric_tpu.utils import serde
+
+from .secure import SecureChannel, SecureServer, dial
+
+logger = logging.getLogger("fabric_tpu.comm.rpc")
+
+
+class RpcError(Exception):
+    pass
+
+
+class RpcConnection:
+    """Client side: concurrent requests over one channel."""
+
+    def __init__(self, channel: SecureChannel):
+        self.channel = channel
+        self._next_id = 1
+        self._lock = threading.Lock()
+        self._waiters: Dict[int, "_Waiter"] = {}
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    def _read_loop(self) -> None:
+        try:
+            while True:
+                msg = serde.decode(self.channel.recv())
+                wid = msg.get("id")
+                with self._lock:
+                    w = self._waiters.get(wid)
+                if w is not None:
+                    w.push(msg)
+        except Exception:
+            with self._lock:
+                self._closed = True
+                waiters = list(self._waiters.values())
+            for w in waiters:
+                w.push({"kind": "resp", "ok": False,
+                        "error": "connection closed"})
+
+    def call(self, method: str, body: dict, timeout: float = 30.0) -> dict:
+        w = self._start(method, body)
+        msg = w.next(timeout)
+        self._finish(w)
+        if msg.get("kind") == "resp" and not msg.get("ok", False):
+            raise RpcError(msg.get("error", "remote error"))
+        return msg.get("body", {})
+
+    def call_stream(self, method: str, body: dict):
+        """Generator of streamed bodies until done.  Abandoning the
+        generator sends a cancel so the server stops producing."""
+        w = self._start(method, body)
+        finished = False
+        try:
+            while True:
+                msg = w.next(timeout=60.0)
+                if msg.get("kind") == "resp":
+                    finished = True
+                    if not msg.get("ok", False):
+                        raise RpcError(msg.get("error", "remote error"))
+                    return
+                yield msg.get("body", {})
+                if msg.get("done"):
+                    finished = True
+                    return
+        finally:
+            self._finish(w)
+            if not finished:
+                try:
+                    self.channel.send(serde.encode(
+                        {"kind": "cancel", "id": w.rid}))
+                except Exception:
+                    pass
+
+    def cast(self, method: str, body: dict) -> None:
+        self.channel.send(serde.encode(
+            {"kind": "cast", "method": method, "body": body}))
+
+    def _start(self, method, body) -> "_Waiter":
+        with self._lock:
+            if self._closed:
+                raise RpcError("connection closed")
+            rid = self._next_id
+            self._next_id += 1
+            w = _Waiter(rid)
+            self._waiters[rid] = w
+        self.channel.send(serde.encode(
+            {"kind": "req", "id": rid, "method": method, "body": body}))
+        return w
+
+    def _finish(self, w: "_Waiter") -> None:
+        with self._lock:
+            self._waiters.pop(w.rid, None)
+
+    def close(self) -> None:
+        self.channel.close()
+
+
+class _Waiter:
+    def __init__(self, rid: int):
+        self.rid = rid
+        self._cond = threading.Condition()
+        self._queue = []
+
+    def push(self, msg) -> None:
+        with self._cond:
+            self._queue.append(msg)
+            self._cond.notify()
+
+    def next(self, timeout: float):
+        with self._cond:
+            if not self._cond.wait_for(lambda: self._queue, timeout=timeout):
+                raise RpcError("rpc timeout")
+            return self._queue.pop(0)
+
+
+class RpcServer:
+    """Server side: SecureServer + method dispatch.
+
+    handler(method, body, peer_identity) -> dict           (unary)
+    stream handlers yield dicts; register with `serve_stream`.
+    cast handlers return None; register with `serve_cast`.
+    """
+
+    def __init__(self, host: str, port: int, signer, msps: Dict):
+        self._unary: Dict[str, Callable] = {}
+        self._stream: Dict[str, Callable] = {}
+        self._cast: Dict[str, Callable] = {}
+        self._cancelled: dict = {}         # (channel id, rid) -> True
+        self._cancel_lock = threading.Lock()
+        self.server = SecureServer(host, port, signer, msps, self._on_channel)
+
+    @property
+    def addr(self):
+        return self.server.addr
+
+    def serve(self, method: str, fn: Callable) -> None:
+        self._unary[method] = fn
+
+    def serve_stream(self, method: str, fn: Callable) -> None:
+        self._stream[method] = fn
+
+    def serve_cast(self, method: str, fn: Callable) -> None:
+        self._cast[method] = fn
+
+    def start(self) -> "RpcServer":
+        self.server.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.stop()
+
+    def _on_channel(self, ch: SecureChannel) -> None:
+        threading.Thread(target=self._conn_loop, args=(ch,),
+                         daemon=True).start()
+
+    def _conn_loop(self, ch: SecureChannel) -> None:
+        try:
+            while True:
+                msg = serde.decode(ch.recv())
+                kind = msg.get("kind")
+                if kind == "cast":
+                    fn = self._cast.get(msg["method"])
+                    if fn is not None:
+                        try:
+                            fn(msg.get("body", {}), ch.peer_identity)
+                        except Exception:
+                            logger.exception("cast handler %s failed",
+                                             msg["method"])
+                    continue
+                if kind == "cancel":
+                    with self._cancel_lock:
+                        self._cancelled[(id(ch), msg.get("id"))] = True
+                    continue
+                if kind != "req":
+                    continue
+                threading.Thread(
+                    target=self._handle_req, args=(ch, msg), daemon=True
+                ).start()
+        except Exception:
+            ch.close()
+
+    def _handle_req(self, ch: SecureChannel, msg: dict) -> None:
+        rid = msg["id"]
+        method = msg["method"]
+        body = msg.get("body", {})
+        try:
+            if method in self._stream:
+                key = (id(ch), rid)
+                for item in self._stream[method](body, ch.peer_identity):
+                    with self._cancel_lock:
+                        if self._cancelled.pop(key, False):
+                            return
+                    ch.send(serde.encode({"kind": "stream", "id": rid,
+                                          "body": item, "done": False}))
+                ch.send(serde.encode({"kind": "resp", "id": rid, "ok": True,
+                                      "body": {}}))
+                return
+            fn = self._unary.get(method)
+            if fn is None:
+                raise RpcError(f"unknown method {method!r}")
+            out = fn(body, ch.peer_identity)
+            ch.send(serde.encode({"kind": "resp", "id": rid, "ok": True,
+                                  "body": out or {}}))
+        except Exception as exc:
+            try:
+                ch.send(serde.encode({"kind": "resp", "id": rid, "ok": False,
+                                      "error": str(exc)[:500]}))
+            except Exception:
+                pass
+
+
+def connect(addr, signer, msps: Dict, timeout: float = 10.0) -> RpcConnection:
+    return RpcConnection(dial(addr, signer, msps, timeout=timeout))

@@ -1,0 +1,11 @@
+"""Runnable node processes (server binaries) for the framework.
+
+The reference ships peer/orderer binaries (/root/reference/cmd/); here
+each node is `python -m fabric_tpu.node.<kind> <config.json>` composed
+from the same library planes, with fabric_tpu.node.provision as the
+cryptogen/configtxgen equivalent.
+"""
+
+from .provision import provision_orderers
+
+__all__ = ["provision_orderers"]

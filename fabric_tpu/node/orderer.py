@@ -1,0 +1,162 @@
+"""Runnable orderer node: Broadcast/Deliver + Raft cluster over sockets.
+
+The reference's orderer server binary (VERDICT.md missing #9 / #3):
+/root/reference/orderer/common/server/main.go wires localconfig, the
+multichannel registrar, the cluster transport, and the AtomicBroadcast
+gRPC service into one process.  This module is the same composition for
+this framework: a JSON node config + MSP material on disk produce a
+process serving `broadcast` (unary), `deliver` (stream), and `raft.step`
+(cast) over the authenticated RPC plane.
+
+Run:  python -m fabric_tpu.node.orderer <node.json>
+Provision a dev network:  fabric_tpu.node.provision.provision_orderers().
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+from typing import Dict, Optional
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.comm.rpc import RpcServer
+from fabric_tpu.config import Bundle, BundleSource, ChannelConfig
+from fabric_tpu.ledger.blkstorage import BlockStore
+from fabric_tpu.msp.identity import SigningIdentity
+from fabric_tpu.orderer import BroadcastHandler, DeliverHandler, Registrar
+from fabric_tpu.orderer.blockcutter import BatchConfig
+from fabric_tpu.orderer.cluster import ClusterService
+from fabric_tpu.orderer.consensus import RaftChain
+from fabric_tpu.orderer.deliver import SeekInfo
+from fabric_tpu.orderer.raft import RaftNode
+from fabric_tpu.policy import SignedData
+from fabric_tpu.protocol import Envelope
+
+logger = logging.getLogger("fabric_tpu.node.orderer")
+
+
+def load_signing_identity(mspid: str, cert_pem: bytes, key_pem: bytes,
+                          scheme: str = None) -> SigningIdentity:
+    from cryptography import x509
+    from cryptography.hazmat.primitives import serialization
+    from fabric_tpu.bccsp.sw import SigningKey
+
+    from cryptography.hazmat.primitives.asymmetric import ec as _ec
+    from fabric_tpu.bccsp import SCHEME_ED25519, SCHEME_P256
+
+    cert = x509.load_pem_x509_certificate(cert_pem)
+    key = serialization.load_pem_private_key(key_pem, password=None)
+    if scheme is None:
+        scheme = (SCHEME_P256 if isinstance(key, _ec.EllipticCurvePrivateKey)
+                  else SCHEME_ED25519)
+    return SigningIdentity(mspid, cert, SigningKey(scheme, key))
+
+
+class OrdererNode:
+    """One orderer process (library form; `main` wraps it)."""
+
+    def __init__(self, cfg: dict, data_dir: str):
+        self.cfg = cfg
+        self.provider = init_factories(FactoryOpts(default="SW"))
+        self.signer = load_signing_identity(
+            cfg["mspid"], cfg["cert_pem"].encode(), cfg["key_pem"].encode())
+
+        channel_cfg = ChannelConfig.deserialize(
+            bytes.fromhex(cfg["channel_config_hex"]))
+        self.bundle_source = BundleSource(Bundle(channel_cfg))
+        msps = self.bundle_source.current().msps
+
+        self.registrar = Registrar()
+        self.raft_id = int(cfg["raft_id"])
+        peer_ids = [int(p["raft_id"]) for p in cfg["cluster"]]
+        node = RaftNode(self.raft_id, peer_ids,
+                        wal_path=f"{data_dir}/wal.bin",
+                        snap_path=f"{data_dir}/snap.bin")
+        batch = channel_cfg.batch
+        self.support = self.registrar.create_channel(
+            channel_cfg.channel_id, msps, self.provider,
+            writers_policy=None,
+            signer=self.signer,
+            batch_config=BatchConfig(
+                max_message_count=batch.max_message_count,
+                absolute_max_bytes=batch.absolute_max_bytes,
+                preferred_max_bytes=batch.preferred_max_bytes,
+                batch_timeout_s=batch.timeout_s),
+            ledger=BlockStore(f"{data_dir}/ledger"),
+            chain_factory=lambda cutter, writer, on_block: RaftChain(
+                node, cutter, writer, on_block=on_block),
+            bundle_source=self.bundle_source)
+
+        self.broadcast = BroadcastHandler(self.registrar)
+        self.deliver = DeliverHandler(self.registrar)
+        self.rpc = RpcServer(cfg.get("host", "127.0.0.1"), int(cfg["port"]),
+                             self.signer, msps)
+        peers = {int(p["raft_id"]): (p.get("host", "127.0.0.1"), int(p["port"]))
+                 for p in cfg["cluster"] if int(p["raft_id"]) != self.raft_id}
+        peer_cns = {int(p["raft_id"]): p["cn"]
+                    for p in cfg["cluster"] if p.get("cn")}
+        self.cluster = ClusterService(self.support.chain, self.rpc,
+                                      self.signer, msps, peers,
+                                      peer_cns=peer_cns)
+        self.rpc.serve("broadcast", self._rpc_broadcast)
+        self.rpc.serve("status", self._rpc_status)
+        self.rpc.serve_stream("deliver", self._rpc_deliver)
+
+    # -- rpc handlers --------------------------------------------------------
+
+    def _rpc_broadcast(self, body: dict, peer_identity) -> dict:
+        env = Envelope.deserialize(body["envelope"])
+        resp = self.broadcast.handle(env)
+        return {"status": resp.status, "info": resp.info or "",
+                "leader": getattr(resp, "leader_hint", 0) or 0}
+
+    def _rpc_deliver(self, body: dict, peer_identity):
+        seek = SeekInfo(start=body.get("start", 0), stop=body.get("stop"),
+                        behavior=body.get("behavior", "block_until_ready"))
+        sd = None
+        if body.get("signed_data"):
+            s = body["signed_data"]
+            sd = SignedData(s["data"], s["identity"], s["signature"])
+        for block in self.deliver.deliver(body["channel"], seek, sd,
+                                          timeout_s=body.get("timeout_s", 30)):
+            yield {"block": block.serialize()}
+
+    def _rpc_status(self, body: dict, peer_identity) -> dict:
+        from fabric_tpu.orderer import raft as raftmod
+        node = self.support.chain.node
+        return {"raft_id": self.raft_id, "role": node.role,
+                "leader": node.leader_id or 0, "term": node.term,
+                "height": self.support.ledger.height}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "OrdererNode":
+        self.rpc.start()
+        self.cluster.start()
+        logger.info("orderer %d serving on %s", self.raft_id, self.rpc.addr)
+        return self
+
+    def stop(self) -> None:
+        self.cluster.stop()
+        self.support.chain.halt()
+        self.rpc.stop()
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m fabric_tpu.node.orderer <node.json>",
+              file=sys.stderr)
+        return 2
+    logging.basicConfig(level=logging.INFO)
+    with open(argv[0]) as f:
+        cfg = json.load(f)
+    node = OrdererNode(cfg, data_dir=cfg["data_dir"]).start()
+    threading.Event().wait()   # serve until killed
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

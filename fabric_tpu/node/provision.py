@@ -1,0 +1,104 @@
+"""Dev-network provisioning: crypto material + node configs on disk.
+
+The composition of the reference's cryptogen + configtxgen
+(/root/reference/internal/cryptogen, internal/configtxgen): generates an
+orderer org, per-node signing identities, the channel's genesis
+ChannelConfig, and one JSON config file per orderer process, ready for
+`python -m fabric_tpu.node.orderer <node.json>`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import List, Tuple
+
+from cryptography.hazmat.primitives import serialization
+
+from fabric_tpu.config import BatchConfig, ChannelConfig, OrgConfig, default_policies
+from fabric_tpu.msp.ca import DevOrg
+
+
+def _key_pem(key) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption())
+
+
+def _cert_pem(cert) -> bytes:
+    return cert.public_bytes(serialization.Encoding.PEM)
+
+
+def provision_orderers(base_dir: str, n: int, channel_id: str = "ch",
+                       base_port: int = 0,
+                       batch: BatchConfig = None) -> List[str]:
+    """Create material for an n-node orderer cluster; returns the list of
+    node-config paths.  base_port=0 lets the OS pick ports (they are
+    reserved by binding momentarily, then released)."""
+    import socket
+
+    org = DevOrg("OrdererOrg")
+    mc = org.msp_config()
+
+    ports = []
+    socks = []
+    for i in range(n):
+        if base_port:
+            ports.append(base_port + i)
+        else:
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            socks.append(s)
+    for s in socks:
+        s.close()
+
+    cfg = ChannelConfig(
+        channel_id=channel_id,
+        sequence=0,
+        orgs=(OrgConfig(mspid="OrdererOrg",
+                        root_certs=tuple(mc.root_certs_pem),
+                        admins=tuple(mc.admin_certs_pem)),),
+        policies=default_policies(["OrdererOrg"]),
+        batch=batch or BatchConfig(max_message_count=2, timeout_s=0.2),
+        consenters=tuple(range(1, n + 1)),
+    )
+    cfg_hex = cfg.serialize().hex()
+
+    cluster = [{"raft_id": i + 1, "host": "127.0.0.1", "port": ports[i],
+                "cn": f"orderer{i + 1}@OrdererOrg"}
+               for i in range(n)]
+    paths = []
+    for i in range(n):
+        node_dir = os.path.join(base_dir, f"orderer{i + 1}")
+        os.makedirs(node_dir, exist_ok=True)
+        cert, key = org.issuer.issue(f"orderer{i + 1}@OrdererOrg")
+        node_cfg = {
+            "mspid": "OrdererOrg",
+            "raft_id": i + 1,
+            "host": "127.0.0.1",
+            "port": ports[i],
+            "cert_pem": _cert_pem(cert).decode(),
+            "key_pem": _key_pem(key).decode(),
+            "channel_config_hex": cfg_hex,
+            "cluster": cluster,
+            "data_dir": node_dir,
+        }
+        path = os.path.join(base_dir, f"orderer{i + 1}.json")
+        with open(path, "w") as f:
+            json.dump(node_cfg, f)
+        paths.append(path)
+
+    # client material (for tests/tools): one member + the admin
+    client_cert, client_key = org.issuer.issue("client@OrdererOrg")
+    with open(os.path.join(base_dir, "client.json"), "w") as f:
+        json.dump({
+            "mspid": "OrdererOrg",
+            "cert_pem": _cert_pem(client_cert).decode(),
+            "key_pem": _key_pem(client_key).decode(),
+            "channel_config_hex": cfg_hex,
+            "cluster": cluster,
+            "channel_id": channel_id,
+        }, f)
+    return paths

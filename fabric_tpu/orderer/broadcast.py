@@ -29,6 +29,7 @@ STATUS_UNAVAILABLE = 503
 class BroadcastResponse:
     status: int
     info: str = ""
+    leader_hint: int = 0   # raft id of the current leader, when known
 
 
 class BroadcastHandler:
@@ -58,7 +59,8 @@ class BroadcastHandler:
                 support.chain.order(env)
         except NotLeaderError as e:
             # SERVICE_UNAVAILABLE + leader hint so clients re-submit there
-            return BroadcastResponse(STATUS_UNAVAILABLE, str(e))
+            return BroadcastResponse(STATUS_UNAVAILABLE, str(e),
+                                     leader_hint=e.leader_id or 0)
         except ChainHaltedError as e:
             return BroadcastResponse(STATUS_UNAVAILABLE, str(e))
         return BroadcastResponse(STATUS_SUCCESS)

@@ -1,0 +1,224 @@
+"""Socket transport for the Raft orderer cluster.
+
+Round-1 left raft messaging in test callbacks (VERDICT.md component #43);
+this promotes it to a production transport: each orderer exposes a
+`raft.step` cast over the authenticated RPC plane
+(fabric_tpu/comm/{secure,rpc}.py — the slot of the reference's
+orderer/common/cluster/comm.go:116 Step RPC over mTLS gRPC), with lazy
+dialing, reconnection, and a driver thread that runs the chain clock
+(raft ticks, batch timeouts) and ships Ready messages.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+from fabric_tpu.comm.rpc import RpcServer, connect
+from fabric_tpu.orderer import raft as raftmod
+
+logger = logging.getLogger("fabric_tpu.orderer.cluster")
+
+
+def _cert_cn(identity) -> str:
+    from cryptography.x509.oid import NameOID
+    try:
+        attrs = identity.cert.subject.get_attributes_for_oid(
+            NameOID.COMMON_NAME)
+        return attrs[0].value if attrs else ""
+    except Exception:
+        return ""
+
+
+class _PeerSender:
+    """Queue + thread per peer: dials with backoff off the driver thread,
+    drops raft messages when the peer is unreachable (raft retransmits),
+    and always closes replaced connections (no fd/thread leaks)."""
+
+    MAX_QUEUE = 256
+
+    def __init__(self, nid: int, addr, signer, msps):
+        self.nid = nid
+        self.addr = tuple(addr)
+        self.signer = signer
+        self.msps = msps
+        self._queue = []
+        self._cond = threading.Condition()
+        self._stopped = False
+        self._conn = None
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def enqueue(self, body: dict) -> None:
+        with self._cond:
+            if len(self._queue) >= self.MAX_QUEUE:
+                self._queue.pop(0)     # raft tolerates loss; keep newest
+            self._queue.append(body)
+            self._cond.notify()
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify()
+        conn = self._conn
+        if conn is not None:
+            try:
+                conn.close()
+            except Exception:
+                pass
+
+    def _loop(self) -> None:
+        backoff = 0.1
+        while True:
+            with self._cond:
+                self._cond.wait_for(lambda: self._queue or self._stopped)
+                if self._stopped:
+                    return
+                body = self._queue.pop(0)
+            if self._conn is None:
+                try:
+                    self._conn = connect(self.addr, self.signer, self.msps,
+                                         timeout=2.0)
+                    backoff = 0.1
+                except Exception:
+                    time.sleep(min(backoff, 1.0))
+                    backoff *= 2
+                    continue   # message dropped; raft resends
+            try:
+                self._conn.cast("raft.step", body)
+            except Exception:
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = None
+
+
+# -- raft message serde ------------------------------------------------------
+
+def msg_to_dict(m: raftmod.Message) -> dict:
+    d = {"type": m.type, "frm": m.frm, "to": m.to, "term": m.term,
+         "index": m.index, "log_term": m.log_term, "commit": m.commit,
+         "reject": 1 if m.reject else 0, "hint": m.hint,
+         "entries": [{"term": e.term, "index": e.index, "data": e.data,
+                      "kind": e.kind} for e in m.entries]}
+    if m.snapshot is not None:
+        d["snapshot"] = {"index": m.snapshot.index, "term": m.snapshot.term,
+                         "data": m.snapshot.data,
+                         "nodes": list(m.snapshot.nodes)}
+    return d
+
+
+def msg_from_dict(d: dict) -> raftmod.Message:
+    snap = None
+    if "snapshot" in d:
+        s = d["snapshot"]
+        snap = raftmod.Snapshot(s["index"], s["term"], s["data"],
+                                tuple(s["nodes"]))
+    return raftmod.Message(
+        type=d["type"], frm=d["frm"], to=d["to"], term=d["term"],
+        index=d["index"], log_term=d["log_term"],
+        entries=tuple(raftmod.Entry(e["term"], e["index"], e["data"],
+                                    e["kind"]) for e in d["entries"]),
+        commit=d["commit"], reject=bool(d["reject"]), hint=d["hint"],
+        snapshot=snap)
+
+
+class ClusterService:
+    """Drives one RaftChain over the network.
+
+    peers: raft node id -> (host, port).  The service registers the
+    `raft.step` cast on the node's RpcServer and runs a driver thread:
+      every tick_ms: node election/heartbeat tick + batch-timeout tick,
+      after every step/tick: process_ready() and ship outbound messages.
+    """
+
+    def __init__(self, chain, rpc: RpcServer, signer, msps,
+                 peers: Dict[int, Tuple[str, int]],
+                 tick_s: float = 0.05,
+                 peer_cns: Dict[int, str] = None):
+        self.chain = chain
+        self.rpc = rpc
+        self.signer = signer
+        self.msps = msps
+        self.peers = dict(peers)
+        # consenter authorization: raft id -> expected certificate common
+        # name.  Without it, any channel member could forge raft traffic
+        # claiming to be a consenter (cluster/comm.go authenticates the
+        # sender's TLS cert against the consenter set the same way).
+        self.peer_cns = dict(peer_cns or {})
+        self.tick_s = tick_s
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._thread = threading.Thread(target=self._drive, daemon=True)
+        # per-peer sender threads: dial/retry must never block the raft
+        # clock (a blackholed peer would otherwise starve heartbeats)
+        self._senders: Dict[int, _PeerSender] = {
+            nid: _PeerSender(nid, addr, signer, msps)
+            for nid, addr in self.peers.items()}
+        rpc.serve_cast("raft.step", self._on_step)
+
+    # -- inbound -------------------------------------------------------------
+
+    def _on_step(self, body: dict, peer_identity) -> None:
+        msg = msg_from_dict(body["msg"])
+        if msg.frm not in self.peers and msg.frm != self.chain.node.id:
+            logger.warning("raft message from unknown node %s", msg.frm)
+            return
+        expected_cn = self.peer_cns.get(msg.frm)
+        if expected_cn is not None:
+            cn = _cert_cn(peer_identity)
+            if cn != expected_cn:
+                logger.warning(
+                    "raft message claiming node %s from identity %r — "
+                    "dropped (consenter authorization)", msg.frm, cn)
+                return
+        self.chain.step(msg)
+        self._wake.set()
+
+    # -- outbound ------------------------------------------------------------
+
+    def _send(self, msg: raftmod.Message) -> None:
+        sender = self._senders.get(msg.to)
+        if sender is not None:
+            sender.enqueue({"msg": msg_to_dict(msg)})
+
+    # -- driver --------------------------------------------------------------
+
+    def start(self) -> "ClusterService":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=2.0)
+        for s in self._senders.values():
+            s.stop()
+
+    def _drive(self) -> None:
+        last_tick = time.monotonic()
+        while not self._stop.is_set():
+            self._wake.wait(timeout=self.tick_s / 2)
+            self._wake.clear()
+            now = time.monotonic()
+            if now - last_tick >= self.tick_s:
+                last_tick = now
+                try:
+                    self.chain.tick()
+                except Exception:
+                    logger.exception("raft tick failed")
+                try:
+                    self.chain.tick_batch(now)
+                except Exception:
+                    logger.exception("batch tick failed")
+            try:
+                ready = self.chain.process_ready()
+            except Exception:
+                logger.exception("process_ready failed")
+                continue
+            for m in ready.messages:
+                self._send(m)

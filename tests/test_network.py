@@ -1,0 +1,203 @@
+"""Network plane: authenticated channels, RPC, multi-process raft cluster.
+
+Reference behaviors covered (VERDICT.md missing #3, weak #4/#6):
+  - mutually authenticated transport bound to MSP identities; peers
+    outside the channel MSPs are rejected at handshake
+    (internal/pkg/comm mTLS + gossip signed handshake),
+  - Broadcast/Deliver as network services over that transport,
+  - an nwo-style multi-PROCESS integration test: 3 orderer OS processes
+    over sockets, e2e ordering, leader kill + continued service
+    (integration/nwo/network.go:173, integration/raft/cft_test.go).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.comm import HandshakeError, RpcError, RpcServer, connect, dial
+from fabric_tpu.msp import CachedMSP
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.node.orderer import load_signing_identity
+from fabric_tpu.node.provision import provision_orderers
+from fabric_tpu.protocol import Envelope, KVWrite, NsRwSet, TxRwSet, build
+
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+# ---------------------------------------------------------------------------
+# secure channel / rpc unit tests (in-process)
+# ---------------------------------------------------------------------------
+
+def test_secure_channel_auth_and_roundtrip():
+    org = DevOrg("NetOrg")
+    rogue = DevOrg("RogueOrg")
+    msps = {"NetOrg": CachedMSP(org.msp())}
+
+    got = []
+    server = RpcServer("127.0.0.1", 0, org.new_identity("srv"), msps)
+    server.serve("echo", lambda body, peer: {
+        "echo": body["x"], "peer_msp": peer.mspid})
+    server.start()
+    try:
+        conn = connect(server.addr, org.new_identity("cli"), msps)
+        out = conn.call("echo", {"x": b"hello"})
+        assert out["echo"] == b"hello" and out["peer_msp"] == "NetOrg"
+        conn.close()
+
+        # a peer from an org outside the channel MSPs is rejected
+        with pytest.raises((HandshakeError, ConnectionError, OSError, RpcError)):
+            c = connect(server.addr, rogue.new_identity("evil"),
+                        {"RogueOrg": CachedMSP(rogue.msp())})
+            c.call("echo", {"x": b"sneak"}, timeout=3.0)
+    finally:
+        server.stop()
+
+
+def test_rpc_stream():
+    org = DevOrg("NetOrg2")
+    msps = {"NetOrg2": CachedMSP(org.msp())}
+    server = RpcServer("127.0.0.1", 0, org.new_identity("srv"), msps)
+
+    def counter(body, peer):
+        for i in range(body["n"]):
+            yield {"i": i}
+    server.serve_stream("count", counter)
+    server.start()
+    try:
+        conn = connect(server.addr, org.new_identity("cli"), msps)
+        got = [b["i"] for b in conn.call_stream("count", {"n": 4})]
+        assert got == [0, 1, 2, 3]
+        conn.close()
+    finally:
+        server.stop()
+
+
+# ---------------------------------------------------------------------------
+# multi-process cluster (nwo-style)
+# ---------------------------------------------------------------------------
+
+def _client_bits(base):
+    with open(os.path.join(base, "client.json")) as f:
+        cc = json.load(f)
+    signer = load_signing_identity(cc["mspid"], cc["cert_pem"].encode(),
+                                   cc["key_pem"].encode())
+    from fabric_tpu.config import Bundle, ChannelConfig
+    bundle = Bundle(ChannelConfig.deserialize(
+        bytes.fromhex(cc["channel_config_hex"])))
+    return cc, signer, bundle.msps
+
+
+def _env(i, signer, channel="ch"):
+    rw = TxRwSet((NsRwSet("cc", writes=(KVWrite(f"k{i}", b"v"),)),))
+    return build.endorser_tx(channel, "cc", "1.0", rw, signer, [signer])
+
+
+def _wait_leader(cc, signer, msps, deadline=30.0):
+    t0 = time.time()
+    last = None
+    while time.time() - t0 < deadline:
+        for node in cc["cluster"]:
+            try:
+                conn = connect(("127.0.0.1", node["port"]), signer, msps,
+                               timeout=2.0)
+                st = conn.call("status", {}, timeout=3.0)
+                conn.close()
+                if st["role"] == "leader":
+                    return node, st
+                last = st
+            except Exception as exc:
+                last = exc
+        time.sleep(0.3)
+    raise AssertionError(f"no leader elected: {last}")
+
+
+@pytest.mark.slow
+def test_three_process_cluster_survives_leader_kill(tmp_path):
+    base = str(tmp_path)
+    paths = provision_orderers(base, 3)
+    procs = {}
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    try:
+        for p in paths:
+            with open(p) as f:
+                rid = json.load(f)["raft_id"]
+            procs[rid] = subprocess.Popen(
+                [sys.executable, "-m", "fabric_tpu.node.orderer", p],
+                env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT)
+
+        cc, signer, msps = _client_bits(base)
+        leader_node, st = _wait_leader(cc, signer, msps)
+        leader_conn = connect(("127.0.0.1", leader_node["port"]), signer, msps)
+
+        # order 4 envelopes -> 2 blocks (max_message_count=2)
+        for i in range(4):
+            out = leader_conn.call(
+                "broadcast", {"envelope": _env(i, signer).serialize()},
+                timeout=10.0)
+            assert out["status"] == 200, out
+
+        # deliver from a FOLLOWER: replication happened over sockets
+        followers = [n for n in cc["cluster"]
+                     if n["port"] != leader_node["port"]]
+        fconn = connect(("127.0.0.1", followers[0]["port"]), signer, msps)
+        blocks = []
+        seek_payload = b"seek:ch:0:1"
+        sd = {"data": seek_payload, "identity": signer.serialize(),
+              "signature": signer.sign(seek_payload)}
+        for item in fconn.call_stream("deliver", {
+                "channel": "ch", "start": 0, "stop": 1, "timeout_s": 20,
+                "signed_data": sd}):
+            blocks.append(Envelope.deserialize(
+                __import__("fabric_tpu.protocol.types",
+                           fromlist=["Block"]).Block.deserialize(
+                    item["block"]).data[0]))
+        assert len(blocks) == 2
+        fconn.close()
+
+        # kill the leader; the remaining two must elect and keep ordering
+        victim = None
+        for rid, proc in procs.items():
+            if cc["cluster"][rid - 1]["port"] == leader_node["port"]:
+                victim = rid
+        procs[victim].kill()
+        procs[victim].wait(timeout=10)
+        leader_conn.close()
+
+        new_leader, st = _wait_leader(
+            cc_without(cc, victim), signer, msps, deadline=45.0)
+        conn2 = connect(("127.0.0.1", new_leader["port"]), signer, msps)
+        for i in range(4, 8):
+            out = conn2.call(
+                "broadcast", {"envelope": _env(i, signer).serialize()},
+                timeout=10.0)
+            assert out["status"] == 200, out
+        # ordering is async past broadcast: poll until the new blocks land
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            st = conn2.call("status", {}, timeout=5.0)
+            if st["height"] >= 4:
+                break
+            time.sleep(0.3)
+        assert st["height"] >= 4, st   # 4 blocks total across the kill
+        conn2.close()
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+
+
+def cc_without(cc, victim_rid):
+    out = dict(cc)
+    out["cluster"] = [n for n in cc["cluster"]
+                      if n["raft_id"] != victim_rid]
+    return out
