@@ -45,13 +45,15 @@ class ChaincodeStub:
 
     def __init__(self, db: StateDB, namespace: str,
                  channel_id: str = "", txid: str = "",
-                 creator: bytes = b"", registry=None):
+                 creator: bytes = b"", registry=None, pvt_store=None):
         self._db = db
         self._ns = namespace
         self.channel_id = channel_id
         self.txid = txid
         self.creator = creator
         self._registry = registry  # for cc2cc invoke
+        self._pvt_store = pvt_store  # local PvtDataStore for private reads
+        self._pvt_writes: Dict[tuple, Dict[str, object]] = {}
         self._builders: Dict[str, _NsBuilder] = {}
         self._done = False
 
@@ -110,6 +112,83 @@ class ChaincodeStub:
         if self._registry is None:
             raise SimulationError("no chaincode registry for cc2cc")
         return self._registry.invoke_into(self, chaincode_id, fn, args)
+
+    # -- key-level endorsement (SBE) ----------------------------------------
+    # Reference: shim SetStateValidationParameter / GetStateValidationParameter
+    # backed by statebased/validator_keylevel.go; parameters are ordinary
+    # versioned writes in the companion metadata namespace, so MVCC orders
+    # concurrent updates and the policy flips at the block boundary.
+
+    def set_state_validation_parameter(self, key: str, policy) -> None:
+        self._check_open()
+        from fabric_tpu.committer import sbe
+        raw = sbe.encode_policy(policy) if policy is not None else None
+        mns = sbe.meta_namespace(self._ns)
+        if raw is None:
+            self._b(mns).writes[key] = KVWrite(key, is_delete=True)
+        else:
+            self._b(mns).writes[key] = KVWrite(key, raw)
+
+    def get_state_validation_parameter(self, key: str):
+        self._check_open()
+        from fabric_tpu.committer import sbe
+        mns = sbe.meta_namespace(self._ns)
+        b = self._b(mns)
+        if key in b.writes:
+            w = b.writes[key]
+            return None if w.is_delete else sbe.decode_policy(w.value)
+        vv = self._db.get(mns, key)
+        if key not in b.reads:
+            b.reads[key] = KVRead(key, None if vv is None else vv.version)
+        return None if vv is None else sbe.decode_policy(vv.value)
+
+    # -- private data (collections) -----------------------------------------
+    # Reference: the chaincode shim's GetPrivateData/PutPrivateData; the
+    # public rwset carries only hash(key)->hash(value) under the hashed
+    # namespace ns$collection, the cleartext goes to the transient store
+    # (gossip/privdata distribution model, VERDICT.md missing #2).
+
+    def put_private_data(self, collection: str, key: str, value: bytes) -> None:
+        self._check_open()
+        if not key:
+            raise SimulationError("empty key")
+        from fabric_tpu.privdata.collection import (hash_key, hash_value,
+                                                    pvt_namespace)
+        hns = pvt_namespace(self._ns, collection)
+        self._b(hns).writes[hash_key(key)] = KVWrite(hash_key(key),
+                                                     hash_value(value))
+        self._pvt_writes.setdefault((self._ns, collection), {})[key] = value
+
+    def del_private_data(self, collection: str, key: str) -> None:
+        self._check_open()
+        from fabric_tpu.privdata.collection import hash_key, pvt_namespace
+        hns = pvt_namespace(self._ns, collection)
+        self._b(hns).writes[hash_key(key)] = KVWrite(hash_key(key),
+                                                     is_delete=True)
+        self._pvt_writes.setdefault((self._ns, collection), {})[key] = None
+
+    def get_private_data(self, collection: str, key: str) -> Optional[bytes]:
+        # Cleartext from the local pvt store; the MVCC-relevant read is
+        # recorded against the HASHED namespace so every peer (member or
+        # not) validates it identically.
+        self._check_open()
+        from fabric_tpu.privdata.collection import hash_key, pvt_namespace
+        staged = self._pvt_writes.get((self._ns, collection), {})
+        if key in staged:
+            return staged[key]
+        hns = pvt_namespace(self._ns, collection)
+        hk = hash_key(key)
+        b = self._b(hns)
+        vv = self._db.get(hns, hk)
+        if hk not in b.reads:
+            b.reads[hk] = KVRead(hk, None if vv is None else vv.version)
+        if self._pvt_store is None:
+            return None
+        return self._pvt_store.get(self._ns, collection, key)
+
+    def private_sets(self) -> Dict[tuple, Dict[str, object]]:
+        # {(namespace, collection): {key: value|None}}
+        return dict(self._pvt_writes)
 
     # -- result -------------------------------------------------------------
 

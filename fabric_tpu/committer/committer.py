@@ -50,6 +50,7 @@ class Committer:
         vr = self.validator.validate(block)
         stats = self.ledger.commit(block)
         final = TxFlags.from_bytes(block.metadata.items[META_TXFLAGS])
+        self._observe_metrics(block, vr, stats)
         if self.bundle_source is not None:
             from fabric_tpu.config import ConfigError, apply_config_block
             from fabric_tpu.protocol.txflags import ValidationCode
@@ -66,6 +67,39 @@ class Committer:
                 logger.exception("config application failed for block %d",
                                  block.header.number)
         return BlockCommitResult(vr, stats, final)
+
+    def _observe_metrics(self, block, vr, stats) -> None:
+        """Per-phase commit metrics (metric parity: the reference's
+        ledger_block_processing_time / gossip state commit duration and
+        validation duration, kv_ledger.go:491-499, validator.go:262)."""
+        try:
+            from fabric_tpu.ops_plane import registry
+            ch = self.validator.channel_id
+            registry.histogram(
+                "validation_duration_seconds",
+                "txvalidator.Validate wall time").observe(
+                    vr.total_s, channel=ch)
+            registry.histogram(
+                "validation_dispatch_seconds",
+                "batched signature dispatch time").observe(
+                    vr.dispatch_s, channel=ch)
+            for phase in ("state_validation_s", "block_commit_s",
+                          "state_commit_s", "history_commit_s"):
+                v = getattr(stats, phase, None)
+                if v is not None:
+                    registry.histogram(
+                        "commit_phase_seconds",
+                        "per-phase ledger commit time").observe(
+                            v, channel=ch, phase=phase[:-2])
+            registry.counter(
+                "committed_blocks_total", "blocks committed").add(1, channel=ch)
+            registry.counter(
+                "committed_txs_total", "txs committed").add(
+                    len(block.data), channel=ch)
+            registry.gauge("ledger_height", "block height").set(
+                self.ledger.height, channel=ch)
+        except Exception:
+            logger.exception("metrics observation failed")
 
     @property
     def height(self) -> int:

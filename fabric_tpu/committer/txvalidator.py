@@ -77,6 +77,9 @@ class _TxWork:
     # per-namespace: (policy, [(dedup_key, identity), ...])
     namespaces: List[Tuple[str, SignaturePolicy, List[Tuple[Tuple, Identity]]]] = \
         field(default_factory=list)
+    # SBE: base_ns -> written keys; and this tx's metadata updates
+    written_keys: Dict[str, Tuple[str, ...]] = field(default_factory=dict)
+    meta_writes: List[Tuple] = field(default_factory=list)
 
 
 @dataclass
@@ -98,12 +101,16 @@ class TxValidator:
 
     def __init__(self, channel_id: str, msps: Dict[str, object], provider,
                  policies: PolicyRegistry,
-                 ledger_has_txid=None, bundle_source=None):
+                 ledger_has_txid=None, bundle_source=None,
+                 sbe_lookup=None):
         self.channel_id = channel_id
         self._static_msps = msps
         self.provider = provider
         self.policies = policies
         self.bundle_source = bundle_source
+        # key-level endorsement: committed validation-parameter lookup
+        # ((ns, key) -> policy bytes), usually sbe.statedb_lookup(statedb)
+        self.sbe_lookup = sbe_lookup
         # blkstorage-backed duplicate-txid oracle (validator.go dedup vs ledger)
         self.ledger_has_txid = ledger_has_txid or (lambda txid: False)
 
@@ -198,14 +205,37 @@ class TxValidator:
             flags.set(tx_num, ValidationCode.BAD_PAYLOAD)
             return None
 
+        from fabric_tpu.committer import sbe as sbemod
         for action in tx.actions:
             endorsed = action.endorsed_bytes()
             # policy scope: the invoked chaincode plus every namespace the tx
             # WRITES (dispatcher.go:189-191) — read-only namespaces are not
             # endorsement-checked in the reference
-            namespaces = {ns.namespace for ns in action.action.rwset.ns_rwsets
-                          if ns.writes}
+            namespaces = set()
+            for ns_set in action.action.rwset.ns_rwsets:
+                if not ns_set.writes:
+                    continue
+                # metadata namespaces route to their BASE namespace's
+                # policy surface; the keys are gated individually below
+                from fabric_tpu.committer import sbe as _sbe
+                namespaces.add(_sbe.base_namespace(ns_set.namespace)
+                               if _sbe.is_meta_namespace(ns_set.namespace)
+                               else ns_set.namespace)
             namespaces.add(action.action.chaincode_id)
+            # SBE bookkeeping: written keys per base namespace + this tx's
+            # validation-parameter updates (statebased/validator_keylevel.go)
+            for ns_set in action.action.rwset.ns_rwsets:
+                if not ns_set.writes:
+                    continue
+                if sbemod.is_meta_namespace(ns_set.namespace):
+                    base = sbemod.base_namespace(ns_set.namespace)
+                    for w in ns_set.writes:
+                        work.meta_writes.append(
+                            (base, w.key,
+                             None if w.is_delete else w.value))
+                else:
+                    work.written_keys[ns_set.namespace] = tuple(
+                        w.key for w in ns_set.writes)
             # one signature set per action; evaluated against every
             # written namespace's policy (dispatcher.go:189-191)
             sigset: List[Tuple[Tuple, Identity]] = []
@@ -232,17 +262,48 @@ class TxValidator:
     # -- pass 2: gate + evaluate --------------------------------------------
 
     def _gate_tx(self, work: _TxWork, flags: TxFlags,
-                 verdict: Dict[Tuple, bool]) -> None:
+                 verdict: Dict[Tuple, bool], sbe_overlay=None) -> None:
         if not verdict.get(work.creator_key, False):
             flags.set(work.tx_num, ValidationCode.BAD_CREATOR_SIGNATURE)
             return
+        evaluator = self.evaluator
         for ns, pol, sigset in work.namespaces:
             valid_idents = [ident for key, ident in sigset
                             if verdict.get(key, False)]
-            if not self.evaluator.evaluate(pol, valid_idents):
+            # key-level endorsement (validator_keylevel.go:244): a key's
+            # validation parameter REPLACES the chaincode policy for that
+            # key; keys without one fall back to the namespace policy.
+            # Metadata UPDATES to a key are themselves gated by the key's
+            # CURRENT policy (or the cc policy when none is set).
+            base_written = work.written_keys.get(ns, ())
+            meta_keys = [k for (b, k, _) in work.meta_writes if b == ns]
+            if sbe_overlay is None or (not base_written and not meta_keys):
+                need_ns_policy = True
+            else:
+                need_ns_policy = False
+                for key in base_written:
+                    kpol = sbe_overlay.policy_for(ns, key)
+                    if kpol is None:
+                        need_ns_policy = True
+                        continue
+                    if not evaluator.evaluate(kpol, list(valid_idents)):
+                        flags.set(work.tx_num,
+                                  ValidationCode.ENDORSEMENT_POLICY_FAILURE)
+                        return
+                for key in meta_keys:
+                    kpol = sbe_overlay.policy_for(ns, key) or pol
+                    if not evaluator.evaluate(kpol, list(valid_idents)):
+                        flags.set(work.tx_num,
+                                  ValidationCode.ENDORSEMENT_POLICY_FAILURE)
+                        return
+            if need_ns_policy and not evaluator.evaluate(pol, valid_idents):
                 flags.set(work.tx_num, ValidationCode.ENDORSEMENT_POLICY_FAILURE)
                 return
         flags.set(work.tx_num, ValidationCode.VALID)
+        if sbe_overlay is not None and work.meta_writes:
+            # a VALID tx's metadata updates take effect for later txs in
+            # this block (the reference's intra-block dependency ordering)
+            sbe_overlay.apply_valid_tx(work.meta_writes)
 
     # -- the block entry point (validator.go:181) ---------------------------
 
@@ -276,8 +337,11 @@ class TxValidator:
         dispatch_s = time.perf_counter() - t0
 
         t0 = time.perf_counter()
+        from fabric_tpu.committer.sbe import SbeOverlay
+        overlay = (SbeOverlay(self.sbe_lookup)
+                   if self.sbe_lookup is not None else None)
         for work in works:
-            self._gate_tx(work, flags, verdict)
+            self._gate_tx(work, flags, verdict, overlay)
         gate_s = time.perf_counter() - t0
 
         n_refs = sum(1 + sum(len(s) for _, _, s in w.namespaces) for w in works)

@@ -42,7 +42,9 @@ class Endorser:
                  registry: ChaincodeRegistry,
                  msps: Dict[str, object], provider,
                  signer: SigningIdentity,
-                 proposal_acl: Optional[SignaturePolicy] = None):
+                 proposal_acl: Optional[SignaturePolicy] = None,
+                 transient_store=None, pvt_store=None, distribute=None,
+                 ledger_height=None):
         self.channel_id = channel_id
         self.db = db
         self.registry = registry
@@ -50,6 +52,13 @@ class Endorser:
         self.signer = signer
         self.proposal_acl = proposal_acl
         self.evaluator = PolicyEvaluator(msps, provider)
+        # private-data plane (gossip/privdata distribution at endorsement):
+        # cleartext write-sets are staged in the transient store and pushed
+        # to collection member peers; only hashes enter the public rwset.
+        self.transient_store = transient_store
+        self.pvt_store = pvt_store
+        self.distribute = distribute      # callable(txid, pvt_sets) -> None
+        self.ledger_height = ledger_height or (lambda: 0)
 
     def process_proposal(self, sp: SignedProposal) -> ProposalResponse:
         """endorser.go:296.  Errors map to a non-200 response, never an
@@ -106,12 +115,21 @@ class Endorser:
     # -- simulation (endorser.go:178) ---------------------------------------
 
     def _simulate(self, prop: Proposal, creator: bytes):
+        txid = prop.header.channel_header.txid
         stub = ChaincodeStub(self.db, prop.chaincode_id,
                              channel_id=self.channel_id,
-                             txid=prop.header.channel_header.txid,
-                             creator=creator, registry=self.registry)
+                             txid=txid,
+                             creator=creator, registry=self.registry,
+                             pvt_store=self.pvt_store)
         _, payload = self.registry.execute(
             stub, prop.chaincode_id, prop.fn, list(prop.args))
+        pvt_sets = stub.private_sets()
+        if pvt_sets:
+            if self.transient_store is not None:
+                self.transient_store.persist(txid, self.ledger_height(),
+                                             pvt_sets)
+            if self.distribute is not None:
+                self.distribute(txid, pvt_sets)
         return payload, stub.rwset()
 
     def _version_of(self, chaincode_id: str) -> str:

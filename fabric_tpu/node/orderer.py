@@ -104,6 +104,15 @@ class OrdererNode:
         self.rpc.serve("status", self._rpc_status)
         self.rpc.serve_stream("deliver", self._rpc_deliver)
 
+        # ops plane: /metrics, /healthz (system.go:75-267 parity)
+        self.ops = None
+        if cfg.get("ops_port") is not None:
+            from fabric_tpu.ops_plane import OperationsServer
+            self.ops = OperationsServer(cfg.get("host", "127.0.0.1"),
+                                        int(cfg["ops_port"]))
+            self.ops.register_checker(
+                "raft", lambda: self.support.chain.node.leader_id is not None)
+
     # -- rpc handlers --------------------------------------------------------
 
     def _rpc_broadcast(self, body: dict, peer_identity) -> dict:
@@ -135,6 +144,8 @@ class OrdererNode:
     def start(self) -> "OrdererNode":
         self.rpc.start()
         self.cluster.start()
+        if self.ops is not None:
+            self.ops.start()
         logger.info("orderer %d serving on %s", self.raft_id, self.rpc.addr)
         return self
 
@@ -142,6 +153,8 @@ class OrdererNode:
         self.cluster.stop()
         self.support.chain.halt()
         self.rpc.stop()
+        if self.ops is not None:
+            self.ops.stop()
 
 
 def main(argv=None) -> int:

@@ -1,0 +1,17 @@
+"""Operations plane: metrics registry, Prometheus exposition, health checks.
+
+Re-design of /root/reference/common/metrics (provider.go) +
+core/operations/system.go:75-267 (VERDICT.md missing #6): a process-local
+metrics registry with counters/gauges/histograms, Prometheus text-format
+exposition, pluggable health checkers, and a tiny ops HTTP server
+(`/metrics`, `/healthz`, `/logspec`, `/version`).
+
+Named ops_plane (not "operations") to avoid clashing with fabric_tpu.ops,
+the TPU kernel package.
+"""
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry, registry
+from .server import OperationsServer
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+           "OperationsServer"]

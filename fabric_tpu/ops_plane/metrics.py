@@ -1,0 +1,157 @@
+"""Metrics registry with Prometheus text exposition.
+
+Reference parity: common/metrics/provider.go's Counter/Gauge/Histogram
+abstraction + the prometheus provider.  Label support follows the same
+With("name", value, ...) pairing convention.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Dict, List, Optional, Tuple
+
+_DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                    5.0, 10.0, float("inf"))
+
+
+def _label_key(pairs) -> Tuple:
+    return tuple(sorted(pairs.items()))
+
+
+def _fmt_labels(key: Tuple, extra: str = "") -> str:
+    parts = [f'{k}="{v}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class Counter:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple, float] = {}
+
+    def add(self, delta: float = 1.0, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + delta
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} counter"]
+        with self._lock:
+            for k, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+class Gauge:
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._values: Dict[Tuple, float] = {}
+
+    def set(self, value: float, **labels) -> None:
+        with self._lock:
+            self._values[_label_key(labels)] = value
+
+    def add(self, delta: float = 1.0, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            self._values[k] = self._values.get(k, 0.0) + delta
+
+    def value(self, **labels) -> float:
+        return self._values.get(_label_key(labels), 0.0)
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} gauge"]
+        with self._lock:
+            for k, v in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(k)} {v}")
+        return out
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str = "",
+                 buckets: Tuple[float, ...] = _DEFAULT_BUCKETS):
+        self.name = name
+        self.help = help_
+        self.buckets = tuple(buckets)
+        self._lock = threading.Lock()
+        self._counts: Dict[Tuple, List[int]] = {}
+        self._sum: Dict[Tuple, float] = {}
+        self._n: Dict[Tuple, int] = {}
+
+    def observe(self, value: float, **labels) -> None:
+        k = _label_key(labels)
+        with self._lock:
+            counts = self._counts.setdefault(k, [0] * len(self.buckets))
+            i = bisect.bisect_left(self.buckets, value)
+            if i < len(counts):
+                counts[i] += 1
+            self._sum[k] = self._sum.get(k, 0.0) + value
+            self._n[k] = self._n.get(k, 0) + 1
+
+    def expose(self) -> List[str]:
+        out = [f"# HELP {self.name} {self.help}",
+               f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for k, counts in sorted(self._counts.items()):
+                cum = 0
+                for ub, c in zip(self.buckets, counts):
+                    cum += c
+                    le = "+Inf" if ub == float("inf") else repr(ub)
+                    out.append(f"{self.name}_bucket"
+                               f"{_fmt_labels(k, f'le=\"{le}\"')} {cum}")
+                out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sum[k]}")
+                out.append(f"{self.name}_count{_fmt_labels(k)} {self._n[k]}")
+        return out
+
+
+class MetricsRegistry:
+    """Process metrics registry (the metrics.Provider role)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, object] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get(name, lambda: Counter(name, help_), Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get(name, lambda: Gauge(name, help_), Gauge)
+
+    def histogram(self, name: str, help_: str = "",
+                  buckets: Tuple[float, ...] = _DEFAULT_BUCKETS) -> Histogram:
+        return self._get(name, lambda: Histogram(name, help_, buckets),
+                         Histogram)
+
+    def _get(self, name, factory, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory()
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered "
+                                f"as {type(m).__name__}")
+            return m
+
+    def expose_text(self) -> str:
+        """Prometheus text exposition format (system.go:183 /metrics)."""
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: List[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+
+registry = MetricsRegistry()     # the process default, like prometheus's

@@ -1,0 +1,13 @@
+"""System chaincodes + discovery service.
+
+Re-design of /root/reference/core/scc/{qscc,cscc} and discovery/
+(VERDICT.md missing #7): in-process system contracts for ledger and
+config queries, and an endorser-discovery service computing endorsement
+layouts from policies + live membership.
+"""
+
+from .qscc import Qscc
+from .cscc import Cscc
+from .discovery import DiscoveryService, Layout
+
+__all__ = ["Qscc", "Cscc", "DiscoveryService", "Layout"]

@@ -1,0 +1,65 @@
+"""qscc — ledger query system contract.
+
+Reference parity: /root/reference/core/scc/qscc/query.go — GetChainInfo,
+GetBlockByNumber, GetBlockByHash, GetTransactionByID, with the read ACL
+evaluated against the channel Readers policy before serving.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from fabric_tpu.policy import SignedData
+
+
+class QsccError(Exception):
+    pass
+
+
+class Qscc:
+    """Bound to one channel's block store (+ optional ACL hooks)."""
+
+    def __init__(self, channel_id: str, blockstore,
+                 authorize=None):
+        self.channel_id = channel_id
+        self.blockstore = blockstore
+        # authorize: callable(SignedData|None) raising on deny — usually
+        # ChainSupport.authorize_read (the Readers policy)
+        self.authorize = authorize or (lambda sd: None)
+
+    def get_chain_info(self, signed: Optional[SignedData] = None) -> Dict:
+        self.authorize(signed)
+        info = self.blockstore.chain_info()
+        return {"height": info.height,
+                "current_hash": info.current_hash,
+                "previous_hash": info.previous_hash}
+
+    def get_block_by_number(self, number: int,
+                            signed: Optional[SignedData] = None):
+        self.authorize(signed)
+        try:
+            return self.blockstore.get_by_number(number)
+        except Exception as exc:
+            raise QsccError(f"block {number}: {exc}") from exc
+
+    def get_block_by_hash(self, block_hash: bytes,
+                          signed: Optional[SignedData] = None):
+        self.authorize(signed)
+        try:
+            return self.blockstore.get_by_hash(block_hash)
+        except Exception as exc:
+            raise QsccError(f"block by hash: {exc}") from exc
+
+    def get_transaction_by_id(self, txid: str,
+                              signed: Optional[SignedData] = None):
+        self.authorize(signed)
+        try:
+            block = self.blockstore.get_by_txid(txid)
+        except Exception as exc:
+            raise QsccError(f"transaction {txid!r}: {exc}") from exc
+        from fabric_tpu.protocol import Envelope
+        for env_bytes in block.data:
+            env = Envelope.deserialize(env_bytes)
+            if env.header().channel_header.txid == txid:
+                return env
+        raise QsccError(f"transaction {txid!r} not found")

@@ -1,0 +1,98 @@
+"""Ops plane: metrics registry, Prometheus exposition, /healthz, /logspec.
+
+Reference parity targets: common/metrics provider semantics and
+core/operations/system.go:75-267 endpoints (VERDICT.md missing #6 —
+"curl-able /metrics and /healthz on a running node").
+"""
+import json
+import logging
+import urllib.request
+
+import pytest
+
+from fabric_tpu.ops_plane import MetricsRegistry, OperationsServer
+
+
+def _get(addr, path):
+    with urllib.request.urlopen(f"http://{addr[0]}:{addr[1]}{path}") as r:
+        return r.status, r.read().decode()
+
+
+def test_metrics_exposition():
+    reg = MetricsRegistry()
+    reg.counter("txs_total", "transactions").add(3, channel="ch")
+    reg.counter("txs_total").add(2, channel="ch")
+    reg.gauge("height").set(7, channel="ch")
+    h = reg.histogram("latency_seconds", buckets=(0.1, 1.0, float("inf")))
+    for v in (0.05, 0.5, 5.0):
+        h.observe(v)
+    text = reg.expose_text()
+    assert 'txs_total{channel="ch"} 5.0' in text
+    assert 'height{channel="ch"} 7' in text
+    assert 'latency_seconds_bucket{le="0.1"} 1' in text
+    assert 'latency_seconds_bucket{le="+Inf"} 3' in text
+    assert "latency_seconds_count 3" in text
+    assert "# TYPE txs_total counter" in text
+
+
+def test_ops_http_endpoints():
+    reg = MetricsRegistry()
+    reg.counter("up").add(1)
+    srv = OperationsServer(metrics=reg).start()
+    try:
+        code, body = _get(srv.addr, "/metrics")
+        assert code == 200 and "up 1.0" in body
+
+        code, body = _get(srv.addr, "/healthz")
+        assert code == 200 and json.loads(body)["status"] == "OK"
+
+        srv.register_checker("raft", lambda: (_ for _ in ()).throw(
+            RuntimeError("no leader")))
+        try:
+            code, body = _get(srv.addr, "/healthz")
+        except urllib.error.HTTPError as e:
+            code, body = e.code, e.read().decode()
+        assert code == 503
+        assert json.loads(body)["failed_checks"][0]["component"] == "raft"
+
+        code, body = _get(srv.addr, "/version")
+        assert code == 200 and "fabric-tpu" in body
+
+        # runtime log-level admin
+        req = urllib.request.Request(
+            f"http://{srv.addr[0]}:{srv.addr[1]}/logspec",
+            data=json.dumps({"spec": "debug"}).encode(), method="PUT")
+        with urllib.request.urlopen(req) as r:
+            assert r.status == 204
+        assert logging.getLogger().getEffectiveLevel() == logging.DEBUG
+        logging.getLogger().setLevel(logging.WARNING)
+    finally:
+        srv.stop()
+
+
+def test_commit_pipeline_metrics(tmp_path):
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    from fabric_tpu.committer.committer import Committer
+    from fabric_tpu.committer.txvalidator import PolicyRegistry, TxValidator
+    from fabric_tpu.ledger import KVLedger
+    from fabric_tpu.msp import CachedMSP
+    from fabric_tpu.msp.ca import DevOrg
+    from fabric_tpu.ops_plane import registry
+    from fabric_tpu.policy import parse_policy
+    from fabric_tpu.protocol import KVWrite, NsRwSet, TxRwSet, build
+
+    provider = init_factories(FactoryOpts(default="SW"))
+    org = DevOrg("MetOrg")
+    msps = {"MetOrg": CachedMSP(org.msp())}
+    validator = TxValidator("met", msps, provider,
+                            PolicyRegistry(parse_policy("OR('MetOrg.member')")))
+    committer = Committer(KVLedger("met"), validator)
+    rw = TxRwSet((NsRwSet("cc", writes=(KVWrite("k", b"v"),)),))
+    env = build.endorser_tx("met", "cc", "1.0", rw,
+                            org.new_identity("c"), [org.new_identity("e")])
+    committer.store_block(build.new_block(0, b"\x00" * 32, [env]))
+    text = registry.expose_text()
+    assert 'committed_blocks_total{channel="met"} 1' in text
+    assert 'ledger_height{channel="met"} 1' in text
+    assert 'validation_duration_seconds_count{channel="met"} 1' in text
+    assert 'commit_phase_seconds' in text

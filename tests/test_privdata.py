@@ -1,0 +1,158 @@
+"""Private data pillar end-to-end (VERDICT.md missing #2).
+
+Covers the reference behaviors:
+  - a collection-scoped write puts only hashes on-chain
+    (gossip/privdata model), cleartext staged in the transient store,
+  - at commit, member peers resolve cleartext (hash-verified) into the
+    pvt store; non-members commit hashes only,
+  - BTL purge removes expired private data (pvtstatepurgemgmt),
+  - a peer that missed the data recovers it via reconciliation
+    (reconcile.go),
+  - tampered cleartext (hash mismatch) is NOT committed.
+"""
+import pytest
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.chaincode.stub import ChaincodeStub
+from fabric_tpu.committer.committer import Committer
+from fabric_tpu.committer.txvalidator import PolicyRegistry, TxValidator
+from fabric_tpu.ledger import KVLedger
+from fabric_tpu.msp import CachedMSP
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.policy import parse_policy
+from fabric_tpu.privdata import (
+    CollectionConfig,
+    CollectionRegistry,
+    Coordinator,
+    PvtDataStore,
+    TransientStore,
+    pvt_namespace,
+)
+from fabric_tpu.privdata.collection import hash_key, hash_value
+from fabric_tpu.protocol import build
+from fabric_tpu.protocol.types import ChaincodeAction, TransactionAction
+
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture()
+def org():
+    return DevOrg("Org1")
+
+
+def make_peer(org, provider, mspid="Org1", fetch=None, tmp=None):
+    from fabric_tpu.ledger.kvledger import LedgerConfig
+    msps = {"Org1": CachedMSP(org.msp())}
+    ledger = KVLedger("ch", LedgerConfig(root=tmp))
+    policy = parse_policy("OR('Org1.member')")
+    validator = TxValidator("ch", msps, provider, PolicyRegistry(policy))
+    committer = Committer(ledger, validator)
+    registry = CollectionRegistry()
+    registry.define("cc", CollectionConfig(
+        "secrets", member_orgs=("Org1",), block_to_live=2))
+    transient = TransientStore()
+    pvt = PvtDataStore()
+    coord = Coordinator(committer, registry, transient, pvt,
+                        mspid=mspid, fetch=fetch)
+    return coord, transient, pvt, ledger
+
+
+def pvt_tx(org, i, transient=None, value=b"classified", tamper=False):
+    """Simulate a tx writing public + private data; returns the envelope."""
+    from fabric_tpu.ledger.statedb import StateDB
+    stub = ChaincodeStub(StateDB(), "cc", channel_id="ch", txid="")
+    stub.put_state(f"pub{i}", b"open")
+    stub.put_private_data("secrets", f"sec{i}", value)
+    rwset = stub.rwset()
+    pvt_sets = stub.private_sets()
+    env = build.endorser_tx("ch", "cc", "1.0", rwset,
+                            org.new_identity("client"),
+                            [org.new_identity("e")])
+    txid = env.header().channel_header.txid
+    if transient is not None:
+        if tamper:
+            pvt_sets = {k: {kk: b"forged" for kk in v}
+                        for k, v in pvt_sets.items()}
+        transient.persist(txid, 0, pvt_sets)
+    return env
+
+
+def commit_block(coord, ledger, envs):
+    prev = (ledger.blockstore.get_by_number(ledger.height - 1).hash()
+            if ledger.height else b"\x00" * 32)
+    blk = build.new_block(ledger.height, prev, envs)
+    return coord.store_block(blk)
+
+
+def test_member_gets_cleartext_nonmember_hashes_only(org, provider, tmp_path):
+    coord, transient, pvt, ledger = make_peer(org, provider,
+                                              tmp=str(tmp_path / "m"))
+    env = pvt_tx(org, 1, transient)
+    commit_block(coord, ledger, [env])
+    # member: cleartext present
+    assert pvt.get("cc", "secrets", "sec1") == b"classified"
+    # public ledger: only the hashed namespace
+    hns = pvt_namespace("cc", "secrets")
+    vv = ledger.statedb.get(hns, hash_key("sec1"))
+    assert vv is not None and vv.value == hash_value(b"classified")
+    assert ledger.statedb.get("cc", "pub1").value == b"open"
+    # transient store purged post-commit
+    assert len(transient) == 0
+
+    # non-member peer: same block, no transient data, not a member
+    coord2, _, pvt2, ledger2 = make_peer(org, provider, mspid="Org2",
+                                         tmp=str(tmp_path / "n"))
+    commit_block(coord2, ledger2, [env])
+    assert pvt2.get("cc", "secrets", "sec1") is None
+    assert ledger2.statedb.get(hns, hash_key("sec1")).value == \
+        hash_value(b"classified")
+    # not recorded as missing either: it is not our collection
+    assert coord2.missing == []
+
+
+def test_btl_purge(org, provider, tmp_path):
+    coord, transient, pvt, ledger = make_peer(org, provider,
+                                              tmp=str(tmp_path))
+    env = pvt_tx(org, 1, transient)
+    commit_block(coord, ledger, [env])       # block 0: write
+    assert pvt.get("cc", "secrets", "sec1") == b"classified"
+    # BTL=2: data survives blocks 1, 2 and purges at block 3
+    for i in range(2, 5):
+        e = pvt_tx(org, i, transient)
+        commit_block(coord, ledger, [e])
+    assert pvt.get("cc", "secrets", "sec1") is None       # purged
+    assert pvt.get("cc", "secrets", "sec4") == b"classified"  # fresh
+
+
+def test_missing_then_reconciled(org, provider, tmp_path):
+    served = {}
+
+    def fetch(txid, ns, coll):
+        return served.get((txid, ns, coll))
+
+    coord, transient, pvt, ledger = make_peer(org, provider, fetch=fetch,
+                                              tmp=str(tmp_path))
+    env = pvt_tx(org, 1, transient=None)     # nothing staged locally
+    commit_block(coord, ledger, [env])
+    assert pvt.get("cc", "secrets", "sec1") is None
+    assert len(coord.missing) == 1
+
+    # a member peer later serves the data: reconcile backfills
+    txid = env.header().channel_header.txid
+    served[(txid, "cc", "secrets")] = {"sec1": b"classified"}
+    assert coord.reconcile() == 1
+    assert pvt.get("cc", "secrets", "sec1") == b"classified"
+    assert coord.missing == []
+
+
+def test_tampered_cleartext_rejected(org, provider, tmp_path):
+    coord, transient, pvt, ledger = make_peer(org, provider,
+                                              tmp=str(tmp_path))
+    env = pvt_tx(org, 1, transient, tamper=True)
+    commit_block(coord, ledger, [env])
+    # hash mismatch: cleartext NOT committed, recorded as missing
+    assert pvt.get("cc", "secrets", "sec1") is None
+    assert len(coord.missing) == 1

@@ -1,0 +1,129 @@
+"""Key-level (state-based) endorsement — validator_keylevel.go semantics.
+
+Covers:
+  - a key's validation parameter replaces the chaincode policy for txs
+    writing that key (stricter AND looser directions),
+  - keys without parameters still need the chaincode policy,
+  - the policy transition takes effect for later blocks (committed
+    metadata) AND for later txs in the same block when the updater tx is
+    valid (intra-block ordering),
+  - removing the parameter falls back to the chaincode policy.
+"""
+import pytest
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.chaincode.stub import ChaincodeStub
+from fabric_tpu.committer import sbe
+from fabric_tpu.committer.committer import Committer
+from fabric_tpu.committer.txvalidator import PolicyRegistry, TxValidator
+from fabric_tpu.ledger import KVLedger
+from fabric_tpu.ledger.statedb import StateDB
+from fabric_tpu.msp import CachedMSP
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.policy import parse_policy
+from fabric_tpu.protocol import build
+from fabric_tpu.protocol.txflags import ValidationCode
+
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture()
+def world(provider):
+    o1, o2 = DevOrg("Org1"), DevOrg("Org2")
+    msps = {"Org1": CachedMSP(o1.msp()), "Org2": CachedMSP(o2.msp())}
+    ledger = KVLedger("ch")
+    cc_policy = parse_policy("OR('Org1.member')")   # default: Org1 alone
+    validator = TxValidator(
+        "ch", msps, provider, PolicyRegistry(cc_policy),
+        sbe_lookup=sbe.statedb_lookup(ledger.statedb))
+    committer = Committer(ledger, validator)
+    return o1, o2, committer, ledger
+
+
+def tx(org_client, endorsers, writes=(), sbe_set=(), sbe_del=()):
+    stub = ChaincodeStub(StateDB(), "cc", channel_id="ch")
+    for k, v in writes:
+        stub.put_state(k, v)
+    for k, pol in sbe_set:
+        stub.set_state_validation_parameter(k, pol)
+    for k in sbe_del:
+        stub.set_state_validation_parameter(k, None)
+    return build.endorser_tx("ch", "cc", "1.0", stub.rwset(),
+                             org_client.new_identity("client"),
+                             endorsers)
+
+
+def commit(committer, envs):
+    lg = committer.ledger
+    prev = (lg.blockstore.chain_info().current_hash
+            if lg.height else b"\x00" * 32)
+    return committer.store_block(build.new_block(lg.height, prev, envs))
+
+
+def codes(result):
+    return [int(c) for c in result.validation.flags.codes()]
+
+
+def test_key_policy_overrides_and_transitions(world):
+    o1, o2, committer, ledger = world
+    e1 = [o1.new_identity("e1")]
+    e2 = [o2.new_identity("e2")]
+    both = parse_policy("AND('Org1.member','Org2.member')")
+
+    # block 0: Org1 writes k normally (cc policy: Org1) + sets SBE=AND(both)
+    r = commit(committer, [
+        tx(o1, e1, writes=[("k", b"v0")], sbe_set=[("k", both)]),
+    ])
+    assert codes(r) == [ValidationCode.VALID]
+
+    # block 1: Org1-only endorsement on k now FAILS (key policy overrides);
+    # an Org1-only write to another key still passes (cc policy)
+    r = commit(committer, [
+        tx(o1, e1, writes=[("k", b"v1")]),
+        tx(o1, e1, writes=[("other", b"x")]),
+        tx(o1, e1 + e2, writes=[("k", b"v2")]),   # both orgs: satisfies SBE
+    ])
+    assert codes(r)[:2] == [ValidationCode.ENDORSEMENT_POLICY_FAILURE,
+                            ValidationCode.VALID]
+    # third tx writes the same key as tx 0 in this block: MVCC decides it,
+    # but the ENDORSEMENT gate must pass; it can only be VALID or
+    # MVCC_READ_CONFLICT, never ENDORSEMENT_POLICY_FAILURE
+    assert codes(r)[2] != ValidationCode.ENDORSEMENT_POLICY_FAILURE
+
+
+def test_same_block_transition(world):
+    o1, o2, committer, ledger = world
+    e1 = [o1.new_identity("e1")]
+    org2_only = parse_policy("OR('Org2.member')")
+
+    # one block: tx0 sets SBE(k2)=Org2; tx1 (Org1-endorsed) writes k2 ->
+    # must FAIL under the NEW policy (intra-block transition); tx2
+    # endorsed by Org2 writes k2 -> endorsement-valid
+    r = commit(committer, [
+        tx(o1, e1, sbe_set=[("k2", org2_only)]),
+        tx(o1, e1, writes=[("k2", b"a")]),
+        tx(o1, [o2.new_identity("e2")], writes=[("k2", b"b")]),
+    ])
+    c = codes(r)
+    assert c[0] == ValidationCode.VALID
+    assert c[1] == ValidationCode.ENDORSEMENT_POLICY_FAILURE
+    assert c[2] != ValidationCode.ENDORSEMENT_POLICY_FAILURE
+
+
+def test_delete_falls_back_to_cc_policy(world):
+    o1, o2, committer, ledger = world
+    e1 = [o1.new_identity("e1")]
+    org2_only = parse_policy("OR('Org2.member')")
+    r = commit(committer, [tx(o1, e1, sbe_set=[("k3", org2_only)])])
+    assert codes(r) == [ValidationCode.VALID]
+    r = commit(committer, [tx(o1, e1, writes=[("k3", b"x")])])
+    assert codes(r) == [ValidationCode.ENDORSEMENT_POLICY_FAILURE]
+    # Org2 removes the parameter; Org1 writes again under the cc policy
+    r = commit(committer, [tx(o1, [o2.new_identity("e2")],
+                              sbe_del=["k3"])])
+    assert codes(r) == [ValidationCode.VALID]
+    r = commit(committer, [tx(o1, e1, writes=[("k3", b"y")])])
+    assert codes(r) == [ValidationCode.VALID]

@@ -1,0 +1,102 @@
+"""System chaincodes (qscc/cscc) + discovery layouts (VERDICT.md #7/#10)."""
+import pytest
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.msp import CachedMSP, Principal
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.policy import parse_policy
+from fabric_tpu.protocol import Envelope, KVWrite, NsRwSet, TxRwSet, build
+from fabric_tpu.scc import Cscc, DiscoveryService, Qscc
+from fabric_tpu.scc.cscc import CsccError
+from fabric_tpu.scc.qscc import QsccError
+
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture()
+def chain(provider):
+    from fabric_tpu.ledger.blkstorage import BlockStore
+    org = DevOrg("Org1")
+    store = BlockStore()
+    envs = []
+    for i in range(3):
+        rw = TxRwSet((NsRwSet("cc", writes=(KVWrite(f"k{i}", b"v"),)),))
+        envs.append(build.endorser_tx("ch", "cc", "1.0", rw,
+                                      org.new_identity("c"),
+                                      [org.new_identity("e")]))
+    store.add_block(build.new_block(0, b"\x00" * 32, envs[:2]))
+    store.add_block(build.new_block(1, store.chain_info().current_hash,
+                                    [envs[2]]))
+    return org, store, envs
+
+
+def test_qscc_queries(chain):
+    org, store, envs = chain
+    q = Qscc("ch", store)
+    info = q.get_chain_info()
+    assert info["height"] == 2
+    blk = q.get_block_by_number(1)
+    assert blk.header.number == 1
+    assert q.get_block_by_hash(blk.hash()).header.number == 1
+    txid = envs[2].header().channel_header.txid
+    env = q.get_transaction_by_id(txid)
+    assert env.header().channel_header.txid == txid
+    with pytest.raises(QsccError):
+        q.get_transaction_by_id("nope")
+    with pytest.raises(QsccError):
+        q.get_block_by_number(99)
+
+    # ACL enforced
+    def deny(sd):
+        raise PermissionError("no")
+    q2 = Qscc("ch", store, authorize=deny)
+    with pytest.raises(PermissionError):
+        q2.get_chain_info()
+
+
+def test_cscc_join_and_config(chain):
+    org, store, envs = chain
+    from fabric_tpu.config import (Bundle, BundleSource, ChannelConfig,
+                                   OrgConfig, default_policies)
+    mc = org.msp_config()
+    cfg = ChannelConfig("ch2", 0, (OrgConfig(
+        "Org1", tuple(mc.root_certs_pem), tuple(mc.admin_certs_pem)),),
+        default_policies(["Org1"]))
+
+    class Chan:
+        def __init__(self, cid, config):
+            self.bundle_source = BundleSource(Bundle(config))
+
+    cscc = Cscc(create_channel=lambda cid, c: Chan(cid, c))
+    cscc.join_chain("ch2", cfg)
+    assert cscc.get_channels() == ["ch2"]
+    assert cscc.get_channel_config("ch2").channel_id == "ch2"
+    with pytest.raises(CsccError):
+        cscc.join_chain("ch2", cfg)
+    with pytest.raises(CsccError):
+        cscc.get_channel_config("nope")
+
+
+def test_discovery_layouts():
+    policy = parse_policy(
+        "OutOf(2, 'Org1.member', 'Org2.member', 'Org3.member')")
+    peers = [
+        {"id": "p1", "mspid": "Org1"},
+        {"id": "p2", "mspid": "Org2"},
+        {"id": "p2b", "mspid": "Org2"},
+    ]   # Org3 has no live peers
+    svc = DiscoveryService(lambda: peers, lambda ns: policy)
+    out = svc.endorsers("cc")
+    dicts = [l.as_dict() for l in out["layouts"]]
+    # only the Org1+Org2 layout is satisfiable (Org3 dark)
+    assert {"Org1:member": 1, "Org2:member": 1} in dicts
+    assert all("Org3:member" not in d for d in dicts)
+    assert out["peers_by_group"]["Org2:member"] == ["p2", "p2b"]
+
+    # AND policy with a dark org -> no layouts
+    policy2 = parse_policy("AND('Org1.member','Org3.member')")
+    svc2 = DiscoveryService(lambda: peers, lambda ns: policy2)
+    assert svc2.endorsers("cc")["layouts"] == []
