@@ -44,23 +44,70 @@ class Committer:
         boundary), so the config tx itself is validated under the previous
         configuration — matching configtx/validator.go sequencing.
         """
-        from fabric_tpu.protocol.txflags import TxFlags
+        from fabric_tpu.protocol.txflags import TxFlags, ValidationCode
         from fabric_tpu.protocol.types import META_TXFLAGS
 
         vr = self.validator.validate(block)
+        # Commit-time config validation happens BEFORE the commit: a config
+        # tx that fails (wrong sequence, Admins unsatisfied) must be
+        # recorded with an INVALID flag, never committed as VALID with the
+        # failure merely logged (the reference invalidates the config tx;
+        # an unauthorized config tx permanently recorded valid would be a
+        # ledger integrity violation).
+        new_cfg = None
+        cfg_env = None
+        if self.bundle_source is not None:
+            from fabric_tpu.config import config_envelope_of
+            cfg_env = config_envelope_of(block)
+        if cfg_env is not None:
+            flags = TxFlags.from_bytes(block.metadata.items[META_TXFLAGS])
+            if flags.is_valid(0):
+                from fabric_tpu.config import (
+                    ConfigError,
+                    parse_config_envelope,
+                    validate_parsed_config_update,
+                )
+                bundle = self.bundle_source.current()
+                try:
+                    cfg, sds = parse_config_envelope(cfg_env)
+                except Exception as exc:
+                    cfg = None
+                    err = ConfigError(f"malformed config envelope: {exc}")
+                else:
+                    err = None
+                if cfg is not None and cfg.sequence <= bundle.sequence:
+                    # Historical replay (a peer bootstrapped at a later
+                    # config catching up through old config blocks) or a
+                    # raced duplicate update that lost: authorization was
+                    # validated when the block was cut.  Re-judging it
+                    # against the CURRENT bundle would permanently flag a
+                    # historically-valid config tx INVALID and diverge
+                    # from peers that validated it at the tip — keep the
+                    # flags, apply nothing.
+                    logger.debug(
+                        "config block %d sequence %d <= bundle sequence "
+                        "%d: already applied, skipping",
+                        block.header.number, cfg.sequence, bundle.sequence)
+                elif err is None:
+                    try:
+                        new_cfg = validate_parsed_config_update(
+                            bundle, cfg, sds,
+                            self.provider or self.validator.provider)
+                    except ConfigError as exc:
+                        err = exc
+                if err is not None:
+                    logger.warning(
+                        "config tx in block %d invalid at commit: %s",
+                        block.header.number, err)
+                    flags.set(0, ValidationCode.INVALID_CONFIG_TRANSACTION)
+                    block.metadata.items[META_TXFLAGS] = flags.to_bytes()
         stats = self.ledger.commit(block)
         final = TxFlags.from_bytes(block.metadata.items[META_TXFLAGS])
         self._observe_metrics(block, vr, stats)
-        if self.bundle_source is not None:
-            from fabric_tpu.config import ConfigError, apply_config_block
-            from fabric_tpu.protocol.txflags import ValidationCode
+        if new_cfg is not None and final.is_valid(0):
             try:
-                apply_config_block(self.bundle_source, block,
-                                   self.provider
-                                   or self.validator.provider)
-            except ConfigError as exc:
-                logger.warning("config block %d rejected at commit: %s",
-                               block.header.number, exc)
+                from fabric_tpu.config import Bundle
+                self.bundle_source.update(Bundle(new_cfg))
             except Exception:
                 # the block is already committed; a config-plane failure
                 # must not make the caller believe the commit failed

@@ -143,7 +143,8 @@ class TxValidator:
 
     def _collect_tx(self, tx_num: int, env_bytes: bytes, flags: TxFlags,
                     seen_txids: Dict[str, int],
-                    items: Dict[Tuple, VerifyItem]) -> Optional[_TxWork]:
+                    items: Dict[Tuple, VerifyItem],
+                    n_txs: int = 1) -> Optional[_TxWork]:
         """ValidateTransaction's structural half + workload collection.
         Returns None when the tx is already terminally flagged."""
         if not env_bytes:
@@ -172,8 +173,15 @@ class TxValidator:
         seen_txids[ch.txid] = tx_num
 
         if ch.type == TX_CONFIG:
-            # config txs are validated by the config plane before commit;
-            # their creator sig still gets checked like any other
+            # config txs must ride alone in their block (the chain's
+            # configure() isolates them); one smuggled into a multi-tx
+            # block by a byzantine orderer must be flagged invalid, never
+            # deferred to a commit-time check that only looks at 1-tx blocks
+            if n_txs != 1:
+                flags.set(tx_num, ValidationCode.INVALID_CONFIG_TRANSACTION)
+                return None
+            # content validation happens commit-side against the current
+            # bundle; the creator sig still gets checked like any other
             work = _TxWork(tx_num)
         elif ch.type == TX_ENDORSER:
             work = _TxWork(tx_num)
@@ -234,7 +242,11 @@ class TxValidator:
                             (base, w.key,
                              None if w.is_delete else w.value))
                 else:
-                    work.written_keys[ns_set.namespace] = tuple(
+                    # accumulate across actions — assignment would let a
+                    # later action's writes clobber an earlier action's
+                    # keys out of SBE gating (multi-action same-namespace)
+                    prev = work.written_keys.get(ns_set.namespace, ())
+                    work.written_keys[ns_set.namespace] = prev + tuple(
                         w.key for w in ns_set.writes)
             # one signature set per action; evaluated against every
             # written namespace's policy (dispatcher.go:189-191)
@@ -324,7 +336,8 @@ class TxValidator:
         items: Dict[Tuple, VerifyItem] = {}
         works: List[_TxWork] = []
         for tx_num, env_bytes in enumerate(block.data):
-            work = self._collect_tx(tx_num, env_bytes, flags, seen_txids, items)
+            work = self._collect_tx(tx_num, env_bytes, flags, seen_txids,
+                                    items, n_txs=n)
             if work is not None:
                 works.append(work)
         collect_s = time.perf_counter() - t0

@@ -21,11 +21,13 @@ from .configtx import (
     build_config_envelope,
     parse_config_envelope,
     validate_config_update,
+    validate_parsed_config_update,
+    config_envelope_of,
 )
 
 __all__ = [
     "Bundle", "BundleSource", "BatchConfig", "ChannelConfig", "ConfigError",
     "OrgConfig", "default_policies", "CAP_V2_0", "CAP_KEY_LEVEL_ENDORSEMENT",
     "apply_config_block", "build_config_envelope", "parse_config_envelope",
-    "validate_config_update",
+    "validate_config_update", "validate_parsed_config_update", "config_envelope_of",
 ]

@@ -72,6 +72,28 @@ def parse_config_envelope(env: Envelope) -> tuple:
     return cfg, sds
 
 
+def config_envelope_of(block) -> Optional[Envelope]:
+    """The single config envelope of a config block, else None.
+
+    THE definition of "is a config block": config blocks are always cut as
+    single-envelope blocks (the chain's configure() isolates them; a config
+    tx smuggled into a multi-tx block is flagged invalid by the validator).
+    Shared by the committer and apply_config_block so the rule cannot
+    drift.
+    """
+    if len(block.data) != 1:
+        return None
+    try:
+        env = Envelope.deserialize(block.data[0])
+    except Exception:
+        return None          # malformed envelope: flagged by the validator
+    try:
+        is_config = env.header().channel_header.type == TX_CONFIG
+    except Exception:
+        return None
+    return env if is_config else None
+
+
 def validate_config_update(bundle: Bundle, env: Envelope, provider) -> ChannelConfig:
     """Admission + commit-time validation of a config envelope against the
     CURRENT bundle.  Returns the new ChannelConfig or raises ConfigError.
@@ -86,6 +108,13 @@ def validate_config_update(bundle: Bundle, env: Envelope, provider) -> ChannelCo
         cfg, sds = parse_config_envelope(env)
     except Exception as exc:
         raise ConfigError(f"malformed config envelope: {exc}") from exc
+    return validate_parsed_config_update(bundle, cfg, sds, provider)
+
+
+def validate_parsed_config_update(bundle: Bundle, cfg: ChannelConfig,
+                                  sds: List[SignedData],
+                                  provider) -> ChannelConfig:
+    """validate_config_update on an already-parsed envelope body."""
     if cfg.channel_id != bundle.channel_id:
         raise ConfigError(
             f"config for channel {cfg.channel_id!r} on {bundle.channel_id!r}")
@@ -110,17 +139,8 @@ def apply_config_block(source, block, provider) -> Optional[Bundle]:
     at ordering admission too, but commit-side re-validation keeps peers
     that weren't the ordering node honest.
     """
-    # config blocks are always cut as single-envelope blocks (the chain's
-    # configure() isolates them), so only single-tx blocks can carry one —
-    # this keeps commit of large normal blocks free of re-parsing.
-    if len(block.data) != 1:
-        return None
-    try:
-        env = Envelope.deserialize(block.data[0])
-        is_config = env.header().channel_header.type == TX_CONFIG
-    except Exception:
-        return None          # malformed envelope: flagged by the validator
-    if not is_config:
+    env = config_envelope_of(block)
+    if env is None:
         return None
     cfg = validate_config_update(source.current(), env, provider)
     new_bundle = Bundle(cfg)

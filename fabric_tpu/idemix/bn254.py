@@ -209,6 +209,20 @@ def g1_neg(a: G1Point) -> G1Point:
     return None if a is None else (a[0], (-a[1]) % P)
 
 
+def g1_on_curve(a: G1Point) -> bool:
+    """Membership check for attacker-supplied points: y^2 == x^3 + b over
+    Fp with canonical coordinates.  g1_add/g1_mul and the pairing operate
+    blindly on off-curve coordinates (invalid-curve attacks void the
+    scheme's soundness), so every deserialized/verification input MUST be
+    gated through this.  Cofactor 1: on-curve implies order r."""
+    if a is None:
+        return True
+    x, y = a
+    if not (0 <= x < P and 0 <= y < P):
+        return False
+    return (y * y - (x * x * x + B_COEFF)) % P == 0
+
+
 def hash_to_g1(data: bytes) -> Tuple[int, int]:
     """Try-and-increment hash to a G1 point (cofactor 1)."""
     ctr = 0

@@ -103,6 +103,8 @@ def issue(isk: IssuerKey, attrs: Sequence[int]) -> Credential:
 
 def verify_credential(ipk: IssuerPublicKey, cred: Credential) -> bool:
     """e(A, w * g2^e) == e(B, g2) (signature.go credential check)."""
+    if cred.A is None or not bn.g1_on_curve(cred.A):
+        return False
     lhs = bn.pairing(cred.A, bn.g2_add(ipk.w, bn.g2_mul(cred.e, bn.G2_GEN)))
     rhs = bn.pairing(cred.B(ipk), bn.G2_GEN)
     return lhs == rhs
@@ -170,6 +172,11 @@ def verify_presentation(ipk: IssuerPublicKey, pres: Presentation,
                         nonce: bytes) -> bool:
     # reject (never crash on) degenerate attacker-supplied points
     if any(p is None for p in (pres.A_prime, pres.A_bar, pres.d)):
+        return False
+    # invalid-curve gate: the group ops and the Tate pairing operate
+    # blindly on off-curve coordinates; soundness requires membership
+    if not all(bn.g1_on_curve(p)
+               for p in (pres.A_prime, pres.A_bar, pres.d)):
         return False
     # (1) pairing check: e(A', w) == e(A_bar, g2)
     if bn.pairing(pres.A_prime, ipk.w) != bn.pairing(pres.A_bar, bn.G2_GEN):

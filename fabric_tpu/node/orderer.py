@@ -95,11 +95,20 @@ class OrdererNode:
                              self.signer, msps)
         peers = {int(p["raft_id"]): (p.get("host", "127.0.0.1"), int(p["port"]))
                  for p in cfg["cluster"] if int(p["raft_id"]) != self.raft_id}
-        peer_cns = {int(p["raft_id"]): p["cn"]
-                    for p in cfg["cluster"] if p.get("cn")}
+        # consenter auth is mandatory: every cluster entry must carry its
+        # identity binding (mspid + cert sha256) or the node refuses to run
+        consenters = {}
+        for p in cfg["cluster"]:
+            if not p.get("mspid") or not p.get("cert_fp"):
+                raise ValueError(
+                    f"cluster entry for raft_id {p.get('raft_id')} is "
+                    "missing mspid/cert_fp — consenter identities must be "
+                    "bound to certificate fingerprints (re-provision the "
+                    "network; CN-based configs are no longer accepted)")
+            consenters[int(p["raft_id"])] = (p["mspid"], p["cert_fp"])
         self.cluster = ClusterService(self.support.chain, self.rpc,
                                       self.signer, msps, peers,
-                                      peer_cns=peer_cns)
+                                      consenters=consenters)
         self.rpc.serve("broadcast", self._rpc_broadcast)
         self.rpc.serve("status", self._rpc_status)
         self.rpc.serve_stream("deliver", self._rpc_deliver)

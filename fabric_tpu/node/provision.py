@@ -66,14 +66,20 @@ def provision_orderers(base_dir: str, n: int, channel_id: str = "ch",
     )
     cfg_hex = cfg.serialize().hex()
 
+    # issue every consenter identity first so the shared cluster list can
+    # bind raft ids to certificate fingerprints (not forgeable CN strings)
+    from fabric_tpu.orderer.cluster import cert_fingerprint
+
+    creds = [org.issuer.issue(f"orderer{i + 1}@OrdererOrg") for i in range(n)]
     cluster = [{"raft_id": i + 1, "host": "127.0.0.1", "port": ports[i],
-                "cn": f"orderer{i + 1}@OrdererOrg"}
+                "mspid": "OrdererOrg",
+                "cert_fp": cert_fingerprint(creds[i][0])}
                for i in range(n)]
     paths = []
     for i in range(n):
         node_dir = os.path.join(base_dir, f"orderer{i + 1}")
         os.makedirs(node_dir, exist_ok=True)
-        cert, key = org.issuer.issue(f"orderer{i + 1}@OrdererOrg")
+        cert, key = creds[i]
         node_cfg = {
             "mspid": "OrdererOrg",
             "raft_id": i + 1,

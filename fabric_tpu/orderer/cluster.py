@@ -22,14 +22,17 @@ from fabric_tpu.orderer import raft as raftmod
 logger = logging.getLogger("fabric_tpu.orderer.cluster")
 
 
-def _cert_cn(identity) -> str:
-    from cryptography.x509.oid import NameOID
-    try:
-        attrs = identity.cert.subject.get_attributes_for_oid(
-            NameOID.COMMON_NAME)
-        return attrs[0].value if attrs else ""
-    except Exception:
-        return ""
+def cert_fingerprint(cert) -> str:
+    """sha256 hex of the DER certificate — the consenter binding token.
+
+    CN strings are forgeable by any org's CA; the full certificate hash
+    is not (the reference authenticates the sender's actual TLS cert
+    against the consenter set, cluster/comm.go).
+    """
+    import hashlib
+    from cryptography.hazmat.primitives import serialization
+    der = cert.public_bytes(serialization.Encoding.DER)
+    return hashlib.sha256(der).hexdigest()
 
 
 class _PeerSender:
@@ -138,17 +141,23 @@ class ClusterService:
     def __init__(self, chain, rpc: RpcServer, signer, msps,
                  peers: Dict[int, Tuple[str, int]],
                  tick_s: float = 0.05,
-                 peer_cns: Dict[int, str] = None):
+                 consenters: Dict[int, Tuple[str, str]] = None):
         self.chain = chain
         self.rpc = rpc
         self.signer = signer
         self.msps = msps
         self.peers = dict(peers)
-        # consenter authorization: raft id -> expected certificate common
-        # name.  Without it, any channel member could forge raft traffic
-        # claiming to be a consenter (cluster/comm.go authenticates the
-        # sender's TLS cert against the consenter set the same way).
-        self.peer_cns = dict(peer_cns or {})
+        # consenter authorization: raft id -> (mspid, sha256 cert
+        # fingerprint).  MANDATORY — without it any channel member could
+        # forge raft traffic claiming to be a consenter (cluster/comm.go
+        # authenticates the sender's actual cert against the consenter
+        # set).  Bound to the full cert hash, not a forgeable CN string.
+        if not consenters:
+            raise ValueError(
+                "ClusterService requires the consenter identity map "
+                "(raft id -> (mspid, cert sha256)); refusing to run an "
+                "unauthenticated raft transport")
+        self.consenters = dict(consenters)
         self.tick_s = tick_s
         self._lock = threading.Lock()
         self._stop = threading.Event()
@@ -168,14 +177,20 @@ class ClusterService:
         if msg.frm not in self.peers and msg.frm != self.chain.node.id:
             logger.warning("raft message from unknown node %s", msg.frm)
             return
-        expected_cn = self.peer_cns.get(msg.frm)
-        if expected_cn is not None:
-            cn = _cert_cn(peer_identity)
-            if cn != expected_cn:
-                logger.warning(
-                    "raft message claiming node %s from identity %r — "
-                    "dropped (consenter authorization)", msg.frm, cn)
-                return
+        expected = self.consenters.get(msg.frm)
+        if expected is None:
+            logger.warning("raft message from non-consenter node %s — "
+                           "dropped", msg.frm)
+            return
+        mspid, fp = expected
+        got_msp = getattr(peer_identity, "mspid", None)
+        got_fp = cert_fingerprint(peer_identity.cert)
+        if got_msp != mspid or got_fp != fp:
+            logger.warning(
+                "raft message claiming node %s from identity %s/%s... — "
+                "dropped (consenter authorization)", msg.frm, got_msp,
+                got_fp[:16])
+            return
         self.chain.step(msg)
         self._wake.set()
 

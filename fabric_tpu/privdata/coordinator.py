@@ -32,6 +32,11 @@ class MissingPvtData:
     txid: str
     namespace: str
     collection: str
+    # on-chain hashed writes (hashed key -> hashed value, None = delete):
+    # reconciliation MUST re-verify fetched cleartext against these — a
+    # malicious peer answering the pull must not be able to poison state
+    # (reference: gossip/privdata/reconcile.go verifies vs the block).
+    expected: Dict[str, object] = field(default_factory=dict)
 
 
 class Coordinator:
@@ -85,7 +90,7 @@ class Coordinator:
                 clear = self._resolve(txid, ns, coll, expected)
                 if clear is None:
                     self.missing.append(MissingPvtData(
-                        block.header.number, txid, ns, coll))
+                        block.header.number, txid, ns, coll, dict(expected)))
                     continue
                 writes.setdefault((ns, coll), {}).update(clear)
                 btl[(ns, coll)] = cfg.block_to_live
@@ -124,14 +129,20 @@ class Coordinator:
         still = []
         for m in self.missing:
             fetched = self.fetch(m.txid, m.namespace, m.collection)
-            if fetched:
+            verified = _match_hashes(fetched, m.expected) if fetched else None
+            if verified is not None:
                 cfg = self.registry.get(m.namespace, m.collection)
                 self.pvt_store.commit(
-                    m.block_num, {(m.namespace, m.collection): fetched},
+                    m.block_num, {(m.namespace, m.collection): verified},
                     {(m.namespace, m.collection):
                      cfg.block_to_live if cfg else 0})
                 recovered += 1
             else:
+                if fetched:
+                    logger.warning(
+                        "reconcile: fetched pvtdata for %s %s/%s failed "
+                        "hash verification; discarding", m.txid,
+                        m.namespace, m.collection)
                 still.append(m)
         self.missing = still
         return recovered

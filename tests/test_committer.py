@@ -159,3 +159,100 @@ def test_unknown_namespace_policy_rejected(world):
     res = committer.store_block(next_block(committer, [env]))
     assert res.validation.flags.codes() == [
         int(ValidationCode.INVALID_CHAINCODE)]
+
+
+# -- commit-time config-tx validation (ADVICE r2: unauthorized config txs
+# must be recorded INVALID, never committed as VALID) ------------------------
+
+def _config_world(sw_provider, tmp_path):
+    from fabric_tpu.config import (Bundle, BundleSource, ChannelConfig,
+                                   OrgConfig, default_policies)
+    org1 = DevOrg("Org1")
+    mc = org1.msp_config()
+    cfg0 = ChannelConfig(
+        channel_id="ch", sequence=0,
+        orgs=(OrgConfig(mspid="Org1", root_certs=tuple(mc.root_certs_pem),
+                        admins=tuple(mc.admin_certs_pem)),),
+        policies=default_policies(["Org1"]))
+    src = BundleSource(Bundle(cfg0))
+    policies = PolicyRegistry(parse_policy("OR('Org1.member')"))
+    ledger = KVLedger("ch", LedgerConfig(root=str(tmp_path)))
+    validator = TxValidator("ch", None, sw_provider, policies,
+                            bundle_source=src)
+    committer = Committer(ledger, validator, bundle_source=src,
+                          provider=sw_provider)
+    return org1, cfg0, src, committer
+
+
+def _new_cfg(org1, cfg0, sequence):
+    from dataclasses import replace
+    return replace(cfg0, sequence=sequence)
+
+
+def test_unauthorized_config_tx_flagged_invalid_at_commit(sw_provider,
+                                                          tmp_path):
+    from fabric_tpu.config import build_config_envelope
+    org1, cfg0, src, committer = _config_world(sw_provider, tmp_path)
+
+    # wrong sequence (5 != 1): must be committed INVALID, bundle unchanged
+    bad = build_config_envelope(_new_cfg(org1, cfg0, 5), [org1.admin])
+    res = committer.store_block(next_block(committer, [bad]))
+    assert not res.final_flags.is_valid(0)
+    assert (res.final_flags.flag(0)
+            == ValidationCode.INVALID_CONFIG_TRANSACTION)
+    assert src.current().sequence == 0
+
+    # non-admin signer: Admins policy unsatisfied -> INVALID
+    member_signed = build_config_envelope(_new_cfg(org1, cfg0, 1),
+                                          [org1.new_identity("m")])
+    res = committer.store_block(next_block(committer, [member_signed]))
+    assert (res.final_flags.flag(0)
+            == ValidationCode.INVALID_CONFIG_TRANSACTION)
+    assert src.current().sequence == 0
+
+    # a correct update still applies
+    good = build_config_envelope(_new_cfg(org1, cfg0, 1), [org1.admin])
+    res = committer.store_block(next_block(committer, [good]))
+    assert res.final_flags.is_valid(0)
+    assert src.current().sequence == 1
+
+
+def test_config_tx_in_multi_tx_block_invalid(sw_provider, tmp_path):
+    """A config tx smuggled into a multi-tx block by a byzantine orderer is
+    flagged invalid outright (config txs must ride alone)."""
+    from fabric_tpu.config import build_config_envelope
+    org1, cfg0, src, committer = _config_world(sw_provider, tmp_path)
+
+    normal = build.endorser_tx("ch", "cc", "1.0",
+                               rw(writes=[KVWrite("k", b"v")]),
+                               org1.new_identity("client"),
+                               [org1.new_identity("e1")])
+    cfg_env = build_config_envelope(_new_cfg(org1, cfg0, 1), [org1.admin])
+    res = committer.store_block(next_block(committer, [normal, cfg_env]))
+    assert res.final_flags.is_valid(0)
+    assert (res.final_flags.flag(1)
+            == ValidationCode.INVALID_CONFIG_TRANSACTION)
+    assert src.current().sequence == 0
+
+
+def test_config_block_replay_keeps_valid_flags(sw_provider, tmp_path):
+    """A peer bootstrapped at a later config catching up through an old
+    config block must NOT re-judge it against the current bundle (that
+    would permanently flag a historically-valid config tx invalid)."""
+    from fabric_tpu.config import build_config_envelope
+    org1, cfg0, src, committer = _config_world(sw_provider, tmp_path / "a")
+    good = build_config_envelope(_new_cfg(org1, cfg0, 1), [org1.admin])
+    block = next_block(committer, [good])
+    res = committer.store_block(block)
+    assert res.final_flags.is_valid(0) and src.current().sequence == 1
+
+    # fresh peer provisioned directly at sequence 1 replays the chain
+    org1b, cfg0b, src2, committer2 = _config_world(sw_provider,
+                                                   tmp_path / "b")
+    from fabric_tpu.config import Bundle
+    src2.update(Bundle(_new_cfg(org1, cfg0, 1)))
+    import dataclasses
+    replay = dataclasses.replace(block)
+    res2 = committer2.store_block(replay)
+    assert res2.final_flags.is_valid(0)          # flags match the tip peer
+    assert src2.current().sequence == 1          # nothing re-applied

@@ -95,3 +95,20 @@ def test_presentation_requires_valid_credential(setup):
                         list(cred.attrs))
     pres = present(ipk, forged, disclose=[0], nonce=b"n")
     assert not verify_presentation(ipk, pres, b"n")
+
+
+def test_presentation_rejects_off_curve_points(setup):
+    """Invalid-curve gate (ADVICE r2): attacker-supplied points not on
+    y^2 = x^3 + 2 must be rejected before any group/pairing math runs."""
+    from dataclasses import replace
+    isk, ipk, cred, attrs = setup
+    pres = present(ipk, cred, disclose=[0], nonce=b"n")
+    assert verify_presentation(ipk, pres, b"n")
+    off = (pres.A_prime[0], (pres.A_prime[1] + 1) % bn.P)
+    assert not bn.g1_on_curve(off)
+    for fld in ("A_prime", "A_bar", "d"):
+        bad = replace(pres, **{fld: off})
+        assert not verify_presentation(ipk, bad, b"n")
+    # out-of-range coordinates are rejected too
+    big = (pres.A_prime[0] + bn.P, pres.A_prime[1])
+    assert not verify_presentation(ipk, replace(pres, A_prime=big), b"n")

@@ -156,3 +156,31 @@ def test_tampered_cleartext_rejected(org, provider, tmp_path):
     # hash mismatch: cleartext NOT committed, recorded as missing
     assert pvt.get("cc", "secrets", "sec1") is None
     assert len(coord.missing) == 1
+
+
+def test_reconcile_rejects_poisoned_fetch(org, provider, tmp_path):
+    """A malicious peer answering the reconciliation pull must not be able
+    to poison committed private state: fetched data is re-verified against
+    the block's hashed writes (reconcile.go parity)."""
+    served = {}
+
+    def fetch(txid, ns, coll):
+        return served.get((txid, ns, coll))
+
+    coord, transient, pvt, ledger = make_peer(org, provider, fetch=fetch,
+                                              tmp=str(tmp_path))
+    env = pvt_tx(org, 1, transient=None)
+    commit_block(coord, ledger, [env])
+    assert len(coord.missing) == 1
+
+    txid = env.header().channel_header.txid
+    # poisoned answer: right key, wrong value
+    served[(txid, "cc", "secrets")] = {"sec1": b"poison"}
+    assert coord.reconcile() == 0
+    assert pvt.get("cc", "secrets", "sec1") is None
+    assert len(coord.missing) == 1      # still missing, retried later
+
+    # honest answer afterwards still lands
+    served[(txid, "cc", "secrets")] = {"sec1": b"classified"}
+    assert coord.reconcile() == 1
+    assert pvt.get("cc", "secrets", "sec1") == b"classified"
