@@ -6,15 +6,20 @@ reference's bccsp/sw path, /root/reference/bccsp/sw/ecdsa.go:41 —
 approximated by OpenSSL via `cryptography`, which is faster than Go's
 crypto/ecdsa, making the comparison conservative).
 
-Round-2 honesty upgrades (VERDICT.md weak #2/#7):
-  - reports BOTH baselines: single-core OpenSSL and all-core OpenSSL
-    (process pool, mirroring validatorPoolSize = NumCPU,
-    /root/reference/core/peer/config.go:251-253); vs_baseline keeps the
-    round-1 definition (single-core) and vs_allcore is reported alongside;
-  - measures p50 block-validation latency through the actual
-    verify-then-gate pipeline (10k txs x (1 creator + 3 endorsement) sigs);
-  - enables the persistent compilation cache and warms the kernel before
-    timing (first-dispatch latency reported separately).
+Round-3 methodology:
+  - The HEADLINE number is the end-to-end PROVIDER rate (DER parsing,
+    packing, dispatch, verdicts — the bccsp boundary of
+    /root/reference/bccsp/sw/impl.go:247) on the reference workload: a
+    10k-tx block's 40k signatures = 3 endorsements/tx from 3 org keys +
+    1 creator sig/tx from a 64-client population, measured steady-state
+    (key comb tables cached — the fixed-base fast path of
+    ops/p256_fixed.py; the reference's msp/cache is the analogous
+    repeat-identity assumption).
+  - detail reports the conservative variant (every creator key distinct
+    — generic-ladder path for 25% of sigs), raw kernel rates for both
+    paths, ed25519 + mixed-curve rates (BASELINE configs 2-3), block-
+    pipeline p50 through the verify-then-gate validator, and the
+    cold-compile/warm split.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
@@ -37,32 +42,74 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.expanduser("~/.cache/fabric_tpu_xla"))
 
 
-def gen_cases(n_distinct: int, n_keys: int = 8):
+# ---------------------------------------------------------------------------
+# workload generation
+# ---------------------------------------------------------------------------
+
+def gen_p256_sigs(n: int, n_keys: int, seed: int = 2026):
+    """n ECDSA-P256 (VerifyItem, der_pub, der_sig, msg) over n_keys keys."""
     from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        decode_dss_signature, encode_dss_signature)
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat)
     from cryptography.hazmat.primitives import hashes
 
+    from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
     from fabric_tpu.ops import p256
 
-    rng = random.Random(2026)
+    rng = random.Random(seed)
     keys = [ec.generate_private_key(ec.SECP256R1()) for _ in range(n_keys)]
-    cases = []
-    for i in range(n_distinct):
-        key = keys[i % n_keys]
-        pub = key.public_key().public_numbers()
+    pubs = [k.public_key().public_bytes(Encoding.X962,
+                                        PublicFormat.UncompressedPoint)
+            for k in keys]
+    ders = [k.public_key().public_bytes(Encoding.DER,
+                                        PublicFormat.SubjectPublicKeyInfo)
+            for k in keys]
+    items, cpu_sigs = [], []
+    for i in range(n):
+        ki = i % n_keys
         msg = rng.randbytes(64)
-        digest = int.from_bytes(hashlib.sha256(msg).digest(), "big")
-        r, s = decode_dss_signature(key.sign(msg, ec.ECDSA(hashes.SHA256())))
+        digest = hashlib.sha256(msg).digest()
+        r, s = decode_dss_signature(keys[ki].sign(msg,
+                                                  ec.ECDSA(hashes.SHA256())))
         if s > p256.HALF_N:
             s = p256.N - s
-        cases.append((pub.x, pub.y, r, s, digest, key.public_key(), msg))
-    return cases
+        sig = encode_dss_signature(r, s)
+        items.append(VerifyItem(SCHEME_P256, pubs[ki], sig, digest))
+        cpu_sigs.append((ders[ki], sig, msg))
+    return items, cpu_sigs
 
+
+def gen_ed25519_sigs(n: int, n_keys: int = 8, seed: int = 7):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey)
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat)
+
+    from fabric_tpu.bccsp import SCHEME_ED25519, VerifyItem
+
+    rng = random.Random(seed)
+    keys = [Ed25519PrivateKey.generate() for _ in range(n_keys)]
+    pubs = [k.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+            for k in keys]
+    items = []
+    for i in range(n):
+        msg = rng.randbytes(64)
+        items.append(VerifyItem(SCHEME_ED25519, pubs[i % n_keys],
+                                keys[i % n_keys].sign(msg), msg))
+    return items
+
+
+# ---------------------------------------------------------------------------
+# CPU baseline (OpenSSL)
+# ---------------------------------------------------------------------------
 
 def _cpu_worker(args):
     der_sigs, seconds = args
     from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.serialization import load_der_public_key
+    from cryptography.hazmat.primitives.serialization import (
+        load_der_public_key)
     from cryptography.hazmat.primitives import hashes
     sigs = [(load_der_public_key(pk), sig, msg) for pk, sig, msg in der_sigs]
     n = 0
@@ -74,58 +121,31 @@ def _cpu_worker(args):
     return n / (time.perf_counter() - t0)
 
 
-def bench_cpu_openssl(cases, seconds: float = 2.0, procs: int = 1) -> float:
-    """OpenSSL ECDSA-P256 verifies/sec across `procs` processes."""
-    from cryptography.hazmat.primitives.asymmetric.utils import encode_dss_signature
-    from cryptography.hazmat.primitives.serialization import (
-        Encoding, PublicFormat)
-
-    der = [(c[5].public_bytes(Encoding.DER, PublicFormat.SubjectPublicKeyInfo),
-            encode_dss_signature(c[2], c[3]), c[6]) for c in cases]
+def bench_cpu_openssl(cpu_sigs, seconds: float = 2.0, procs: int = 1):
     if procs == 1:
-        return _cpu_worker((der, seconds))
+        return _cpu_worker((cpu_sigs[:256], seconds))
     with multiprocessing.Pool(procs) as pool:
-        rates = pool.map(_cpu_worker, [(der, seconds)] * procs)
+        rates = pool.map(_cpu_worker, [(cpu_sigs[:256], seconds)] * procs)
     return sum(rates)
 
 
-def bench_tpu(cases, batch: int, iters: int = 5):
-    import jax
-    from fabric_tpu.ops import p256
+# ---------------------------------------------------------------------------
+# provider-level benchmarks
+# ---------------------------------------------------------------------------
 
-    reps = (batch + len(cases) - 1) // len(cases)
-    tiled = (cases * reps)[:batch]
-    qx, qy, r, s, e, _, _ = zip(*tiled)
-    args = [p256.ints_to_words(list(v)) for v in (qx, qy, r, s, e)]
-
-    if jax.default_backend() == "cpu":
-        from fabric_tpu.ops import ecp256
-        fn = lambda *a: ecp256.verify_words_xla(*a)
-    elif os.environ.get("FABRIC_TPU_PALLAS") == "1":
-        from fabric_tpu.ops import p256_pallas
-        fn = lambda *a: p256_pallas.verify_words(*a)
-    else:
-        from fabric_tpu.ops import bignum as bn, ecp256
-        tab = ecp256.comb_table_f32()
-
-        # the words->limbs conversion must live INSIDE the jit: eagerly it
-        # costs dozens of tunneled device dispatches per call
-        def whole(qx, qy, r, s, e):
-            limbs = [bn.words_be_to_limbs(v) for v in (qx, qy, r, s, e)]
-            return ecp256.verify_body(*limbs, tab, require_low_s=True)
-        fn = jax.jit(whole)
-
+def time_batches(provider, items, iters: int = 3):
+    """(rate sigs/s, per-call s, first-call s) for provider.batch_verify."""
     t0 = time.perf_counter()
-    out = fn(*args)
-    jax.block_until_ready(out)
-    compile_and_first = time.perf_counter() - t0
-    assert bool(np.asarray(out).all()), "benchmark signatures must all verify"
-    t0 = time.perf_counter()
+    out = provider.batch_verify(items)
+    first_s = time.perf_counter() - t0
+    assert bool(np.asarray(out).all()), "benchmark signatures must verify"
+    times = []
     for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    dt = (time.perf_counter() - t0) / iters
-    return batch / dt, dt, compile_and_first
+        t0 = time.perf_counter()
+        out = provider.batch_verify(items)
+        times.append(time.perf_counter() - t0)
+    dt = statistics.median(times)
+    return len(items) / dt, dt, first_s
 
 
 def bench_block_p50(provider, n_tx: int = 10000, endorsers: int = 3,
@@ -169,44 +189,90 @@ def bench_block_p50(provider, n_tx: int = 10000, endorsers: int = 3,
     return statistics.median(times), vr
 
 
+def _kernel_name() -> str:
+    import jax
+    if jax.default_backend() == "cpu":
+        return "xla-cpu-eager"
+    if os.environ.get("FABRIC_TPU_PALLAS") == "1":
+        return "pallas+fixedcomb-multikey"
+    return "xla-fixedcomb-multikey+ladder"
+
+
 def main():
-    batch = int(os.environ.get("BENCH_BATCH", "16384"))
+    n_tx = int(os.environ.get("BENCH_BLOCK_TXS", "10000"))
     ncpu = os.cpu_count() or 1
-    cases = gen_cases(256)
-    cpu_rate_1 = bench_cpu_openssl(cases, procs=1)
-    cpu_rate_all = bench_cpu_openssl(cases, seconds=1.0, procs=ncpu)
-    tpu_rate, step_s, compile_s = bench_tpu(cases, batch)
+
+    # -- workloads ----------------------------------------------------------
+    # endorsements: 3 sigs/tx from 3 org keys (the fast-path shape)
+    endorse_items, cpu_sigs = gen_p256_sigs(3 * n_tx, n_keys=3)
+    # creators: every key distinct — conservative worst case, every
+    # creator sig rides the generic windowed-ladder kernel
+    distinct_creators, _ = gen_p256_sigs(n_tx, n_keys=n_tx, seed=13)
+
+    cpu_rate_1 = bench_cpu_openssl(cpu_sigs, procs=1)
+    cpu_rate_all = bench_cpu_openssl(cpu_sigs, seconds=1.0, procs=ncpu)
+
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    provider = init_factories(FactoryOpts(default="JAXTPU"))
 
     detail = {
-        "batch": batch,
-        "tpu_step_ms": round(step_s * 1e3, 2),
         "cpu_openssl_1core_sigs_per_sec": round(cpu_rate_1, 1),
         "cpu_openssl_allcore_sigs_per_sec": round(cpu_rate_all, 1),
         "cpu_cores": ncpu,
-        "vs_allcore": round(tpu_rate / cpu_rate_all, 2),
-        "compile_plus_first_s": round(compile_s, 2),
         "device": str(__import__("jax").devices()[0]),
-        "kernel": ("pallas" if os.environ.get("FABRIC_TPU_PALLAS") == "1"
-                   else "xla-windowed"),
+        "kernel": _kernel_name(),
+        "block_txs": n_tx,
     }
 
+    # -- headline: the reference block workload, end-to-end provider rate --
+    # 40k sigs = 3 org endorsements/tx (merged multikey fast path) + 1
+    # distinct-key creator sig/tx (generic path); two device dispatches.
+    mixed = endorse_items + distinct_creators
+    fast_before = provider.stats["fast_key_sigs"]
+    rate, step_s, first_s = time_batches(provider, mixed)
+    calls = 4                               # 1 warmup + 3 timed
+    detail["mixed_steady_ms"] = round(step_s * 1e3, 2)
+    detail["compile_plus_first_s"] = round(first_s, 2)
+    detail["fast_key_sigs_per_block"] = (
+        provider.stats["fast_key_sigs"] - fast_before) // calls
+
+    # -- per-lane rates ------------------------------------------------------
+    rate_fast, _, _ = time_batches(provider, endorse_items, iters=3)
+    detail["fixed_path_sigs_per_sec"] = round(rate_fast, 1)
+    detail["vs_baseline_fixed_path"] = round(rate_fast / cpu_rate_1, 2)
+    rate_gen, _, _ = time_batches(provider, distinct_creators, iters=3)
+    detail["generic_path_sigs_per_sec"] = round(rate_gen, 1)
+
+    # -- BASELINE configs 2/3: ed25519 and mixed-curve ----------------------
+    if os.environ.get("BENCH_SKIP_ED") != "1":
+        try:
+            ed_items = gen_ed25519_sigs(n_tx)
+            rate_ed, _, ed_first = time_batches(provider, ed_items, iters=2)
+            detail["ed25519_sigs_per_sec"] = round(rate_ed, 1)
+            detail["ed25519_compile_s"] = round(ed_first, 2)
+            mixed_curve = endorse_items[:2 * n_tx] + ed_items[:n_tx]
+            rate_mc, _, _ = time_batches(provider, mixed_curve, iters=2)
+            detail["mixed_curve_sigs_per_sec"] = round(rate_mc, 1)
+        except Exception as exc:
+            detail["ed25519_error"] = str(exc)[:200]
+
+    # -- block pipeline p50 --------------------------------------------------
     if os.environ.get("BENCH_SKIP_BLOCK") != "1":
         try:
-            from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
-            provider = init_factories(FactoryOpts(default="JAXTPU"))
-            n_tx = int(os.environ.get("BENCH_BLOCK_TXS", "10000"))
             p50, vr = bench_block_p50(provider, n_tx=n_tx)
             detail["block_p50_s"] = round(p50, 3)
-            detail["block_txs"] = n_tx
             detail["block_sigs"] = n_tx * 4
+            detail["block_collect_s"] = round(vr.collect_s, 3)
+            detail["block_dispatch_s"] = round(vr.dispatch_s, 3)
+            detail["block_gate_s"] = round(vr.gate_s, 3)
         except Exception as exc:  # keep the headline number robust
             detail["block_p50_error"] = str(exc)[:200]
 
     result = {
         "metric": "ecdsa_p256_sig_verifies_per_sec",
-        "value": round(tpu_rate, 1),
+        "value": round(rate, 1),
         "unit": "sigs/s",
-        "vs_baseline": round(tpu_rate / cpu_rate_1, 2),
+        "vs_baseline": round(rate / cpu_rate_1, 2),
         "detail": detail,
     }
     print(json.dumps(result))

@@ -50,12 +50,23 @@ class JaxTpuProvider(prov.Provider):
 
     def __init__(self, require_low_s: bool = True, mesh=None,
                  fallback: Optional[SoftwareProvider] = None):
+        import os
         self.require_low_s = require_low_s
         self.mesh = mesh
         self.fallback = fallback or SoftwareProvider(require_low_s=require_low_s)
         self._fns = {}
         self.stats = {"dispatches": 0, "device_sigs": 0, "host_rejects": 0,
-                      "fallbacks": 0}
+                      "fallbacks": 0, "fast_key_sigs": 0}
+        # per-key fixed-base fast path (ops/p256_fixed.py): keys whose comb
+        # table is cached skip the variable-point ladder entirely.  A table
+        # build costs ~15 ms host-side, so uncached keys only earn one when
+        # a single batch brings at least `fast_key_threshold` signatures
+        # (endorser keys easily do; one-off client keys never will).
+        from fabric_tpu.ops.p256_tables import KeyTableCache
+        self.key_tables = KeyTableCache(
+            max_keys=int(os.environ.get("FABRIC_TPU_KEY_CACHE", "64")))
+        self.fast_key_threshold = int(
+            os.environ.get("FABRIC_TPU_FAST_KEY_THRESHOLD", "1024"))
 
     # signing / key-gen are host-side: delegate
     def key_gen(self, scheme: str):
@@ -102,6 +113,22 @@ class JaxTpuProvider(prov.Provider):
                             return ecp256.verify_body(
                                 *args, _tab, require_low_s=low_s)
                         self._fns[key] = jax.jit(whole)
+            elif scheme == "p256-multikey":
+                from fabric_tpu.ops import p256_fixed
+                low_s = self.require_low_s
+                if self.mesh is not None:
+                    from fabric_tpu.parallel import mesh as meshmod
+                    f = meshmod.sharded_p256_multikey_verify(
+                        self.mesh, self.require_low_s)
+                    self._fns[key] = lambda *a: f(*a)[0]
+                elif jax.default_backend() == "cpu":
+                    self._fns[key] = (
+                        lambda *a: p256_fixed.verify_words_multikey(
+                            *a, require_low_s=low_s))
+                else:
+                    self._fns[key] = jax.jit(
+                        lambda *a: p256_fixed.verify_words_multikey(
+                            *a, require_low_s=low_s))
             elif scheme == SCHEME_ED25519:
                 from fabric_tpu.ops import ed25519
                 if self.mesh is not None:
@@ -114,9 +141,10 @@ class JaxTpuProvider(prov.Provider):
                 raise ValueError(f"unsupported scheme {scheme!r}")
         return self._fns[key]
 
-    def _pack_p256(self, items, idxs):
-        """-> (ok_idx, arrays) with malformed items dropped (verdict False)."""
-        qx, qy, r, s, e, keep = [], [], [], [], [], []
+    def _parse_p256(self, items, idxs):
+        """Host-side parse: -> list of (idx, pubkey, r32, s32, e32) with
+        malformed items dropped (verdict stays False)."""
+        out = []
         for i in idxs:
             it = items[i]
             try:
@@ -131,17 +159,27 @@ class JaxTpuProvider(prov.Provider):
             except Exception:
                 self.stats["host_rejects"] += 1
                 continue
-            qx.append(int.from_bytes(pk[1:33], "big"))
-            qy.append(int.from_bytes(pk[33:65], "big"))
-            r.append(ri)
-            s.append(si)
-            e.append(int.from_bytes(it.payload, "big"))
-            keep.append(i)
-        if not keep:
+            out.append((i, pk, ri.to_bytes(32, "big"),
+                        si.to_bytes(32, "big"), it.payload))
+        return out
+
+    def _pack_p256(self, items, idxs):
+        """Generic-lane packing: -> (ok_idx, [qx qy r s e] word arrays)."""
+        recs = self._parse_p256(items, idxs)
+        return self._pack_p256_recs(recs)
+
+    @staticmethod
+    def _pack_p256_recs(recs):
+        if not recs:
             return [], None
         from fabric_tpu.ops import p256 as p256mod
-        arrays = [p256mod.ints_to_words(v) for v in (qx, qy, r, s, e)]
-        return keep, arrays
+        keep = [rec[0] for rec in recs]
+        qx = p256mod.bytes32_to_words([rec[1][1:33] for rec in recs])
+        qy = p256mod.bytes32_to_words([rec[1][33:65] for rec in recs])
+        r = p256mod.bytes32_to_words([rec[2] for rec in recs])
+        s = p256mod.bytes32_to_words([rec[3] for rec in recs])
+        e = p256mod.bytes32_to_words([rec[4] for rec in recs])
+        return keep, [qx, qy, r, s, e]
 
     def _pack_ed25519(self, items, idxs):
         keep, pks, sigs, msgs = [], [], [], []
@@ -173,38 +211,119 @@ class JaxTpuProvider(prov.Provider):
             out.append(np.pad(a, widths))
         return out
 
-    # -- the batch verb -----------------------------------------------------
+    # -- dispatch helpers ---------------------------------------------------
 
-    def batch_verify(self, items: Sequence[VerifyItem]) -> np.ndarray:
+    def _dispatch(self, fn, keep, arrays, pending, extra_args=()):
+        """Pad to buckets, chunk beyond MAX_BUCKET (bounds the compiled-
+        program set while arbitrarily large blocks still use the device),
+        ENQUEUE the device calls (jax dispatch is async), and record
+        (keep, out) pairs for the resolve step."""
+        for lo in range(0, len(keep), MAX_BUCKET):
+            hi = min(lo + MAX_BUCKET, len(keep))
+            chunk = [a[..., lo:hi] for a in arrays]
+            padded = self._pad(chunk, hi - lo)
+            out = fn(*extra_args, *padded)
+            self.stats["dispatches"] += 1
+            self.stats["device_sigs"] += hi - lo
+            pending.append((keep[lo:hi], out))
+
+    # fast-lane key capacity per dispatch: NK is a compiled shape, so it
+    # is bucketed; beyond the largest bucket, the hottest keys win and
+    # the rest spill to the generic lane (the one-hot joint lookup cost
+    # scales with NK, so NK stays small)
+    FAST_NK_BUCKETS = (4,)
+
+    def _verify_p256(self, items, idxs, pending):
+        """Two-lane P-256 dispatch: signatures under cached (or
+        cache-worthy) public keys take the fixed-base multikey comb
+        kernel in ONE merged dispatch — the key-repetitive endorsement
+        workload of SURVEY.md §3.2 — and the rest take the generic
+        windowed-ladder kernel.  Dispatches are merged because relayed
+        TPU transports charge a full round trip per dispatch."""
+        recs = self._parse_p256(items, idxs)
+        groups = {}
+        for rec in recs:
+            groups.setdefault(rec[1], []).append(rec)
+        generic, fast = [], []
+        for pk, g in groups.items():
+            tab = None
+            if pk in self.key_tables or len(g) >= self.fast_key_threshold:
+                tab = self.key_tables.get_or_build(pk)
+            if tab is None:
+                generic.extend(g)
+            else:
+                fast.append((tab, g))
+        fast.sort(key=lambda t: -len(t[1]))
+        max_nk = self.FAST_NK_BUCKETS[-1]
+        for _, g in fast[max_nk:]:
+            generic.extend(g)
+        fast = fast[:max_nk]
+        if fast:
+            from fabric_tpu.ops import p256 as p256mod
+            nk = next(b for b in self.FAST_NK_BUCKETS if b >= len(fast))
+            tabs = np.stack(
+                [t for t, _ in fast]
+                + [fast[0][0]] * (nk - len(fast))).astype(np.float32)
+            frecs, key_idx = [], []
+            for ki, (_, g) in enumerate(fast):
+                frecs.extend(g)
+                key_idx.extend([ki] * len(g))
+            keep = [rec[0] for rec in frecs]
+            arrays = [np.asarray(key_idx, dtype=np.int32)] + [
+                p256mod.bytes32_to_words([rec[j] for rec in frecs])
+                for j in (2, 3, 4)]
+            self._dispatch(self._get_fn("p256-multikey"), keep, arrays,
+                           pending, extra_args=(tabs,))
+            self.stats["fast_key_sigs"] += len(keep)
+        generic.sort(key=lambda rec: rec[0])
+        keep, arrays = self._pack_p256_recs(generic)
+        if keep:
+            self._dispatch(self._get_fn(SCHEME_P256), keep, arrays, pending)
+
+    # -- the batch verbs ----------------------------------------------------
+
+    def batch_verify_async(self, items: Sequence[VerifyItem]):
+        """Enqueue device verification and return resolve() -> bool[N].
+
+        The device work races ahead while the caller keeps collecting
+        (SURVEY.md §7 hard-part #3 overlap); resolve() blocks on the
+        results.  Fallback stays atomic: ANY device failure — at enqueue
+        or at resolve — recomputes the whole batch on the sw provider."""
+        items = list(items)
         verdicts = np.zeros(len(items), dtype=bool)
-        by_scheme = {}
-        for i, it in enumerate(items):
-            by_scheme.setdefault(it.scheme, []).append(i)
+        pending = []
         try:
+            by_scheme = {}
+            for i, it in enumerate(items):
+                by_scheme.setdefault(it.scheme, []).append(i)
             for scheme, idxs in by_scheme.items():
                 if scheme == SCHEME_P256:
-                    keep, arrays = self._pack_p256(items, idxs)
+                    self._verify_p256(items, idxs, pending)
                 elif scheme == SCHEME_ED25519:
                     keep, arrays = self._pack_ed25519(items, idxs)
+                    if keep:
+                        self._dispatch(self._get_fn(scheme), keep, arrays,
+                                       pending)
                 else:
                     self.stats["host_rejects"] += len(idxs)
-                    continue  # unknown scheme: all False
-                if not keep:
-                    continue
-                fn = self._get_fn(scheme)
-                # chunk batches beyond MAX_BUCKET so the compiled-program set
-                # stays bounded while arbitrarily large blocks still use TPU
-                for lo in range(0, len(keep), MAX_BUCKET):
-                    hi = min(lo + MAX_BUCKET, len(keep))
-                    chunk = [a[..., lo:hi] for a in arrays]
-                    padded = self._pad(chunk, hi - lo)
-                    out = np.asarray(fn(*padded))[:hi - lo]
-                    self.stats["dispatches"] += 1
-                    self.stats["device_sigs"] += hi - lo
-                    verdicts[np.asarray(keep[lo:hi])] = out
         except Exception:
-            # atomic fallback: recompute the WHOLE batch on the sw provider
-            logger.exception("TPU dispatch failed; falling back to sw provider")
+            logger.exception(
+                "TPU dispatch failed; falling back to sw provider")
             self.stats["fallbacks"] += 1
-            return self.fallback.batch_verify(items)
-        return verdicts
+            return lambda: self.fallback.batch_verify(items)
+
+        def resolve():
+            try:
+                for keep, out in pending:
+                    verdicts[np.asarray(keep)] = np.asarray(out)[:len(keep)]
+            except Exception:
+                logger.exception(
+                    "TPU resolve failed; falling back to sw provider")
+                self.stats["fallbacks"] += 1
+                return self.fallback.batch_verify(items)
+            return verdicts
+
+        return resolve
+
+    def batch_verify(self, items: Sequence[VerifyItem]) -> np.ndarray:
+        return self.batch_verify_async(items)()

@@ -75,5 +75,15 @@ class Provider:
         (policy.go:390-393 semantics)."""
         raise NotImplementedError
 
+    def batch_verify_async(self, items: Sequence[VerifyItem]):
+        """Start verifying a batch; returns resolve() -> bool[N].
+
+        Device providers override this to ENQUEUE the work and return
+        immediately, letting the caller overlap further host-side
+        collection with device compute (SURVEY.md §7 hard-part #3).  The
+        default is lazy-but-correct: work happens at resolve()."""
+        items = list(items)
+        return lambda: self.batch_verify(items)
+
     def hash(self, data: bytes, algo: str = HASH_SHA256) -> bytes:
         return hash_payload(data, algo)

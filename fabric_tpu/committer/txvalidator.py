@@ -327,6 +327,20 @@ class TxValidator:
         finally:
             self._msps_snapshot = None
 
+    @property
+    def overlap_chunk(self) -> int:
+        """Pass-1 sub-block chunk size: every CHUNK txs the newly-collected
+        unique items are dispatched to the device asynchronously, so host
+        collection of the NEXT chunk overlaps device verification of the
+        previous one (SURVEY.md §7 hard-part #3 double-buffering).  The
+        default is one flush per block: on relayed/tunneled devices each
+        extra dispatch costs a full round trip (measured ~0.25 s on axon),
+        dwarfing the overlap win; co-located deployments can lower it via
+        FABRIC_TPU_VALIDATE_CHUNK (read per validate call)."""
+        import os
+        return int(os.environ.get("FABRIC_TPU_VALIDATE_CHUNK",
+                                  "1000000000"))
+
     def _validate_inner(self, block: Block) -> ValidationResult:
         n = len(block.data)
         flags = TxFlags(n)
@@ -335,18 +349,37 @@ class TxValidator:
         seen_txids: Dict[str, int] = {}
         items: Dict[Tuple, VerifyItem] = {}
         works: List[_TxWork] = []
+        resolvers: List[Tuple[object, List[Tuple]]] = []
+        flushed = 0
+        chunk = self.overlap_chunk
+
+        def flush():
+            nonlocal flushed
+            keys = list(items.keys())
+            new = keys[flushed:]
+            if new:
+                resolvers.append(
+                    (self.provider.batch_verify_async(
+                        [items[k] for k in new]), new))
+                flushed = len(keys)
+
         for tx_num, env_bytes in enumerate(block.data):
             work = self._collect_tx(tx_num, env_bytes, flags, seen_txids,
                                     items, n_txs=n)
             if work is not None:
                 works.append(work)
+            if (tx_num + 1) % chunk == 0:
+                flush()
+        flush()
         collect_s = time.perf_counter() - t0
 
         t0 = time.perf_counter()
         keys = list(items.keys())
-        verdicts = (self.provider.batch_verify([items[k] for k in keys])
-                    if keys else np.zeros(0, dtype=bool))
-        verdict = {k: bool(v) for k, v in zip(keys, verdicts)}
+        verdict: Dict[Tuple, bool] = {}
+        for resolve, chunk_keys in resolvers:
+            out = resolve()
+            verdict.update(
+                (k, bool(v)) for k, v in zip(chunk_keys, out))
         dispatch_s = time.perf_counter() - t0
 
         t0 = time.perf_counter()

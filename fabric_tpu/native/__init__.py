@@ -1,0 +1,55 @@
+"""Native (C) components of the framework, built on demand.
+
+The reference leans on C-backed machinery for its hot paths (protobuf,
+LevelDB, cgo PKCS#11 — SURVEY.md §2.1); this package holds the
+TPU-native framework's equivalents.  Extensions are compiled lazily on
+first import with the system compiler and cached next to their sources;
+set FABRIC_TPU_NO_NATIVE=1 to force the pure-Python fallbacks.
+
+Current extensions:
+  _ftlv  — the canonical serde codec (fabric_tpu/utils/serde.py contract)
+"""
+
+from __future__ import annotations
+
+import importlib
+import logging
+import os
+import subprocess
+import sysconfig
+
+logger = logging.getLogger("fabric_tpu.native")
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def _build(name: str):
+    src = os.path.join(_DIR, f"{name[1:]}.c")
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    so = os.path.join(_DIR, name + suffix)
+    if (not os.path.exists(so)
+            or os.path.getmtime(so) < os.path.getmtime(src)):
+        cc = os.environ.get("CC", "cc")
+        inc = sysconfig.get_path("include")
+        tmp = so + f".tmp{os.getpid()}"
+        cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{inc}", src, "-o", tmp]
+        subprocess.run(cmd, check=True, capture_output=True)
+        os.replace(tmp, so)    # atomic: concurrent builders race benignly
+    return importlib.import_module(f"fabric_tpu.native.{name}")
+
+
+def load(name: str):
+    """Import a native extension, building it if needed.  Returns the
+    module or None (unavailable / disabled)."""
+    if os.environ.get("FABRIC_TPU_NO_NATIVE") == "1":
+        return None
+    try:
+        return importlib.import_module(f"fabric_tpu.native.{name}")
+    except ImportError:
+        pass
+    try:
+        return _build(name)
+    except Exception as exc:
+        logger.warning("native extension %s unavailable (%s); using "
+                       "pure-Python fallback", name, exc)
+        return None

@@ -1,0 +1,370 @@
+/* FTLV codec — C implementation of fabric_tpu.utils.serde.
+ *
+ * The framework's canonical TLV serialization (the slot the reference
+ * fills with C-backed protobuf, /root/reference/protoutil/) sits on the
+ * block-validation hot path: pass 1 of the validator decodes every
+ * envelope of every block (SURVEY.md §3.2), and profiling showed the
+ * pure-Python codec taking ~half of host-side collect time.  This
+ * extension implements the exact same wire format and error behavior;
+ * tests/test_serde.py runs differentially against the Python reference
+ * implementation.
+ *
+ * Format (see fabric_tpu/utils/serde.py):
+ *   'N' | 'T' | 'F'
+ *   'I' + 8B signed big-endian
+ *   'V' + u32 len + unsigned big-endian magnitude  (ints >= 2^63)
+ *   'B' + u32 len + raw bytes
+ *   'S' + u32 len + utf-8
+ *   'L' + u32 count + items
+ *   'D' + u32 count + (u32 keylen + key-utf8 + value), keys sorted
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <string.h>
+
+/* ------------------------------------------------------------------ */
+/* growable output buffer                                              */
+
+typedef struct {
+    char *data;
+    Py_ssize_t len;
+    Py_ssize_t cap;
+} buf_t;
+
+static int buf_init(buf_t *b) {
+    b->cap = 256;
+    b->len = 0;
+    b->data = PyMem_Malloc(b->cap);
+    return b->data ? 0 : -1;
+}
+
+static void buf_free(buf_t *b) {
+    PyMem_Free(b->data);
+}
+
+static int buf_reserve(buf_t *b, Py_ssize_t extra) {
+    if (b->len + extra <= b->cap) return 0;
+    Py_ssize_t cap = b->cap;
+    while (cap < b->len + extra) cap *= 2;
+    char *nd = PyMem_Realloc(b->data, cap);
+    if (!nd) { PyErr_NoMemory(); return -1; }
+    b->data = nd;
+    b->cap = cap;
+    return 0;
+}
+
+static int buf_put(buf_t *b, const void *src, Py_ssize_t n) {
+    if (buf_reserve(b, n) < 0) return -1;
+    memcpy(b->data + b->len, src, n);
+    b->len += n;
+    return 0;
+}
+
+static int buf_putc(buf_t *b, char c) {
+    return buf_put(b, &c, 1);
+}
+
+static int buf_put_u32(buf_t *b, uint32_t v) {
+    unsigned char tmp[4] = {
+        (unsigned char)(v >> 24), (unsigned char)(v >> 16),
+        (unsigned char)(v >> 8), (unsigned char)v };
+    return buf_put(b, tmp, 4);
+}
+
+/* every length/count field is u32 on the wire; larger values must error
+ * (the Python reference raises struct.error -> ValueError), never wrap */
+static int buf_put_len(buf_t *b, Py_ssize_t n) {
+    if (n < 0 || (uint64_t)n > 0xFFFFFFFFull) {
+        PyErr_SetString(PyExc_ValueError,
+                        "length does not fit a u32 field");
+        return -1;
+    }
+    return buf_put_u32(b, (uint32_t)n);
+}
+
+/* ------------------------------------------------------------------ */
+/* encode                                                              */
+
+static int enc(PyObject *v, buf_t *b);
+
+static int enc_int(PyObject *v, buf_t *b) {
+    int overflow = 0;
+    long long x = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (!overflow) {
+        if (x == -1 && PyErr_Occurred()) return -1;
+        unsigned char tmp[9];
+        tmp[0] = 'I';
+        unsigned long long ux = (unsigned long long)x;
+        for (int i = 0; i < 8; i++)
+            tmp[1 + i] = (unsigned char)(ux >> (8 * (7 - i)));
+        return buf_put(b, tmp, 9);
+    }
+    if (overflow < 0) {
+        PyErr_SetString(PyExc_ValueError, "big negative ints unsupported");
+        return -1;
+    }
+    /* big positive int: 'V' + u32 len + magnitude */
+    size_t nbits = _PyLong_NumBits(v);
+    if (nbits == (size_t)-1 && PyErr_Occurred()) return -1;
+    Py_ssize_t n = (Py_ssize_t)((nbits + 7) / 8);
+    if (buf_putc(b, 'V') < 0 || buf_put_len(b, n) < 0) return -1;
+    if (buf_reserve(b, n) < 0) return -1;
+    if (_PyLong_AsByteArray((PyLongObject *)v,
+                            (unsigned char *)b->data + b->len, n,
+                            /*little=*/0, /*signed=*/0
+#if PY_VERSION_HEX >= 0x030d0000
+                            , /*with_exceptions=*/1
+#endif
+                            ) < 0)
+        return -1;
+    b->len += n;
+    return 0;
+}
+
+static int enc_buffer(PyObject *v, buf_t *b) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(v, &view, PyBUF_CONTIG_RO) < 0) return -1;
+    int rc = -1;
+    if (buf_putc(b, 'B') == 0 && buf_put_len(b, view.len) == 0
+        && buf_put(b, view.buf, view.len) == 0)
+        rc = 0;
+    PyBuffer_Release(&view);
+    return rc;
+}
+
+static int enc_str(PyObject *v, buf_t *b) {
+    Py_ssize_t n;
+    const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+    if (!s) return -1;
+    if (buf_putc(b, 'S') < 0 || buf_put_len(b, n) < 0) return -1;
+    return buf_put(b, s, n);
+}
+
+static int enc_seq(PyObject *v, buf_t *b) {
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(v);
+    if (buf_putc(b, 'L') < 0 || buf_put_len(b, n) < 0) return -1;
+    PyObject **items = PySequence_Fast_ITEMS(v);
+    for (Py_ssize_t i = 0; i < n; i++)
+        if (enc(items[i], b) < 0) return -1;
+    return 0;
+}
+
+static int enc_dict(PyObject *v, buf_t *b) {
+    PyObject *keys = PyDict_Keys(v);
+    if (!keys) return -1;
+    if (PyList_Sort(keys) < 0) { Py_DECREF(keys); return -1; }
+    Py_ssize_t n = PyList_GET_SIZE(keys);
+    int rc = -1;
+    if (buf_putc(b, 'D') < 0 || buf_put_len(b, n) < 0)
+        goto done;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject *k = PyList_GET_ITEM(keys, i);
+        if (!PyUnicode_Check(k)) {
+            PyErr_SetString(PyExc_TypeError, "dict keys must be str");
+            goto done;
+        }
+        Py_ssize_t kn;
+        const char *ks = PyUnicode_AsUTF8AndSize(k, &kn);
+        if (!ks) goto done;
+        if (buf_put_len(b, kn) < 0 || buf_put(b, ks, kn) < 0)
+            goto done;
+        PyObject *val = PyDict_GetItemWithError(v, k);
+        if (!val) {
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_KeyError, "key vanished during encode");
+            goto done;
+        }
+        if (enc(val, b) < 0) goto done;
+    }
+    rc = 0;
+done:
+    Py_DECREF(keys);
+    return rc;
+}
+
+static int enc(PyObject *v, buf_t *b) {
+    if (Py_EnterRecursiveCall(" in ftlv encode")) return -1;
+    int rc = -1;
+    if (v == Py_None) {
+        rc = buf_putc(b, 'N');
+    } else if (v == Py_True) {
+        rc = buf_putc(b, 'T');
+    } else if (v == Py_False) {
+        rc = buf_putc(b, 'F');
+    } else if (PyLong_Check(v)) {
+        rc = enc_int(v, b);
+    } else if (PyBytes_Check(v) || PyByteArray_Check(v) || PyMemoryView_Check(v)) {
+        rc = enc_buffer(v, b);
+    } else if (PyUnicode_Check(v)) {
+        rc = enc_str(v, b);
+    } else if (PyList_Check(v) || PyTuple_Check(v)) {
+        rc = enc_seq(v, b);
+    } else if (PyDict_Check(v)) {
+        rc = enc_dict(v, b);
+    } else {
+        PyErr_Format(PyExc_TypeError, "unsupported type %R", Py_TYPE(v));
+    }
+    Py_LeaveRecursiveCall();
+    return rc;
+}
+
+static PyObject *py_encode(PyObject *self, PyObject *arg) {
+    buf_t b;
+    if (buf_init(&b) < 0) return PyErr_NoMemory();
+    if (enc(arg, &b) < 0) {
+        buf_free(&b);
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize(b.data, b.len);
+    buf_free(&b);
+    return out;
+}
+
+/* ------------------------------------------------------------------ */
+/* decode                                                              */
+
+typedef struct {
+    const unsigned char *p;
+    Py_ssize_t len;
+    Py_ssize_t off;
+} rd_t;
+
+static int rd_need(rd_t *r, Py_ssize_t n) {
+    if (r->off + n > r->len) {
+        PyErr_Format(PyExc_ValueError,
+                     "short buffer: need %zd bytes at %zd, have %zd",
+                     n, r->off, r->len - r->off);
+        return -1;
+    }
+    return 0;
+}
+
+static int rd_u32(rd_t *r, uint32_t *out) {
+    if (rd_need(r, 4) < 0) return -1;
+    const unsigned char *p = r->p + r->off;
+    *out = ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16)
+         | ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+    r->off += 4;
+    return 0;
+}
+
+static PyObject *dec(rd_t *r) {
+    if (rd_need(r, 1) < 0) return NULL;
+    unsigned char tag = r->p[r->off++];
+    PyObject *out = NULL;
+    if (Py_EnterRecursiveCall(" in ftlv decode")) return NULL;
+    switch (tag) {
+    case 'N': out = Py_None; Py_INCREF(out); break;
+    case 'T': out = Py_True; Py_INCREF(out); break;
+    case 'F': out = Py_False; Py_INCREF(out); break;
+    case 'I': {
+        if (rd_need(r, 8) < 0) break;
+        const unsigned char *p = r->p + r->off;
+        unsigned long long ux = 0;
+        for (int i = 0; i < 8; i++) ux = (ux << 8) | p[i];
+        r->off += 8;
+        out = PyLong_FromLongLong((long long)ux);
+        break;
+    }
+    case 'V': {
+        uint32_t n;
+        if (rd_u32(r, &n) < 0 || rd_need(r, n) < 0) break;
+        out = _PyLong_FromByteArray(r->p + r->off, n, /*little=*/0,
+                                    /*signed=*/0);
+        r->off += n;
+        break;
+    }
+    case 'B': {
+        uint32_t n;
+        if (rd_u32(r, &n) < 0 || rd_need(r, n) < 0) break;
+        out = PyBytes_FromStringAndSize((const char *)r->p + r->off, n);
+        r->off += n;
+        break;
+    }
+    case 'S': {
+        uint32_t n;
+        if (rd_u32(r, &n) < 0 || rd_need(r, n) < 0) break;
+        out = PyUnicode_DecodeUTF8((const char *)r->p + r->off, n, NULL);
+        r->off += n;
+        break;
+    }
+    case 'L': {
+        uint32_t n;
+        if (rd_u32(r, &n) < 0) break;
+        out = PyList_New(0);
+        if (!out) break;
+        for (uint32_t i = 0; i < n; i++) {
+            PyObject *item = dec(r);
+            if (!item || PyList_Append(out, item) < 0) {
+                Py_XDECREF(item);
+                Py_CLEAR(out);
+                break;
+            }
+            Py_DECREF(item);
+        }
+        break;
+    }
+    case 'D': {
+        uint32_t n;
+        if (rd_u32(r, &n) < 0) break;
+        out = PyDict_New();
+        if (!out) break;
+        for (uint32_t i = 0; i < n; i++) {
+            uint32_t kn;
+            if (rd_u32(r, &kn) < 0 || rd_need(r, kn) < 0) {
+                Py_CLEAR(out);
+                break;
+            }
+            PyObject *k = PyUnicode_DecodeUTF8(
+                (const char *)r->p + r->off, kn, NULL);
+            r->off += kn;
+            PyObject *v = k ? dec(r) : NULL;
+            if (!k || !v || PyDict_SetItem(out, k, v) < 0) {
+                Py_XDECREF(k);
+                Py_XDECREF(v);
+                Py_CLEAR(out);
+                break;
+            }
+            Py_DECREF(k);
+            Py_DECREF(v);
+        }
+        break;
+    }
+    default:
+        PyErr_Format(PyExc_ValueError, "bad tag %c at %zd",
+                     tag, r->off - 1);
+    }
+    Py_LeaveRecursiveCall();
+    return out;
+}
+
+static PyObject *py_decode(PyObject *self, PyObject *arg) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_CONTIG_RO) < 0) return NULL;
+    rd_t r = { (const unsigned char *)view.buf, view.len, 0 };
+    PyObject *out = dec(&r);
+    if (out && r.off != r.len) {
+        Py_DECREF(out);
+        out = NULL;
+        PyErr_SetString(PyExc_ValueError, "trailing bytes");
+    }
+    PyBuffer_Release(&view);
+    return out;
+}
+
+/* ------------------------------------------------------------------ */
+
+static PyMethodDef methods[] = {
+    {"encode", py_encode, METH_O, "FTLV-encode a value to bytes."},
+    {"decode", py_decode, METH_O, "Decode FTLV bytes to a value."},
+    {NULL, NULL, 0, NULL}
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_ftlv", "C FTLV codec", -1, methods,
+};
+
+PyMODINIT_FUNC PyInit__ftlv(void) {
+    return PyModule_Create(&moduledef);
+}

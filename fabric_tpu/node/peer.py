@@ -1,0 +1,450 @@
+"""Runnable peer node: endorser + deliver client + validator/committer.
+
+The reference's peer binary (the larger of its two server processes:
+/root/reference/cmd/peer/main.go:29, internal/peer/node/start.go:110-860,
+channel wiring core/peer/peer.go:207) composed for this framework: a JSON
+node config + MSP material on disk produce ONE process that
+
+  - serves the Endorser (`endorse`), qscc/cscc (`qscc.*`, `cscc.*`),
+    discovery (`discovery.endorsers`), and the private-data pull/push
+    plane (`privdata.fetch` / `privdata.push`) over the authenticated RPC
+    plane (fabric_tpu/comm),
+  - runs the deliver client against the orderer cluster with failover
+    (internal/pkg/peer/blocksprovider semantics: seek from height, batch-
+    verify orderer signatures, commit in order),
+  - validates + commits through the verify-then-gate TxValidator and the
+    privdata Coordinator (missing collections recorded and reconciled on
+    a timer, gossip/privdata/reconcile.go),
+  - exposes the ops plane (/healthz /metrics /logspec).
+
+Run:  python -m fabric_tpu.node.peer <node.json>
+Provision a dev network: fabric_tpu.node.provision.provision_network().
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import sys
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.chaincode import (
+    ChaincodeDefinition,
+    ChaincodeRegistry,
+    LifecyclePolicyProvider,
+    SimulationError,
+)
+from fabric_tpu.chaincode.runtime import FuncContract
+from fabric_tpu.comm.rpc import RpcServer, connect
+from fabric_tpu.committer import Committer, TxValidator
+from fabric_tpu.committer.sbe import statedb_lookup
+from fabric_tpu.config import Bundle, BundleSource, ChannelConfig
+from fabric_tpu.endorser import Endorser
+from fabric_tpu.endorser.proposal import SignedProposal
+from fabric_tpu.ledger import KVLedger, LedgerConfig
+from fabric_tpu.node.orderer import load_signing_identity
+from fabric_tpu.orderer import block_signature_items
+from fabric_tpu.policy import SignedData, parse_policy
+from fabric_tpu.privdata import (
+    CollectionConfig,
+    CollectionRegistry,
+    Coordinator,
+    PvtDataStore,
+    TransientStore,
+)
+from fabric_tpu.protocol.types import Block
+from fabric_tpu.scc.cscc import Cscc
+from fabric_tpu.scc.discovery import DiscoveryService
+from fabric_tpu.scc.qscc import Qscc
+
+logger = logging.getLogger("fabric_tpu.node.peer")
+
+
+# -- built-in dev contracts (in-process dev mode; external chaincode is the
+#    production path, fabric_tpu/chaincode/extcc.py) -------------------------
+
+def _asset_contract():
+    def create(stub, key, value):
+        if stub.get_state(key.decode()) is not None:
+            raise SimulationError("asset exists")
+        stub.put_state(key.decode(), value)
+        return b"created"
+
+    def transfer(stub, key, owner):
+        v = stub.get_state(key.decode())
+        if v is None:
+            raise SimulationError("no such asset")
+        stub.put_state(key.decode(), owner)
+        return b"transferred"
+
+    def put_private(stub, collection, key, value):
+        stub.put_state(key.decode() + ".marker", b"1")
+        stub.put_private_data(collection.decode(), key.decode(), value)
+        return b"ok"
+
+    return FuncContract(create=create, transfer=transfer,
+                        put_private=put_private)
+
+
+DEV_CONTRACTS = {"asset_demo": _asset_contract}
+
+
+class RemoteDeliver:
+    """Deliver-handler facade over the orderer cluster's RPC deliver
+    stream, with per-call failover across orderer endpoints."""
+
+    def __init__(self, orderers: List[Tuple[str, int]], signer, msps):
+        self.orderers = list(orderers)
+        self.signer = signer
+        self.msps = msps
+        self._rr = 0
+
+    def deliver(self, channel_id, seek, signed=None, timeout_s: int = 10):
+        last = None
+        payload = b"seek:%s" % channel_id.encode()
+        sd = {"data": payload, "identity": self.signer.serialize(),
+              "signature": self.signer.sign(payload)}
+        for k in range(len(self.orderers)):
+            addr = self.orderers[(self._rr + k) % len(self.orderers)]
+            try:
+                conn = connect(tuple(addr), self.signer, self.msps,
+                               timeout=3.0)
+                try:
+                    for item in conn.call_stream("deliver", {
+                            "channel": channel_id, "start": seek.start,
+                            "stop": seek.stop, "behavior": seek.behavior,
+                            "timeout_s": int(timeout_s),
+                            "signed_data": sd}):
+                        yield Block.deserialize(item["block"])
+                    self._rr = (self._rr + k) % len(self.orderers)
+                    return
+                finally:
+                    conn.close()
+            except Exception as exc:
+                last = exc
+        if last is not None:
+            raise last
+
+
+class PeerNode:
+    """One peer process (library form; `main` wraps it)."""
+
+    def __init__(self, cfg: dict, data_dir: str):
+        self.cfg = cfg
+        self.channel_id = cfg.get("channel_id", "ch")
+        self.provider = init_factories(
+            FactoryOpts(default=cfg.get("bccsp", "SW")))
+        self.signer = load_signing_identity(
+            cfg["mspid"], cfg["cert_pem"].encode(), cfg["key_pem"].encode())
+        self.mspid = cfg["mspid"]
+
+        channel_cfg = ChannelConfig.deserialize(
+            bytes.fromhex(cfg["channel_config_hex"]))
+        self.bundle_source = BundleSource(Bundle(channel_cfg))
+        self.msps = self.bundle_source.current().msps
+
+        self.ledger = KVLedger(self.channel_id,
+                               LedgerConfig(root=f"{data_dir}/ledger"))
+
+        # chaincode runtime (dev mode: in-process contracts; external
+        # chaincode processes are handled by chaincode/extcc.py)
+        self.cc_registry = ChaincodeRegistry()
+        self.policies = LifecyclePolicyProvider(self.ledger.statedb)
+        self._cc_policies: Dict[str, object] = {}
+        for cc in cfg.get("chaincodes", []):
+            contract = self._make_contract(cc)
+            self.cc_registry.install(
+                ChaincodeDefinition(cc["name"], cc.get("version", "1.0")),
+                contract)
+            if cc.get("policy"):
+                pol = parse_policy(cc["policy"])
+                self.policies.set_policy(cc["name"], pol)
+                self._cc_policies[cc["name"]] = pol
+
+        self.validator = TxValidator(
+            self.channel_id, None, self.provider, self.policies,
+            bundle_source=self.bundle_source,
+            sbe_lookup=statedb_lookup(self.ledger.statedb))
+        self.committer = Committer(self.ledger, self.validator,
+                                   bundle_source=self.bundle_source,
+                                   provider=self.provider)
+
+        # private data plane
+        self.collections = CollectionRegistry()
+        for col in cfg.get("collections", []):
+            self.collections.define(col["ns"], CollectionConfig(
+                col["name"], member_orgs=tuple(col["members"]),
+                block_to_live=int(col.get("btl", 0))))
+        self.transient = TransientStore()
+        self.pvt_store = PvtDataStore()
+        self.coordinator = Coordinator(
+            self.committer, self.collections, self.transient,
+            self.pvt_store, mspid=self.mspid,
+            fetch=self._privdata_fetch_remote)
+
+        self.endorser = Endorser(
+            self.channel_id, self.ledger.statedb, self.cc_registry,
+            self.msps, self.provider, self.signer,
+            transient_store=self.transient, pvt_store=self.pvt_store,
+            distribute=self._privdata_distribute,
+            ledger_height=lambda: self.ledger.height)
+
+        # system chaincodes + discovery
+        self.qscc = Qscc(self.channel_id, self.ledger.blockstore)
+        self.cscc = Cscc()
+        self.cscc.register(self.channel_id, self)
+        self.peers = [tuple(p) for p in cfg.get("peers", [])]
+        self.peer_orgs = {tuple(p[:2]): p[2] if len(p) > 2 else None
+                          for p in cfg.get("peers", [])}
+        self.discovery = DiscoveryService(
+            membership=self._membership,
+            policy_for=self.policies.policy_for)
+
+        self.orderers = [tuple(o) for o in cfg.get("orderers", [])]
+        self.deliver_client = RemoteDeliver(self.orderers, self.signer,
+                                            self.msps)
+
+        # RPC surface
+        self.rpc = RpcServer(cfg.get("host", "127.0.0.1"), int(cfg["port"]),
+                             self.signer, self.msps)
+        self.rpc.serve("endorse", self._rpc_endorse)
+        self.rpc.serve("status", self._rpc_status)
+        self.rpc.serve("qscc.chain_info", self._rpc_chain_info)
+        self.rpc.serve("qscc.block_by_number", self._rpc_block_by_number)
+        self.rpc.serve("qscc.tx_by_id", self._rpc_tx_by_id)
+        self.rpc.serve("cscc.channels", lambda b, p:
+                       {"channels": self.cscc.get_channels()})
+        self.rpc.serve("discovery.endorsers", self._rpc_discovery)
+        self.rpc.serve("privdata.fetch", self._rpc_privdata_fetch)
+        self.rpc.serve_cast("privdata.push", self._rpc_privdata_push)
+
+        self.ops = None
+        if cfg.get("ops_port") is not None:
+            from fabric_tpu.ops_plane import OperationsServer
+            self.ops = OperationsServer(cfg.get("host", "127.0.0.1"),
+                                        int(cfg["ops_port"]))
+            self.ops.register_checker(
+                "deliver", lambda: self._deliver_healthy)
+
+        self._stop = threading.Event()
+        self._deliver_healthy = True
+        self._deliver_thread = threading.Thread(target=self._deliver_loop,
+                                                daemon=True)
+
+    # -- wiring helpers ------------------------------------------------------
+
+    def _make_contract(self, cc_cfg: dict):
+        kind = cc_cfg.get("contract", "asset_demo")
+        if kind in DEV_CONTRACTS:
+            return DEV_CONTRACTS[kind]()
+        if kind.startswith("extern:"):
+            from fabric_tpu.chaincode.extcc import ExternalContract
+            return ExternalContract(cc_cfg["name"], kind[len("extern:"):])
+        raise ValueError(f"unknown contract {kind!r}")
+
+    def _membership(self):
+        """discovery membership: this peer + its configured neighbors
+        (live gossip membership in the reference)."""
+        me = f"{self.cfg.get('host', '127.0.0.1')}:{self.cfg['port']}"
+        out = [{"id": me, "mspid": self.mspid, "roles": ["peer"]}]
+        for p in self.cfg.get("peers", []):
+            if len(p) > 2:
+                out.append({"id": f"{p[0]}:{p[1]}", "mspid": p[2],
+                            "roles": ["peer"]})
+        return out
+
+    # -- rpc handlers --------------------------------------------------------
+
+    def _rpc_endorse(self, body: dict, peer_identity) -> dict:
+        sp = SignedProposal(body["proposal"], body["signature"])
+        resp = self.endorser.process_proposal(sp)
+        out = {"status": resp.status, "message": resp.message,
+               "payload": resp.payload}
+        if resp.endorsement is not None:
+            out["endorser"] = resp.endorsement.endorser
+            out["endorsement_sig"] = resp.endorsement.signature
+        return out
+
+    def _rpc_status(self, body: dict, peer_identity) -> dict:
+        return {"mspid": self.mspid, "channel": self.channel_id,
+                "height": self.ledger.height,
+                "commit_hash": (self.ledger.commit_hash or b"").hex()}
+
+    def _rpc_chain_info(self, body: dict, peer_identity) -> dict:
+        return self.qscc.get_chain_info()
+
+    def _rpc_block_by_number(self, body: dict, peer_identity) -> dict:
+        blk = self.qscc.get_block_by_number(int(body["number"]))
+        return {"block": blk.serialize()}
+
+    def _rpc_tx_by_id(self, body: dict, peer_identity) -> dict:
+        env = self.qscc.get_transaction_by_id(body["txid"])
+        return {"envelope": env.serialize()}
+
+    def _rpc_discovery(self, body: dict, peer_identity) -> dict:
+        out = self.discovery.endorsers(body["namespace"])
+        out["layouts"] = [l.as_dict() for l in out["layouts"]]
+        return out
+
+    def _rpc_privdata_fetch(self, body: dict, peer_identity) -> dict:
+        """Collection pull: ONLY collection-member orgs may read cleartext
+        (gossip/privdata/pvtdataprovider.go membership check)."""
+        ns, coll = body["namespace"], body["collection"]
+        cfg = self.collections.get(ns, coll)
+        if cfg is None or not cfg.is_member(
+                getattr(peer_identity, "mspid", None)):
+            return {"found": False, "denied": True}
+        data = self.pvt_store.get_tx_set(ns, coll, body["txid"])
+        if data is None:
+            # also try the transient store (pre-commit staging)
+            for sets in self.transient.get(body["txid"]):
+                if (ns, coll) in sets:
+                    data = sets[(ns, coll)]
+                    break
+        if data is None:
+            return {"found": False}
+        return {"found": True,
+                "keys": list(data.keys()),
+                "values": [v if v is not None else b"" for v in
+                           data.values()],
+                "deleted": [v is None for v in data.values()]}
+
+    def _rpc_privdata_push(self, body: dict, peer_identity) -> None:
+        """Endorsement-time distribution: a member peer pushes cleartext
+        into our transient store (gossip/privdata/distributor.go)."""
+        sets = {}
+        for rec in body["sets"]:
+            ns, coll = rec["namespace"], rec["collection"]
+            cfg = self.collections.get(ns, coll)
+            if cfg is None or not cfg.is_member(self.mspid):
+                continue      # we are not a member: refuse cleartext
+            sets[(ns, coll)] = {k: (None if d else v) for k, v, d in
+                                zip(rec["keys"], rec["values"],
+                                    rec["deleted"])}
+        if sets:
+            self.transient.persist(body["txid"], int(body["height"]), sets)
+
+    # -- privdata client side ------------------------------------------------
+
+    def _privdata_distribute(self, txid: str, pvt_sets: dict) -> None:
+        """Push endorsement-time cleartext to collection member peers."""
+        recs = []
+        for (ns, coll), kv in pvt_sets.items():
+            recs.append({"namespace": ns, "collection": coll,
+                         "keys": list(kv.keys()),
+                         "values": [v if v is not None else b""
+                                    for v in kv.values()],
+                         "deleted": [v is None for v in kv.values()]})
+        if not recs:
+            return
+        body = {"txid": txid, "height": self.ledger.height, "sets": recs}
+        for addr in self.peers:
+            try:
+                conn = connect(tuple(addr[:2]), self.signer, self.msps,
+                               timeout=2.0)
+                try:
+                    conn.cast("privdata.push", body)
+                finally:
+                    conn.close()
+            except Exception:
+                logger.debug("privdata push to %s failed", addr,
+                             exc_info=True)
+
+    def _privdata_fetch_remote(self, txid: str, ns: str,
+                               coll: str) -> Optional[dict]:
+        """Reconciliation pull from member peers (reconcile.go)."""
+        for addr in self.peers:
+            try:
+                conn = connect(tuple(addr[:2]), self.signer, self.msps,
+                               timeout=2.0)
+                try:
+                    out = conn.call("privdata.fetch", {
+                        "txid": txid, "namespace": ns, "collection": coll},
+                        timeout=5.0)
+                finally:
+                    conn.close()
+            except Exception:
+                continue
+            if out.get("found"):
+                return {k: (None if d else v) for k, v, d in
+                        zip(out["keys"], out["values"], out["deleted"])}
+        return None
+
+    # -- deliver / commit loop ----------------------------------------------
+
+    def _deliver_loop(self) -> None:
+        from fabric_tpu.orderer.deliver import SeekInfo
+        backoff = 0.2
+        reconcile_at = time.monotonic() + 5.0
+        while not self._stop.is_set():
+            height = self.ledger.height
+            try:
+                got = 0
+                for block in self.deliver_client.deliver(
+                        self.channel_id,
+                        SeekInfo(start=height, stop=height + 31,
+                                 behavior="block_until_ready"),
+                        timeout_s=5):
+                    items = block_signature_items(block, self.msps)
+                    if not items or not bool(
+                            self.provider.batch_verify(items).all()):
+                        logger.warning("block %d failed orderer-signature "
+                                       "verification; dropping window",
+                                       block.header.number)
+                        break
+                    self.coordinator.store_block(block)
+                    got += 1
+                self._deliver_healthy = True
+                backoff = 0.2
+                if not got:
+                    time.sleep(0.1)
+            except Exception:
+                self._deliver_healthy = False
+                logger.debug("deliver pull failed; retrying", exc_info=True)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 3.0)
+            if time.monotonic() >= reconcile_at:
+                try:
+                    n = self.coordinator.reconcile()
+                    if n:
+                        logger.info("reconciled %d private collections", n)
+                except Exception:
+                    logger.exception("privdata reconcile failed")
+                reconcile_at = time.monotonic() + 5.0
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "PeerNode":
+        self.rpc.start()
+        if self.ops is not None:
+            self.ops.start()
+        self._deliver_thread.start()
+        logger.info("peer %s serving on %s", self.mspid, self.rpc.addr)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.rpc.stop()
+        if self.ops is not None:
+            self.ops.stop()
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: python -m fabric_tpu.node.peer <node.json>",
+              file=sys.stderr)
+        return 2
+    logging.basicConfig(level=logging.INFO)
+    with open(argv[0]) as f:
+        cfg = json.load(f)
+    PeerNode(cfg, data_dir=cfg["data_dir"]).start()
+    threading.Event().wait()   # serve until killed
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,3 +108,138 @@ def provision_orderers(base_dir: str, n: int, channel_id: str = "ch",
             "channel_id": channel_id,
         }, f)
     return paths
+
+
+def _free_ports(n: int) -> List[int]:
+    import socket
+    ports, socks = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        ports.append(s.getsockname()[1])
+        socks.append(s)
+    for s in socks:
+        s.close()
+    return ports
+
+
+def provision_network(base_dir: str, n_orderers: int = 3,
+                      peer_orgs: List[str] = ("Org1", "Org2"),
+                      peers_per_org: int = 1,
+                      channel_id: str = "ch",
+                      chaincodes: List[dict] = None,
+                      collections: List[dict] = None,
+                      batch: BatchConfig = None) -> dict:
+    """Full dev network: orderer cluster + peer-org peers on one channel.
+
+    The nwo-style harness (reference: integration/nwo/network.go:173) —
+    generates all crypto material and one JSON config per process.
+    Returns {"orderers": [cfg paths], "peers": [cfg paths],
+             "clients": {org: client cfg path}}.
+    """
+    from fabric_tpu.orderer.cluster import cert_fingerprint
+
+    ord_org = DevOrg("OrdererOrg")
+    p_orgs = {name: DevOrg(name) for name in peer_orgs}
+    all_orgs = {"OrdererOrg": ord_org, **p_orgs}
+
+    n_peers = len(p_orgs) * peers_per_org
+    ports = _free_ports(n_orderers + n_peers)
+    ord_ports, peer_ports = ports[:n_orderers], ports[n_orderers:]
+
+    org_cfgs = []
+    for name, org in all_orgs.items():
+        mc = org.msp_config()
+        org_cfgs.append(OrgConfig(mspid=name,
+                                  root_certs=tuple(mc.root_certs_pem),
+                                  admins=tuple(mc.admin_certs_pem)))
+    cfg = ChannelConfig(
+        channel_id=channel_id,
+        sequence=0,
+        orgs=tuple(org_cfgs),
+        policies=default_policies(list(all_orgs)),
+        batch=batch or BatchConfig(max_message_count=8, timeout_s=0.2),
+        consenters=tuple(range(1, n_orderers + 1)),
+    )
+    cfg_hex = cfg.serialize().hex()
+
+    chaincodes = chaincodes or [
+        {"name": "assets", "version": "1.0", "contract": "asset_demo",
+         "policy": "AND(%s)" % ", ".join(
+             f"'{o}.member'" for o in peer_orgs)}]
+    collections = collections or []
+
+    # orderers
+    creds = [ord_org.issuer.issue(f"orderer{i + 1}@OrdererOrg")
+             for i in range(n_orderers)]
+    cluster = [{"raft_id": i + 1, "host": "127.0.0.1", "port": ord_ports[i],
+                "mspid": "OrdererOrg",
+                "cert_fp": cert_fingerprint(creds[i][0])}
+               for i in range(n_orderers)]
+    orderer_paths = []
+    for i in range(n_orderers):
+        node_dir = os.path.join(base_dir, f"orderer{i + 1}")
+        os.makedirs(node_dir, exist_ok=True)
+        cert, key = creds[i]
+        path = os.path.join(base_dir, f"orderer{i + 1}.json")
+        with open(path, "w") as f:
+            json.dump({
+                "mspid": "OrdererOrg", "raft_id": i + 1,
+                "host": "127.0.0.1", "port": ord_ports[i],
+                "cert_pem": _cert_pem(cert).decode(),
+                "key_pem": _key_pem(key).decode(),
+                "channel_config_hex": cfg_hex,
+                "cluster": cluster, "data_dir": node_dir,
+            }, f)
+        orderer_paths.append(path)
+
+    # peers: each knows every OTHER peer's endpoint + org (privdata push,
+    # discovery membership)
+    peer_list = []
+    idx = 0
+    for org_name in peer_orgs:
+        for j in range(peers_per_org):
+            peer_list.append((org_name, j, peer_ports[idx]))
+            idx += 1
+    peer_paths = []
+    for org_name, j, port in peer_list:
+        org = p_orgs[org_name]
+        node_dir = os.path.join(base_dir, f"peer{org_name}_{j}")
+        os.makedirs(node_dir, exist_ok=True)
+        cert, key = org.issuer.issue(f"peer{j}@{org_name}")
+        others = [["127.0.0.1", p, o] for (o, k, p) in peer_list
+                  if (o, k) != (org_name, j)]
+        path = os.path.join(base_dir, f"peer{org_name}_{j}.json")
+        with open(path, "w") as f:
+            json.dump({
+                "mspid": org_name, "channel_id": channel_id,
+                "host": "127.0.0.1", "port": port,
+                "cert_pem": _cert_pem(cert).decode(),
+                "key_pem": _key_pem(key).decode(),
+                "channel_config_hex": cfg_hex,
+                "orderers": [["127.0.0.1", p] for p in ord_ports],
+                "peers": others,
+                "chaincodes": chaincodes,
+                "collections": collections,
+                "data_dir": node_dir,
+            }, f)
+        peer_paths.append(path)
+
+    # per-org clients
+    clients = {}
+    for org_name, org in p_orgs.items():
+        ccert, ckey = org.issuer.issue(f"client@{org_name}")
+        path = os.path.join(base_dir, f"client_{org_name}.json")
+        with open(path, "w") as f:
+            json.dump({
+                "mspid": org_name,
+                "cert_pem": _cert_pem(ccert).decode(),
+                "key_pem": _key_pem(ckey).decode(),
+                "channel_config_hex": cfg_hex,
+                "channel_id": channel_id,
+                "orderers": [["127.0.0.1", p] for p in ord_ports],
+                "peers": [["127.0.0.1", p, o] for (o, k, p) in peer_list],
+            }, f)
+        clients[org_name] = path
+    return {"orderers": orderer_paths, "peers": peer_paths,
+            "clients": clients}

@@ -56,6 +56,7 @@ HALF_N = (N - 1) // 2
 
 COMB_W = 6
 COMB_WINDOWS = 43            # 43*6 = 258 >= 256
+COMB_ENTRIES = 1 << COMB_W
 LADDER_W = 4
 LADDER_WINDOWS = 64          # u2 < n < 2^256
 
@@ -101,52 +102,56 @@ _COMB_CACHE = {}
 
 
 def comb_table_f32() -> np.ndarray:
-    """(COMB_WINDOWS * 64, 2 * L) f32: rows of Montgomery-form affine limbs
-    [x limbs || y limbs] for k * 2^(6j) * G; row j*64+k.  k=0 rows are zero
-    (patched at lookup time via the digit==0 select).
+    """(COMB_WINDOWS * COMB_ENTRIES, 2 * L) f32: rows of Montgomery-form
+    affine limbs [x limbs || y limbs] for k * 2^(COMB_W*j) * G; row
+    j*COMB_ENTRIES+k.  k=0 rows are zero (patched at lookup time via the
+    digit==0 select).
 
     Exactness: limbs < 2^12 are exactly representable in f32, and a one-hot
     matmul sums exactly one row — no rounding anywhere.
     """
     if "t" in _COMB_CACHE:
         return _COMB_CACHE["t"]
-    rows = np.zeros((COMB_WINDOWS * 64, 2 * L), dtype=np.float32)
-    base = (GX, GY)
-    for j in range(COMB_WINDOWS):
-        pt = None
-        for k in range(64):
-            if k > 0:
-                pt = _aff_add(pt, base)
-                xm = bn.int_to_limbs(pt[0] * fp.R % P)
-                ym = bn.int_to_limbs(pt[1] * fp.R % P)
-                rows[j * 64 + k, :L] = xm
-                rows[j * 64 + k, L:] = ym
-        # base <- 2^6 * base
-        for _ in range(COMB_W):
-            base = _aff_add(base, base)
-    _COMB_CACHE["t"] = rows
-    return rows
+    from . import p256_tables
+    _COMB_CACHE["t"] = p256_tables.comb_table_for_point(GX, GY)
+    return _COMB_CACHE["t"]
 
 
 # ---------------------------------------------------------------------------
-# Jacobian point ops (flat field, explicit infinity flags)
+# Jacobian point ops (lazy-reduction flat field, explicit infinity flags)
 # ---------------------------------------------------------------------------
-# A point is (X, Y, Z, inf) with inf a (B,) bool; X,Y,Z relaxed Montgomery.
+# A point is (X, Y, Z, inf) with inf a (B,) int32 flag; X,Y,Z Montgomery-
+# form LAZILY-REDUCED limbs with the static per-coordinate invariant
+#
+#     value(X) < 11p,  value(Y) < 4p,  value(Z) < 6p
+#
+# maintained by every op below with ZERO conditional subtractions (the
+# round-2 formulas paid one ~70-op Kogge-Stone cond-sub per mod_add /
+# mod_sub / mul_small — about half the cost of a dbl again on top of its
+# muls).  Safety rests on two CIOS facts (flatfield mul): operands may
+# carry values up to ~16p, and a product a*b <= 256*p^2 emerges < 2p
+# (out < p + ab/R with p < R/256).  Each op's bound is derived in a
+# trailing comment: "# <k.kp" means value < k.k * p at that point.
 
 def dbl(Pt):
-    """dbl-2001-b for a = -3; complete for Y=0 (gives Z3=0 -> flagged inf
-    by the is_zero in add patches never needed: doubling a 2-torsion point
-    can't arise on P-256 (odd order), but Z3=0 output is still safe."""
+    """Jacobian doubling (dbl-2001-b shape, a = -3), lazy reduction.
+    Input invariant (11p, 4p, 6p) -> output (10.2p, 3.4p, 4.5p).  8 muls,
+    no cond-subs.  Doubling a 2-torsion point can't arise on P-256 (odd
+    order); a Z3=0 output would still be safe downstream."""
     X, Y, Z, inf = Pt
-    delta = fp.sqr(Z)
-    gamma = fp.sqr(Y)
-    beta = fp.mul(X, gamma)
-    alpha = fp.mul_small(fp.mul(fp.mod_sub(X, delta), fp.mod_add(X, delta)), 3)
-    beta8 = fp.mul_small(beta, 8)
-    X3 = fp.mod_sub(fp.sqr(alpha), beta8)
-    Z3 = fp.mod_sub(fp.sqr(fp.mod_add(Y, Z)), fp.mod_add(gamma, delta))
-    Y3 = fp.mod_sub(fp.mul(alpha, fp.mod_sub(fp.mul_small(beta, 4), X3)),
-                    fp.mul_small(fp.sqr(gamma), 8))
+    delta = fp.sqr(Z)                    # 36p^2   -> <1.15p
+    gamma = fp.sqr(Y)                    # 16p^2   -> <1.07p
+    beta = fp.mul(X, gamma)              # 11.8p^2 -> <1.05p
+    t1 = fp.subl(X, delta, 2)            # <13p
+    t2 = fp.addl(X, delta)               # <12.2p
+    alpha = fp.smalll(fp.mul(t1, t2), 3)  # 159p^2 -> <1.63p; x3 -> <4.9p
+    X3 = fp.subl(fp.sqr(alpha), fp.smalll(beta, 8), 9)   # <1.1p + 9p = 10.1p
+    w = fp.subl(fp.smalll(beta, 4), X3, 11)              # <15.2p
+    # 8*gamma^2 as a MUL output (not a post-scale) keeps Y3's bound small
+    m3 = fp.mul(gamma, fp.smalll(gamma, 8))              # 9.2p^2 -> <1.04p
+    Y3 = fp.subl(fp.mul(alpha, w), m3, 2)                # <1.3p + 2p = 3.3p
+    s = fp.sqr(fp.addl(Y, Z))                            # 100p^2 -> <1.4p
+    Z3 = fp.subl(s, fp.addl(gamma, delta), 3)            # <4.4p
     return X3, Y3, Z3, inf
 
 
@@ -154,28 +159,31 @@ def add_nodbl(Pt, Qt):
     """Complete-except-doubling Jacobian add (see module docstring for the
     reachability argument).  Patches: P inf, Q inf, P == -Q -> infinity.
     P == Q would produce Z3 = 0 (treated as infinity downstream) — only
-    possible for inputs outside the guaranteed domain (garbage Q, gated)."""
+    possible for inputs outside the guaranteed domain (garbage Q, gated).
+    Lazy bounds: inputs (11p, 4p, 6p) -> outputs (5.1p, 3.1p, 1.1p)."""
     X1, Y1, Z1, inf1 = Pt
     X2, Y2, Z2, inf2 = Qt
-    z1z1 = fp.sqr(Z1)
-    z2z2 = fp.sqr(Z2)
-    u1 = fp.mul(X1, z2z2)
-    u2 = fp.mul(X2, z1z1)
-    s1 = fp.mul(Y1, fp.mul(Z2, z2z2))
-    s2 = fp.mul(Y2, fp.mul(Z1, z1z1))
-    h = fp.mod_sub(u2, u1)
-    r = fp.mod_sub(s2, s1)
-    h2 = fp.sqr(h)
-    h3 = fp.mul(h, h2)
-    u1h2 = fp.mul(u1, h2)
-    X3 = fp.mod_sub(fp.mod_sub(fp.sqr(r), h3), fp.mul_small(u1h2, 2))
-    Y3 = fp.mod_sub(fp.mul(r, fp.mod_sub(u1h2, X3)), fp.mul(s1, h3))
-    Z3 = fp.mul(fp.mul(Z1, Z2), h)
+    z1z1 = fp.sqr(Z1)                    # <1.15p
+    z2z2 = fp.sqr(Z2)                    # <1.15p
+    u1 = fp.mul(X1, z2z2)                # 12.7p^2 -> <1.05p
+    u2 = fp.mul(X2, z1z1)                # <1.05p
+    s1 = fp.mul(Y1, fp.mul(Z2, z2z2))    # 6.9p^2 -> <1.03p; then <1.02p
+    s2 = fp.mul(Y2, fp.mul(Z1, z1z1))    # <1.02p
+    h = fp.subl(u2, u1, 2)               # <3.05p
+    r = fp.subl(s2, s1, 2)               # <3.04p
+    h2 = fp.sqr(h)                       # 9.3p^2 -> <1.04p
+    h3 = fp.mul(h, h2)                   # <1.02p
+    u1h2 = fp.mul(u1, h2)                # <1.01p
+    X3 = fp.subl(fp.sqr(r),
+                 fp.addl(h3, fp.smalll(u1h2, 2)), 4)     # <1.04p + 4p = 5.04p
+    w = fp.subl(u1h2, X3, 6)                             # <7.05p
+    Y3 = fp.subl(fp.mul(r, w), fp.mul(s1, h3), 2)        # 21.4p^2 -> <3.1p
+    Z3 = fp.mul(fp.mul(Z1, Z2), h)       # 36p^2 -> <1.15p; 3.5p^2 -> <1.02p
 
     # h == 0 means P == -Q (cancel) for in-domain inputs; P == Q is
     # unreachable (module docstring) and maps to infinity too, which is
     # wrong only for garbage Q already gated by the on-curve bit.
-    h_zero = fp.is_zero(h)
+    h_zero = fp.is_zero_k(h, 4)
     i1b, i2b = inf1 != 0, inf2 != 0
     cancel = h_zero & ~i1b & ~i2b
     inf3 = (cancel | (i1b & i2b)).astype(jnp.int32)
@@ -187,7 +195,9 @@ def add_nodbl(Pt, Qt):
 
 
 def add_complete(Pt, Qt):
-    """Fully complete add: also handles P == Q via an embedded doubling."""
+    """Fully complete add: also handles P == Q via an embedded doubling.
+    Same lazy bounds as add_nodbl; output X bound is max(5.1p, dbl's
+    10.2p, the 11p inputs) = 11p."""
     X1, Y1, Z1, inf1 = Pt
     X2, Y2, Z2, inf2 = Qt
     z1z1 = fp.sqr(Z1)
@@ -196,17 +206,19 @@ def add_complete(Pt, Qt):
     u2 = fp.mul(X2, z1z1)
     s1 = fp.mul(Y1, fp.mul(Z2, z2z2))
     s2 = fp.mul(Y2, fp.mul(Z1, z1z1))
-    h = fp.mod_sub(u2, u1)
-    r = fp.mod_sub(s2, s1)
+    h = fp.subl(u2, u1, 2)               # <3.05p
+    r = fp.subl(s2, s1, 2)               # <3.04p
     h2 = fp.sqr(h)
     h3 = fp.mul(h, h2)
     u1h2 = fp.mul(u1, h2)
-    X3 = fp.mod_sub(fp.mod_sub(fp.sqr(r), h3), fp.mul_small(u1h2, 2))
-    Y3 = fp.mod_sub(fp.mul(r, fp.mod_sub(u1h2, X3)), fp.mul(s1, h3))
+    X3 = fp.subl(fp.sqr(r),
+                 fp.addl(h3, fp.smalll(u1h2, 2)), 4)
+    w = fp.subl(u1h2, X3, 6)
+    Y3 = fp.subl(fp.mul(r, w), fp.mul(s1, h3), 2)
     Z3 = fp.mul(fp.mul(Z1, Z2), h)
 
-    h_zero = fp.is_zero(h)
-    r_zero = fp.is_zero(r)
+    h_zero = fp.is_zero_k(h, 4)
+    r_zero = fp.is_zero_k(r, 4)
     Dx, Dy, Dz, _ = dbl(Qt)
     i1b, i2b = inf1 != 0, inf2 != 0
     is_dbl = h_zero & r_zero & ~i1b & ~i2b
@@ -223,23 +235,26 @@ def add_complete(Pt, Qt):
 
 
 def add_mixed(Pt, x2, y2, q_absent):
-    """Mixed add (Z2 = 1) for the comb: addend is an affine table entry.
+    """Mixed add (Z2 = 1) for the comb: addend is an affine table entry
+    with canonical (< p) coordinates.
 
     q_absent: (B,) bool — digit == 0, addend is the identity.
     No P == +-Q patches (unreachable; module docstring).  11 muls.
-    """
+    Lazy bounds: input (11p, 4p, 6p) -> output (5.2p, 3.2p, 1.3p)."""
     X1, Y1, Z1, inf1 = Pt
-    z1z1 = fp.sqr(Z1)
-    u2 = fp.mul(x2, z1z1)
-    s2 = fp.mul(y2, fp.mul(Z1, z1z1))
-    h = fp.mod_sub(u2, X1)
-    r = fp.mod_sub(s2, Y1)
-    h2 = fp.sqr(h)
-    h3 = fp.mul(h, h2)
-    u1h2 = fp.mul(X1, h2)
-    X3 = fp.mod_sub(fp.mod_sub(fp.sqr(r), h3), fp.mul_small(u1h2, 2))
-    Y3 = fp.mod_sub(fp.mul(r, fp.mod_sub(u1h2, X3)), fp.mul(Y1, h3))
-    Z3 = fp.mul(Z1, h)
+    z1z1 = fp.sqr(Z1)                    # <1.15p
+    u2 = fp.mul(x2, z1z1)                # <1.01p
+    s2 = fp.mul(y2, fp.mul(Z1, z1z1))    # <1.01p
+    h = fp.subl(u2, X1, 11)              # <12.01p
+    r = fp.subl(s2, Y1, 4)               # <5.01p
+    h2 = fp.sqr(h)                       # 144p^2 -> <1.57p
+    h3 = fp.mul(h, h2)                   # 18.9p^2 -> <1.08p
+    u1h2 = fp.mul(X1, h2)                # 17.3p^2 -> <1.07p
+    X3 = fp.subl(fp.sqr(r),
+                 fp.addl(h3, fp.smalll(u1h2, 2)), 4)     # <1.1p + 4p = 5.1p
+    w = fp.subl(u1h2, X3, 6)                             # <7.17p
+    Y3 = fp.subl(fp.mul(r, w), fp.mul(Y1, h3), 2)        # 35.9p^2 -> <3.2p
+    Z3 = fp.mul(Z1, h)                   # 72p^2 -> <1.3p
     one = fp.one_bc(X1.shape[1:])
     sel = fp.select
     i1b = inf1 != 0
@@ -266,6 +281,15 @@ def infinity(bshape):
     return one, one, fp.zero_bc(bshape), jnp.ones(bshape, jnp.int32)
 
 
+def _infinity_like(bshape, like):
+    """infinity() made data-dependent on `like` ((L, B) limbs) by adding
+    zeros derived from it: under shard_map, scan carries must share the
+    body output's varying-axis type, which constants lack."""
+    z = like[0] * 0
+    X, Y, Z, inf = infinity(bshape)
+    return X + z[None], Y + z[None], Z + z[None], inf + z
+
+
 # ---------------------------------------------------------------------------
 # Digit extraction (flat)
 # ---------------------------------------------------------------------------
@@ -282,18 +306,120 @@ def ladder_digits(u2_can):
 
 
 def comb_digits(u1_can):
-    """(L, B) canonical -> list of COMB_WINDOWS (B,) int32 6-bit digits,
-    LSB-first (window j covers bits [6j, 6j+6))."""
+    """(L, B) canonical -> list of COMB_WINDOWS (B,) int32 COMB_W-bit
+    digits, LSB-first (window j covers bits [W*j, W*j+W))."""
     out = []
     for j in range(COMB_WINDOWS):
-        bitpos = 6 * j
+        bitpos = COMB_W * j
         limb = bitpos // LB
         off = bitpos % LB
         v = u1_can[limb] >> off
         if off > LB - COMB_W and limb + 1 < L:
             v = v | (u1_can[limb + 1] << (LB - off))
-        out.append(v & 63)
+        out.append(v & (COMB_ENTRIES - 1))
     return out
+
+
+# ---------------------------------------------------------------------------
+# Fixed-base comb accumulation (shared by the G half of every verify and
+# by the per-key fast path in ops/p256_fixed.py)
+# ---------------------------------------------------------------------------
+
+def comb_accumulate(tab_f32, u_can, bshape):
+    """u * T for a canonical scalar u (< n, (L, B) limbs) against a comb
+    table (COMB_WINDOWS*COMB_ENTRIES, 2L) whose base point T has order n.
+
+    Table lookups are exact one-hot f32 matmuls (MXU; limbs <= 2^12 are
+    exactly representable, and one-hot sums select a single row).  Runs
+    as a lax.scan when traced; eagerly (python loop over per-primitive
+    jits) on concrete inputs — XLA:CPU cannot compile the big scan bodies
+    in reasonable time.
+    """
+    from jax import lax as _lax
+    eager = ff._is_concrete(u_can)
+    cd = jnp.stack(comb_digits(u_can))                       # (43, B)
+    tab = jnp.asarray(tab_f32).reshape(COMB_WINDOWS, COMB_ENTRIES, 2 * L)
+
+    if eager:
+        def comb_body(acc, d, rows):
+            iota = jnp.arange(COMB_ENTRIES, dtype=jnp.int32).reshape(
+                COMB_ENTRIES, *([1] * len(bshape)))
+            onehot = (iota == d[None]).astype(jnp.float32)
+            # HIGHEST: TPU f32 matmuls default to bf16 passes, which
+            # cannot represent 12-bit limbs exactly
+            sel = jnp.tensordot(
+                rows.T, onehot, axes=1,
+                precision=_lax.Precision.HIGHEST).astype(jnp.int32)
+            return add_mixed(acc, sel[:L], sel[L:], d == 0)
+
+        acc = infinity(bshape)
+        for j in range(COMB_WINDOWS):
+            acc = comb_body(acc, cd[j], tab[j])
+        return acc
+
+    # Traced: ALL window lookups ride ONE batched matmul up front (43
+    # small per-window matmuls inside the scan measured ~26 ms/comb at
+    # B=16k — half the fixed-path step — the batched form keeps the MXU
+    # busy instead of paying 43 tiny dispatches).
+    iota = jnp.arange(COMB_ENTRIES, dtype=jnp.int32).reshape(1, COMB_ENTRIES, 1)
+    onehot = (iota == cd[:, None, :]).astype(jnp.float32)    # (43, 64, B)
+    sel = _lax.dot_general(
+        tab, onehot,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        precision=_lax.Precision.HIGHEST).astype(jnp.int32)  # (43, 2L, B)
+
+    def body(acc, xs):
+        s, d = xs
+        return add_mixed(acc, s[:L], s[L:], d == 0), None
+
+    acc, _ = _lax.scan(body, _infinity_like(bshape, u_can), (sel, cd))
+    return acc
+
+
+def comb_accumulate_multikey(tabs_f32, key_idx, u_can, bshape):
+    """Multi-key comb: u * T[key_idx] against NK stacked per-key tables.
+
+    tabs_f32: (NK, COMB_WINDOWS*COMB_ENTRIES, 2L) f32 tables (the
+    KeyTableCache layout); key_idx: (B,) int32.  The lookup is one
+    batched one-hot matmul over the joint (key, digit) index — gathers
+    lower catastrophically on TPU (measured ~8x slower end to end), and
+    one merged dispatch matters because relayed TPU transports charge a
+    full round trip per dispatch.  NK is a compiled shape; keep it small
+    (provider buckets at 4).
+    """
+    from jax import lax as _lax
+    eager = ff._is_concrete(u_can)
+    NK = tabs_f32.shape[0]
+    flat = jnp.asarray(tabs_f32, jnp.float32).reshape(
+        NK, COMB_WINDOWS, COMB_ENTRIES, 2 * L).transpose(1, 0, 2, 3).reshape(
+        COMB_WINDOWS, NK * COMB_ENTRIES, 2 * L)
+    cd = jnp.stack(comb_digits(u_can))                       # (43, B)
+    joint = key_idx[None, :] * COMB_ENTRIES + cd             # (43, B)
+
+    iota = jnp.arange(NK * COMB_ENTRIES, dtype=jnp.int32).reshape(
+        1, NK * COMB_ENTRIES, 1)
+    if eager:
+        acc = infinity(bshape)
+        for j in range(COMB_WINDOWS):
+            onehot = (iota[0] == joint[j][None]).astype(jnp.float32)
+            sel = jnp.tensordot(
+                flat[j].T, onehot, axes=1,
+                precision=_lax.Precision.HIGHEST).astype(jnp.int32)
+            acc = add_mixed(acc, sel[:L], sel[L:], cd[j] == 0)
+        return acc
+
+    onehot = (iota == joint[:, None, :]).astype(jnp.float32)
+    sel = _lax.dot_general(
+        flat, onehot,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        precision=_lax.Precision.HIGHEST).astype(jnp.int32)  # (43, 2L, B)
+
+    def body(acc, xs):
+        s, d = xs
+        return add_mixed(acc, s[:L], s[L:], d == 0), None
+
+    acc, _ = _lax.scan(body, _infinity_like(bshape, u_can), (sel, cd))
+    return acc
 
 
 # ---------------------------------------------------------------------------
@@ -303,7 +429,7 @@ def comb_digits(u1_can):
 def verify_body(qx_l, qy_l, r_l, s_l, e_l, comb_tab_f32, require_low_s=True):
     """Batched ECDSA-P256 verify over canonical integer limbs (L, B).
 
-    comb_tab_f32: (COMB_WINDOWS*64, 2L) f32 table from comb_table_f32().
+    comb_tab_f32: (COMB_WINDOWS*COMB_ENTRIES, 2L) f32 table.
     Returns (B,) bool.
     """
     bshape = qx_l.shape[1:]
@@ -317,63 +443,62 @@ def verify_body(qx_l, qy_l, r_l, s_l, e_l, comb_tab_f32, require_low_s=True):
 
     qx_m = fp.to_mont(qx_l)
     qy_m = fp.to_mont(qy_l)
-    # on-curve: y^2 == x^3 - 3x + b
+    # on-curve: y^2 == x^3 - 3x + b  (lazy: lhs <1.01p, rhs <2.01p)
     lhs = fp.sqr(qy_m)
-    rhs = fp.mod_add(fp.mul(fp.mod_add(fp.sqr(qx_m), ff.const_col(_A_M, 2)), qx_m),
-                     ff.const_col(_B_M, 2))
-    q_ok = q_ok & fp.eq(lhs, rhs)
+    rhs = fp.addl(
+        fp.mul(fp.addl(fp.sqr(qx_m), ff.const_col(_A_M, 2)), qx_m),
+        ff.const_col(_B_M, 2))
+    q_ok = q_ok & fp.eq_k(lhs, rhs, 3, 5)
 
     # --- u1 = e/s, u2 = r/s mod n ---
     s_mn = fn.to_mont(s_l)
     e_mn = fn.to_mont(e_l)
     r_mn = fn.to_mont(r_l)
-    w = fn.inv(s_mn)
+    w = _inv_n(s_mn, bshape)
     u1 = fn.from_mont(fn.mul(e_mn, w))
     u2 = fn.from_mont(fn.mul(r_mn, w))
 
-    # --- u1*G via comb: lax.scan when traced, python loop when eager
-    # (XLA:CPU cannot compile the big scan bodies in reasonable time; the
-    # eager path drives small per-primitive jits instead) ---
+    # --- u1*G via comb (lax.scan when traced, python loop when eager) ---
     from jax import lax as _lax
     eager = ff._is_concrete(u1)
-    cd = jnp.stack(comb_digits(u1))                          # (43, B)
-    tab = jnp.asarray(comb_tab_f32).reshape(COMB_WINDOWS, 64, 2 * L)
-
-    def comb_body(acc, xs):
-        d, rows = xs
-        iota = jnp.arange(64, dtype=jnp.int32).reshape(64, *([1] * len(bshape)))
-        onehot = (iota == d[None]).astype(jnp.float32)
-        # HIGHEST: TPU f32 matmuls default to bf16 passes, which cannot
-        # represent 12-bit limbs exactly
-        sel = jnp.tensordot(rows.T, onehot, axes=1,
-                            precision=_lax.Precision.HIGHEST).astype(jnp.int32)
-        return add_mixed(acc, sel[:L], sel[L:], d == 0), None
-
-    if eager:
-        acc_g = infinity(bshape)
-        for j in range(COMB_WINDOWS):
-            acc_g, _ = comb_body(acc_g, (cd[j], tab[j]))
-    else:
-        acc_g, _ = _lax.scan(comb_body, infinity(bshape), (cd, tab))
+    acc_g = comb_accumulate(comb_tab_f32, u1, bshape)
 
     # --- u2*Q via 4-bit windowed ladder (lax.scan over 64 windows) ---
+    # The 16-entry table is built as 2Q = dbl(Q), then a scan of kQ =
+    # (k-1)Q + Q for k = 3..15 — the k-1 == +-1 doubling/cancel cases are
+    # unreachable there (k-1 >= 2) for an order-n Q, and the scan keeps
+    # the traced program small (13 adds compile as ONE body; the round-2
+    # unrolled dbl/add tree was ~20k extra HLO ops of pure compile time).
     Q1 = (qx_m, qy_m, fp.one_bc(bshape), jnp.zeros(bshape, jnp.int32))
-    T = [infinity(bshape), Q1]
-    T.append(dbl(Q1))                            # 2Q
-    for k in range(3, 16):
-        if k % 2 == 0:
-            T.append(dbl(T[k // 2]))
-        else:
+    T0 = infinity(bshape) if eager else _infinity_like(bshape, qx_m)
+    T2 = dbl(Q1)
+    if eager:
+        T = [T0, Q1, T2]
+        for k in range(3, 16):
             T.append(add_nodbl(T[k - 1], Q1))
+        TX = jnp.stack([t[0] for t in T])
+        TY = jnp.stack([t[1] for t in T])
+        TZ = jnp.stack([t[2] for t in T])
+        TI = jnp.stack([t[3] for t in T])
+    else:
+        def tab_body(acc, _):
+            nxt = add_nodbl(acc, Q1)
+            return nxt, nxt
+
+        _, rest = _lax.scan(tab_body, T2, None, length=13)
+        TX, TY, TZ, TI = (
+            jnp.concatenate([jnp.stack([a, b, c]), r], axis=0)
+            for a, b, c, r in zip(T0, Q1, T2, rest))
+
     ld = jnp.stack(ladder_digits(u2))                        # (64, B) MSB first
-    TX = jnp.stack([t[0] for t in T])
-    TY = jnp.stack([t[1] for t in T])
-    TZ = jnp.stack([t[2] for t in T])
-    TI = jnp.stack([t[3] for t in T])
 
     def ladder_body(acc, d):
-        for _ in range(LADDER_W):
-            acc = dbl(acc)
+        if eager:
+            for _ in range(LADDER_W):
+                acc = dbl(acc)
+        else:
+            # fori_loop: the dbl body compiles once, not LADDER_W times
+            acc = _lax.fori_loop(0, LADDER_W, lambda _, a: dbl(a), acc)
         ent = (TX[0], TY[0], TZ[0], TI[0])
         for k in range(1, 16):
             ent = select_point(d == k, (TX[k], TY[k], TZ[k], TI[k]), ent)
@@ -386,22 +511,40 @@ def verify_body(qx_l, qy_l, r_l, s_l, e_l, comb_tab_f32, require_low_s=True):
         for i in range(LADDER_WINDOWS):
             acc, _ = ladder_body(acc, ld[i])
     else:
-        acc, _ = _lax.scan(ladder_body, infinity(bshape), ld)
+        acc, _ = _lax.scan(ladder_body, _infinity_like(bshape, u2), ld)
     # --- combine (fully complete: u1*G == +-u2*Q is reachable) ---
     X, Y, Z, inf = add_complete(acc_g, acc)
 
-    nonzero = (inf == 0) & ~fp.is_zero(Z)
+    nonzero = (inf == 0) & ~fp.is_zero_k(Z, 6)
 
     # --- projective x-coordinate check: X == (r + k*n)*Z^2, k in {0,1} ---
+    # X carries the lazy 11p bound; the mul results are < 2p.
     z2 = fp.sqr(Z)
     r_mp = fp.to_mont(r_l)
-    eq1 = fp.eq(X, fp.mul(r_mp, z2))
+    eq1 = fp.eq_k(X, fp.mul(r_mp, z2), 2, 13)
     rn_l = ff.split_rounds(r_l + ff.const_col(bn.int_to_limbs(N),
                                               len(bshape) + 1), 3)
     rn_lt_p = ff.lt_const(rn_l, P)
-    eq2 = rn_lt_p & fp.eq(X, fp.mul(fp.to_mont(rn_l), z2))
+    eq2 = rn_lt_p & fp.eq_k(X, fp.mul(fp.to_mont(rn_l), z2), 2, 13)
 
     return r_ok & s_ok & q_ok & nonzero & (eq1 | eq2)
+
+
+def _inv_n(s_mn, bshape):
+    """w = s^-1 mod n on Montgomery forms.
+
+    Traced 1-D batches use the Montgomery-trick product tree (~3 muls per
+    element instead of a ~330-mul Fermat ladder); zero elements (s == 0
+    mod n — always rejected by the range checks) are pre-selected to 1 so
+    they cannot poison the tree, their garbage inverse being gated by
+    s_ok.  Eager/odd-shaped inputs keep the Fermat path.
+    """
+    if (not ff._is_concrete(s_mn) and len(bshape) == 1
+            and bshape[0] >= 128 and bshape[0] % 2 == 0):
+        s_zero = fn.is_zero_k(s_mn, 2)
+        s_safe = fn.select(s_zero, fn.one_bc(bshape), s_mn)
+        return fn.inv_tree(s_safe)
+    return fn.inv(s_mn)
 
 
 def verify_words_xla(qx, qy, r, s, e, require_low_s: bool = True):

@@ -321,6 +321,133 @@ class FlatMod:
             t -= 1
         return s
 
+    # -- lazy-reduction ops (round-3 hot-path API) ---------------------------
+    #
+    # The round-2 mod_add/mod_sub/mul_small each paid a Kogge-Stone-based
+    # conditional subtraction (~60-80 elementwise ops — comparable to half
+    # a field mul) to normalize every intermediate back to < 2p.  That is
+    # wasted work: the CIOS mul tolerates operand VALUES up to ~16p (and
+    # products up to 256p^2 still emerge < 2p), so curve formulas can run
+    # entirely on lazily-reduced values whose bound the CALLER tracks
+    # statically (ops/ecp256.py documents the per-coordinate invariants).
+    # Only limb magnitudes must stay < 2^13 for the CIOS int32 headroom —
+    # one value-preserving carry-save round (split_rounds(.., 1), ~4 ops)
+    # after each add/sub/scale is enough.  No conditional subtractions
+    # anywhere in the hot loop.
+
+    def _kp_np(self, k: int) -> np.ndarray:
+        key = ("kp", k)
+        cached = _PRIM_CACHE.get((self.p, key))
+        if cached is None:
+            cached = bn.int_to_limbs(k * self.p).astype(np.int32)
+            _PRIM_CACHE[(self.p, key)] = cached
+        return cached
+
+    def addl(self, a, b):
+        """Lazy add: value(a)+value(b); bound = sum of bounds (caller
+        tracks; keep mul operands <= ~16p).  ~4 elementwise ops."""
+        if _is_concrete(a, b):
+            return _prim_jit(("addl", self.p), self._addl_impl)(a, b)
+        return self._addl_impl(a, b)
+
+    def _addl_impl(self, a, b):
+        return split_rounds(jnp.asarray(a) + jnp.asarray(b), 1)
+
+    def subl(self, a, b, k: int):
+        """Lazy subtract: a - b + k*p, REQUIRES value(b) < k*p so the
+        result is non-negative.  Bound = bound(a) + k*p."""
+        if _is_concrete(a, b):
+            return _prim_jit(("subl", self.p, k),
+                             lambda x, y: self._subl_impl(x, y, k))(a, b)
+        return self._subl_impl(a, b, k)
+
+    def _subl_impl(self, a, b, k: int):
+        a = jnp.asarray(a)
+        kp = self._col(self._kp_np(k), a.ndim)
+        return split_rounds(a - jnp.asarray(b) + kp, 1)
+
+    def smalll(self, a, c: int):
+        """Lazy small-scalar multiply (1 <= c <= 8): bound = c * bound(a)."""
+        if _is_concrete(a):
+            return _prim_jit(("smalll", self.p, c),
+                             lambda x: self._smalll_impl(x, c))(a)
+        return self._smalll_impl(a, c)
+
+    def _smalll_impl(self, a, c: int):
+        if not 1 <= c <= 8:
+            raise ValueError("smalll scale out of range")
+        return split_rounds(jnp.asarray(a) * c, 1)
+
+    def reduce_to_2p(self, a, kbound: int):
+        """Lazily-bounded value < kbound*p -> value < 2p (for handoff to
+        the canonical predicates).  ceil(log2(kbound))-1 conditional
+        subtractions — use only OUTSIDE hot loops."""
+        if _is_concrete(a):
+            return _prim_jit(("red2p", self.p, kbound),
+                             lambda x: self._reduce_to_2p_impl(x, kbound))(a)
+        return self._reduce_to_2p_impl(a, kbound)
+
+    def _reduce_to_2p_impl(self, a, kbound: int):
+        s = jnp.asarray(a)
+        t = max(0, (kbound - 1).bit_length() - 1)
+        while t >= 1:
+            sub = self._col(self._kp_np(1 << t), s.ndim)
+            d = s - sub
+            neg = is_negative(d)
+            s = jnp.where(neg[None], s, split_rounds(d, 2))
+            t -= 1
+        return s
+
+    def is_zero_k(self, a, kbound: int):
+        """value(a) == 0 mod p for a lazily-bounded value < kbound*p:
+        (B,) bool.  One exact resolve + kbound limb comparisons."""
+        if _is_concrete(a):
+            return _prim_jit(("is0k", self.p, kbound),
+                             lambda x: self._is_zero_k_impl(x, kbound))(a)
+        return self._is_zero_k_impl(a, kbound)
+
+    def _is_zero_k_impl(self, a, kbound: int):
+        r = resolve(jnp.asarray(a))
+        acc = None
+        for j in range(kbound):
+            jp = self._col(self._kp_np(j), r.ndim) if j else None
+            hit = (jnp.all(r == jp, axis=0) if j
+                   else jnp.all(r == 0, axis=0))
+            acc = hit if acc is None else (acc | hit)
+        return acc
+
+    def eq_k(self, a, b, kbound_b: int, kbound_sum: int):
+        """value(a) == value(b) mod p; b bounded < kbound_b*p, and
+        kbound_sum >= bound(a)/p + kbound_b."""
+        return self.is_zero_k(self.subl(a, b, kbound_b), kbound_sum)
+
+    def inv_tree(self, a, min_width: int = 64):
+        """Batched modular inverse via Montgomery's simultaneous-inversion
+        trick as a product tree over the batch axis: ~3 muls per element
+        plus one Fermat chain on a min_width-wide stub — replaces the
+        ~330-mul per-element Fermat ladder.
+
+        a: (L, B) Montgomery-form values, B a power of two, with NO zero
+        elements (callers must pre-select zeros to 1; zero poisons the
+        whole product tree).  inv of the Montgomery form x gives the
+        Montgomery form of x^-1.
+        """
+        a = jnp.asarray(a)
+        stack = []
+        cur = a
+        while cur.shape[1] > min_width and cur.shape[1] % 2 == 0:
+            left, right = cur[:, 0::2], cur[:, 1::2]
+            stack.append((left, right))
+            cur = self.mul(left, right)
+        inv = self.pow_const_scan(cur, self.p - 2)
+        for left, right in reversed(stack):
+            inv_left = self.mul(inv, right)
+            inv_right = self.mul(inv, left)
+            # interleave back: (L, 2, m) -> (L, 2m)
+            inv = jnp.stack([inv_left, inv_right], axis=2).reshape(
+                inv_left.shape[0], -1)
+        return inv
+
     # -- conversions / predicates -------------------------------------------
 
     def to_mont(self, a):

@@ -94,13 +94,14 @@ def verify_words(qx, qy, r, s, e, require_low_s: bool = True) -> jnp.ndarray:
 
 def bytes32_to_words(vals: list) -> np.ndarray:
     """list of B 32-byte big-endian bytestrings -> (8, B) uint32."""
-    out = np.zeros((8, len(vals)), dtype=np.uint32)
-    for b, v in enumerate(vals):
+    for v in vals:
         if len(v) != 32:
             raise ValueError("expected 32-byte value")
-        for wi in range(8):
-            out[wi, b] = int.from_bytes(v[4 * wi:4 * wi + 4], "big")
-    return out
+    if not vals:
+        return np.zeros((8, 0), dtype=np.uint32)
+    flat = np.frombuffer(b"".join(vals), dtype=">u4")
+    return np.ascontiguousarray(
+        flat.reshape(len(vals), 8).T).astype(np.uint32)
 
 
 def ints_to_words(vals: list) -> np.ndarray:

@@ -66,10 +66,10 @@ def _kernel(expn_ref, comb_ref, qx_ref, qy_ref, r_ref, s_ref, e_ref,
     qx_m = fp.to_mont(qx_l)
     qy_m = fp.to_mont(qy_l)
     lhs = fp.sqr(qy_m)
-    rhs = fp.mod_add(
-        fp.mul(fp.mod_add(fp.sqr(qx_m), ff.const_col(ec._A_M, 2)), qx_m),
+    rhs = fp.addl(
+        fp.mul(fp.addl(fp.sqr(qx_m), ff.const_col(ec._A_M, 2)), qx_m),
         ff.const_col(ec._B_M, 2))
-    q_ok = q_ok & fp.eq(lhs, rhs)
+    q_ok = q_ok & fp.eq_k(lhs, rhs, 3, 5)
 
     # --- w = s^-1 mod n: windowed Fermat, exponent digits from SMEM ---
     s_mn = fn.to_mont(s_l)
@@ -108,9 +108,10 @@ def _kernel(expn_ref, comb_ref, qx_ref, qy_ref, r_ref, s_ref, e_ref,
 
     def comb_body(j, acc):
         d = cdig_ref[pl.ds(j, 1), :][0]
-        iota = lax.broadcasted_iota(jnp.int32, (64,) + tuple(bshape), 0)
+        iota = lax.broadcasted_iota(
+            jnp.int32, (ec.COMB_ENTRIES,) + tuple(bshape), 0)
         onehot = (iota == d[None]).astype(jnp.float32)
-        rows = comb_ref[pl.ds(j * 64, 64), :]          # (64, 2L) f32
+        rows = comb_ref[pl.ds(j * ec.COMB_ENTRIES, ec.COMB_ENTRIES), :]
         sel = jax.lax.dot_general(
             rows, onehot, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32,
@@ -144,14 +145,15 @@ def _kernel(expn_ref, comb_ref, qx_ref, qy_ref, r_ref, s_ref, e_ref,
     acc_q = lax.fori_loop(0, ec.LADDER_WINDOWS, ladder_body,
                           ec.infinity(bshape))
 
-    # --- combine + projective x check ---
+    # --- combine + projective x check (lazy bounds: X < 11p, Z < 6p) ---
     X, Y, Z, inf = ec.add_complete(acc_g, acc_q)
-    nonzero = (inf == 0) & ~fp.is_zero(Z)
+    nonzero = (inf == 0) & ~fp.is_zero_k(Z, 6)
     z2 = fp.sqr(Z)
-    eq1 = fp.eq(X, fp.mul(fp.to_mont(r_l), z2))
+    eq1 = fp.eq_k(X, fp.mul(fp.to_mont(r_l), z2), 2, 13)
     rn_l = ff.split_rounds(r_l + ff.const_col(bn.int_to_limbs(ec.N),
                                               len(bshape) + 1), 3)
-    eq2 = ff.lt_const(rn_l, ec.P) & fp.eq(X, fp.mul(fp.to_mont(rn_l), z2))
+    eq2 = (ff.lt_const(rn_l, ec.P)
+           & fp.eq_k(X, fp.mul(fp.to_mont(rn_l), z2), 2, 13))
 
     ok = r_ok & s_ok & q_ok & nonzero & (eq1 | eq2)
     out_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32)[None, :],
@@ -226,7 +228,8 @@ def _run_tiles(cpool, expn, comb, qx, qy, r, s, e, require_low_s, n_tiles):
             pl.BlockSpec(cpool.shape, lambda i: (0, 0),
                          memory_space=pltpu.VMEM),       # constant pool
             pl.BlockSpec(memory_space=pltpu.SMEM),       # exponent digits
-            pl.BlockSpec((ec.COMB_WINDOWS * 64, 2 * L), lambda i: (0, 0),
+            pl.BlockSpec((ec.COMB_WINDOWS * ec.COMB_ENTRIES, 2 * L),
+                         lambda i: (0, 0),
                          memory_space=pltpu.VMEM),       # comb table
             limb_spec, limb_spec, limb_spec, limb_spec, limb_spec,
         ],

@@ -1,0 +1,185 @@
+"""Host-side per-key fixed-base comb tables for ECDSA-P256 verification.
+
+The verify workload this framework exists for (SURVEY.md §3.2) is heavily
+key-repetitive: a 10k-tx block carries ~3 endorsement signatures per tx
+from a handful of stable org endorser certificates (the reference's own
+msp/cache exists because identities repeat, msp/cache/cache.go).  For a
+repeated public key Q the u2*Q half of the verification can use the same
+fixed-base comb the generator G already enjoys (ops/ecp256.py): 43
+windows of 6 bits over a precomputed table of k * 2^(6j) * Q — replacing
+the 256-doubling windowed ladder entirely and roughly tripling per-sig
+throughput (ops/p256_fixed.py).
+
+This module builds those tables on the host with python-int Jacobian
+arithmetic + one Montgomery-trick batched inversion (~15 ms per key) and
+caches them by SEC1 pubkey, so the cost amortizes across blocks.  The
+on-curve check happens ONCE here at build time; the device kernel for
+cached keys never sees Q at all.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import numpy as np
+
+from . import bignum as bn
+from . import ecp256 as ec
+
+P = ec.P
+COMB_W = ec.COMB_W
+COMB_WINDOWS = ec.COMB_WINDOWS
+COMB_ENTRIES = ec.COMB_ENTRIES
+L = ec.L
+
+
+# -- python-int Jacobian arithmetic (no inversions until the end) ------------
+
+def _jdbl(pt):
+    X, Y, Z = pt
+    delta = Z * Z % P
+    gamma = Y * Y % P
+    beta = X * gamma % P
+    alpha = 3 * (X - delta) * (X + delta) % P
+    X3 = (alpha * alpha - 8 * beta) % P
+    Z3 = ((Y + Z) * (Y + Z) - gamma - delta) % P
+    Y3 = (alpha * (4 * beta - X3) - 8 * gamma * gamma) % P
+    return X3, Y3, Z3
+
+
+def _jadd(p1, p2):
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    if Z1 == 0:
+        return p2
+    if Z2 == 0:
+        return p1
+    z1z1 = Z1 * Z1 % P
+    z2z2 = Z2 * Z2 % P
+    u1 = X1 * z2z2 % P
+    u2 = X2 * z1z1 % P
+    s1 = Y1 * Z2 * z2z2 % P
+    s2 = Y2 * Z1 * z1z1 % P
+    h = (u2 - u1) % P
+    r = (s2 - s1) % P
+    if h == 0:
+        if r == 0:
+            return _jdbl(p1)
+        return (1, 1, 0)
+    h2 = h * h % P
+    h3 = h * h2 % P
+    u1h2 = u1 * h2 % P
+    X3 = (r * r - h3 - 2 * u1h2) % P
+    Y3 = (r * (u1h2 - X3) - s1 * h3) % P
+    Z3 = Z1 * Z2 * h % P
+    return X3, Y3, Z3
+
+
+def _batch_to_affine(points):
+    """Jacobian -> affine for a list of points with one modular inversion
+    (Montgomery's trick).  No infinities allowed."""
+    zs = [pt[2] for pt in points]
+    prefix = [1]
+    for z in zs:
+        prefix.append(prefix[-1] * z % P)
+    inv_all = pow(prefix[-1], P - 2, P)
+    out = [None] * len(points)
+    for i in range(len(points) - 1, -1, -1):
+        z_inv = inv_all * prefix[i] % P
+        inv_all = inv_all * zs[i] % P
+        z2 = z_inv * z_inv % P
+        X, Y, _ = points[i]
+        out[i] = (X * z2 % P, Y * z2 % P * z_inv % P)
+    return out
+
+
+def on_curve(qx: int, qy: int) -> bool:
+    if not (0 <= qx < P and 0 <= qy < P):
+        return False
+    return (qy * qy - (qx * qx * qx + ec.A * qx + ec.B)) % P == 0
+
+
+def comb_table_for_point(qx: int, qy: int) -> np.ndarray:
+    """(COMB_WINDOWS * COMB_ENTRIES, 2L) f32 comb table for Q = (qx, qy):
+    row j*COMB_ENTRIES+k holds the Montgomery-form affine limbs of
+    k * 2^(COMB_W*j) * Q (k = 0 rows are zero, patched at lookup time —
+    ec.comb_table_f32's G table uses the same builder).
+
+    Raises ValueError for points not on the curve — this is the single
+    on-curve gate for the fixed-base fast path.
+    """
+    if not on_curve(qx, qy):
+        raise ValueError("point not on P-256")
+    jac = []                      # (window, k) in order, k = 1..2^W-1
+    base = (qx, qy, 1)
+    for j in range(COMB_WINDOWS):
+        acc = base
+        jac.append(acc)
+        for _ in range(COMB_ENTRIES - 2):
+            acc = _jadd(acc, base)
+            jac.append(acc)
+        for _ in range(COMB_W):
+            base = _jdbl(base)
+    affine = _batch_to_affine(jac)
+    rows = np.zeros((COMB_WINDOWS * COMB_ENTRIES, 2 * L), dtype=np.float32)
+    R = ec.fp.R
+    idx = 0
+    for j in range(COMB_WINDOWS):
+        for k in range(1, COMB_ENTRIES):
+            x, y = affine[idx]
+            idx += 1
+            rows[j * COMB_ENTRIES + k, :L] = bn.int_to_limbs(x * R % P)
+            rows[j * COMB_ENTRIES + k, L:] = bn.int_to_limbs(y * R % P)
+    return rows
+
+
+class KeyTableCache:
+    """LRU cache of per-key comb tables, keyed by SEC1 pubkey bytes.
+
+    Thread-safe.  ~2.8 MB per key; the default cap of 64 keys bounds the
+    cache at ~180 MB — far more distinct *hot* keys than any real channel
+    has endorsing orgs.
+    """
+
+    def __init__(self, max_keys: int = 64):
+        self.max_keys = max_keys
+        self._lru: "OrderedDict[bytes, np.ndarray]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "builds": 0, "rejects": 0}
+
+    def __contains__(self, pubkey: bytes) -> bool:
+        with self._lock:
+            return pubkey in self._lru
+
+    def get(self, pubkey: bytes) -> Optional[np.ndarray]:
+        with self._lock:
+            tab = self._lru.get(pubkey)
+            if tab is not None:
+                self._lru.move_to_end(pubkey)
+                self.stats["hits"] += 1
+            return tab
+
+    def get_or_build(self, pubkey: bytes) -> Optional[np.ndarray]:
+        """Build (and cache) the table for an uncompressed SEC1 pubkey;
+        returns None for malformed/off-curve keys."""
+        tab = self.get(pubkey)
+        if tab is not None:
+            return tab
+        if len(pubkey) != 65 or pubkey[0] != 0x04:
+            self.stats["rejects"] += 1
+            return None
+        qx = int.from_bytes(pubkey[1:33], "big")
+        qy = int.from_bytes(pubkey[33:65], "big")
+        try:
+            tab = comb_table_for_point(qx, qy)
+        except ValueError:
+            self.stats["rejects"] += 1
+            return None
+        with self._lock:
+            self.stats["builds"] += 1
+            self._lru[pubkey] = tab
+            while len(self._lru) > self.max_keys:
+                self._lru.popitem(last=False)
+        return tab

@@ -70,6 +70,32 @@ def sharded_p256_verify(mesh: Mesh, require_low_s: bool = True):
     return jax.jit(fn)
 
 
+def sharded_p256_multikey_verify(mesh: Mesh, require_low_s: bool = True):
+    """Sharded multi-key fixed-base P-256 verifier.
+
+    fn(tabs, key_idx, r, s, e) -> (verdicts (B,), valid_count ()): the
+    stacked per-key tables replicate to every device; key indices and
+    signature words shard over the batch axis.
+    """
+    from fabric_tpu.ops import p256_fixed
+
+    word_spec = PSpec(None, BATCH_AXIS)
+    idx_spec = PSpec(BATCH_AXIS)
+    tab_spec = PSpec(None, None, None)
+
+    def local(tabs, key_idx, r, s, e):
+        v = p256_fixed.verify_words_multikey(
+            tabs, key_idx, r, s, e, require_low_s=require_low_s)
+        count = jax.lax.psum(jnp.sum(v.astype(jnp.int32)), BATCH_AXIS)
+        return v, count
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(tab_spec, idx_spec, word_spec, word_spec, word_spec),
+        out_specs=(PSpec(BATCH_AXIS), PSpec()))
+    return jax.jit(fn)
+
+
 def sharded_ed25519_verify(mesh: Mesh):
     """Build a jitted sharded ed25519 batch verifier over `mesh`.
 

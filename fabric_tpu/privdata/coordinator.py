@@ -93,6 +93,9 @@ class Coordinator:
                         block.header.number, txid, ns, coll, dict(expected)))
                     continue
                 writes.setdefault((ns, coll), {}).update(clear)
+                self.pvt_store.record_tx(txid, ns, coll, clear,
+                                         block_num=block.header.number,
+                                         btl=cfg.block_to_live)
                 btl[(ns, coll)] = cfg.block_to_live
         if writes:
             self.pvt_store.commit(block.header.number, writes, btl)
@@ -136,6 +139,10 @@ class Coordinator:
                     m.block_num, {(m.namespace, m.collection): verified},
                     {(m.namespace, m.collection):
                      cfg.block_to_live if cfg else 0})
+                self.pvt_store.record_tx(
+                    m.txid, m.namespace, m.collection, verified,
+                    block_num=m.block_num,
+                    btl=cfg.block_to_live if cfg else 0)
                 recovered += 1
             else:
                 if fetched:

@@ -22,6 +22,10 @@ class PvtDataStore:
         self._state: Dict[Tuple[str, str, str], Tuple[bytes, int]] = {}
         # expiry_block -> list of keys to purge
         self._expiry: Dict[int, List[Tuple[str, str, str]]] = {}
+        # (ns, coll, txid) -> {key: value} — the pull-service index
+        self._by_txid: Dict[Tuple[str, str, str], dict] = {}
+        # expiry_block -> tx-index entries to drop alongside the state keys
+        self._tx_expiry: Dict[int, List[Tuple[str, str, str]]] = {}
 
     def commit(self, block_num: int, writes: dict, btl_by_coll: dict) -> None:
         """writes: {(ns, coll): {key: value|None}}; btl_by_coll maps
@@ -52,7 +56,29 @@ class PvtDataStore:
                     if ent is not None and ent[1] + 1 <= expiry:
                         del self._state[sk]
                         purged += 1
+            for expiry in [b for b in self._tx_expiry if b <= block_num]:
+                for tk in self._tx_expiry.pop(expiry):
+                    self._by_txid.pop(tk, None)
         return purged
+
+    def record_tx(self, txid: str, namespace: str, collection: str,
+                  kv: dict, block_num: int = 0, btl: int = 0) -> None:
+        """Index a committed tx's collection cleartext by txid — the
+        lookup surface the privdata pull service answers from
+        (pvtdataprovider.go serves by txid+collection).  BTL applies to
+        this index exactly like the keyed state: expired private data
+        must stop being servable."""
+        with self._lock:
+            tk = (namespace, collection, txid)
+            self._by_txid.setdefault(tk, {}).update(kv)
+            if btl:
+                self._tx_expiry.setdefault(block_num + btl + 1, []).append(tk)
+
+    def get_tx_set(self, namespace: str, collection: str,
+                   txid: str) -> Optional[dict]:
+        with self._lock:
+            got = self._by_txid.get((namespace, collection, txid))
+            return dict(got) if got is not None else None
 
     def get(self, namespace: str, collection: str, key: str) -> Optional[bytes]:
         with self._lock:

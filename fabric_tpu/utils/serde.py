@@ -33,6 +33,11 @@ def encode(v: Any) -> bytes:
     return bytes(out)
 
 
+# the pure-Python implementations stay importable under these names for
+# the differential tests and as the no-compiler fallback
+encode_py = encode
+
+
 def _enc(v: Any, out: bytearray) -> None:
     if v is None:
         out += b"N"
@@ -141,3 +146,17 @@ def _dec(mv: memoryview, off: int):
             d[k] = v
         return d, off
     raise ValueError(f"bad tag {tag!r} at {off - 1}")
+
+
+decode_py = decode
+
+# hot-path C codec (fabric_tpu/native/ftlv.c) — identical wire format and
+# error behavior; tests/test_serde.py exercises both differentially
+try:
+    from fabric_tpu import native as _native_pkg
+    _ftlv = _native_pkg.load("_ftlv")
+except Exception:      # pragma: no cover - import cycle / broken toolchain
+    _ftlv = None
+if _ftlv is not None:
+    encode = _ftlv.encode
+    decode = _ftlv.decode

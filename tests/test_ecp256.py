@@ -174,3 +174,70 @@ def test_pallas_matches_xla(cases):
         *[__import__("fabric_tpu.ops.bignum", fromlist=["x"])
           .words_be_to_limbs(a) for a in args])))
     assert pl_out == xla
+
+
+# ---------------------------------------------------------------------------
+# per-key fixed-base fast path (round-3)
+# ---------------------------------------------------------------------------
+
+def test_fixed_path_matches_generic(cases):
+    """The cached-key comb path must agree bit-for-bit with the generic
+    path (and hence the OpenSSL oracle) — including adversarial r/s and
+    wrong-digest cases, for each distinct key."""
+    from fabric_tpu.ops import p256_fixed, p256_tables
+    want = [bool(c[5]) for c in cases]
+    by_key = {}
+    for i, c in enumerate(cases):
+        by_key.setdefault((c[0], c[1]), []).append(i)
+    got = [None] * len(cases)
+    for (qx, qy), idxs in by_key.items():
+        if not p256_tables.on_curve(qx, qy):
+            for i in idxs:
+                got[i] = False      # provider routes these to host-reject
+            continue
+        tab = p256_tables.comb_table_for_point(qx, qy)
+        sub = [cases[i] for i in idxs]
+        _, _, r, s, e = [np.asarray(p256.ints_to_words(list(v)))
+                         for v in zip(*[c[:5] for c in sub])]
+        out = np.asarray(p256_fixed.verify_words_fixed(tab, r, s, e))
+        for j, i in enumerate(idxs):
+            got[i] = bool(out[j])
+    assert got == want
+
+
+def test_key_table_cache():
+    from fabric_tpu.ops.p256_tables import KeyTableCache
+    key = cec.generate_private_key(cec.SECP256R1()).public_key()
+    from cryptography.hazmat.primitives import serialization
+    sec1 = key.public_bytes(serialization.Encoding.X962,
+                            serialization.PublicFormat.UncompressedPoint)
+    cache = KeyTableCache(max_keys=2)
+    t1 = cache.get_or_build(sec1)
+    assert t1 is not None and cache.stats["builds"] == 1
+    t2 = cache.get_or_build(sec1)
+    assert t2 is t1 and cache.stats["hits"] >= 1
+    # off-curve key rejected
+    bad = bytearray(sec1)
+    bad[-1] ^= 1
+    assert cache.get_or_build(bytes(bad)) is None
+    assert cache.stats["rejects"] == 1
+
+
+def test_multikey_path_matches_generic(cases):
+    """The merged multi-key gather kernel must agree with the generic
+    path for mixed-key batches (provider dispatch shape)."""
+    from fabric_tpu.ops import p256_fixed, p256_tables
+    on_curve_cases = [c for c in cases
+                      if p256_tables.on_curve(c[0], c[1])]
+    keys = {}
+    for c in on_curve_cases:
+        keys.setdefault((c[0], c[1]), len(keys))
+    tabs = np.stack([p256_tables.comb_table_for_point(qx, qy)
+                     for (qx, qy) in keys]).astype(np.int32)
+    key_idx = np.asarray([keys[(c[0], c[1])] for c in on_curve_cases],
+                         dtype=np.int32)
+    _, _, r, s, e = [np.asarray(p256.ints_to_words(list(v)))
+                     for v in zip(*[c[:5] for c in on_curve_cases])]
+    out = np.asarray(p256_fixed.verify_words_multikey(tabs, key_idx, r, s, e))
+    want = [bool(c[5]) for c in on_curve_cases]
+    assert list(out) == want

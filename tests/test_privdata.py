@@ -125,6 +125,10 @@ def test_btl_purge(org, provider, tmp_path):
         commit_block(coord, ledger, [e])
     assert pvt.get("cc", "secrets", "sec1") is None       # purged
     assert pvt.get("cc", "secrets", "sec4") == b"classified"  # fresh
+    # the txid-indexed pull-service view purges with the state: expired
+    # private data must stop being servable over privdata.fetch
+    txid1 = env.header().channel_header.txid
+    assert pvt.get_tx_set("cc", "secrets", txid1) is None
 
 
 def test_missing_then_reconciled(org, provider, tmp_path):

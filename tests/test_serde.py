@@ -27,3 +27,65 @@ def test_unsupported_types_raise():
         serde.encode({1: "intkey"})
     with pytest.raises(ValueError):
         serde.encode(-(2**100))
+
+
+def test_native_codec_differential():
+    """The C codec (fabric_tpu/native/ftlv.c) must byte-match the Python
+    reference encoder and agree on decode, including error behavior."""
+    from fabric_tpu import native
+    import random
+    mod = native.load("_ftlv")
+    if mod is None:
+        pytest.skip("no C toolchain")
+
+    rng = random.Random(9)
+
+    def rand_val(depth=0):
+        kinds = ["int", "bigint", "bytes", "str", "none", "bool"]
+        if depth < 3:
+            kinds += ["list", "dict"] * 2
+        k = rng.choice(kinds)
+        if k == "int":
+            return rng.randrange(-2**63, 2**63)
+        if k == "bigint":
+            return rng.randrange(2**63, 2**300)
+        if k == "bytes":
+            return rng.randbytes(rng.randrange(0, 40))
+        if k == "str":
+            return "".join(chr(rng.randrange(32, 0x2FF))
+                           for _ in range(rng.randrange(0, 12)))
+        if k == "none":
+            return None
+        if k == "bool":
+            return rng.random() < 0.5
+        if k == "list":
+            return [rand_val(depth + 1) for _ in range(rng.randrange(0, 5))]
+        return {f"k{rng.randrange(99)}": rand_val(depth + 1)
+                for _ in range(rng.randrange(0, 5))}
+
+    for _ in range(200):
+        v = rand_val()
+        c_bytes = mod.encode(v)
+        assert c_bytes == serde.encode_py(v)
+        assert mod.decode(c_bytes) == v
+        assert serde.decode_py(c_bytes) == v
+
+    # edge ints around the I/V boundary
+    for x in [2**63 - 1, 2**63, 2**64, 2**200, 0, -1, -2**63]:
+        assert mod.encode(x) == serde.encode_py(x)
+        assert mod.decode(mod.encode(x)) == x
+
+    # error parity
+    for bad in [b"", b"I\x00\x01", b"B\x00\x00\x00\x10abc", b"Z",
+                serde.encode_py({"a": 1}) + b"t"]:
+        with pytest.raises(ValueError):
+            mod.decode(bad)
+    with pytest.raises(TypeError):
+        mod.encode(1.5)
+    with pytest.raises(TypeError):
+        mod.encode({1: "intkey"})
+    with pytest.raises(ValueError):
+        mod.encode(-(2**100))
+    # memoryview/bytearray accepted like the Python encoder
+    assert mod.encode(memoryview(b"xy")) == serde.encode_py(memoryview(b"xy"))
+    assert mod.encode(bytearray(b"xy")) == serde.encode_py(bytearray(b"xy"))
