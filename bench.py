@@ -256,6 +256,28 @@ def main():
         except Exception as exc:
             detail["ed25519_error"] = str(exc)[:200]
 
+    # -- Idemix host baseline (BASELINE config 4 starting point) ------------
+    if os.environ.get("BENCH_SKIP_IDEMIX") != "1":
+        try:
+            from fabric_tpu.idemix import bn254 as bnc
+            t0 = time.perf_counter()
+            n_pair = 3
+            for _ in range(n_pair):
+                bnc.pairing(bnc.G1_GEN, bnc.G2_GEN)
+            detail["idemix_host_pairings_per_sec"] = round(
+                n_pair / (time.perf_counter() - t0), 2)
+            from fabric_tpu.idemix import credential as crd
+            from fabric_tpu.idemix.msp import N_ATTRS
+            isk = crd.IssuerKey.generate(N_ATTRS)
+            c = crd.issue(isk, [1, 1, 2, 3])
+            pres = crd.present(isk.public(), c, [0, 1], b"n")
+            t0 = time.perf_counter()
+            assert crd.verify_presentation(isk.public(), pres, b"n")
+            detail["idemix_host_verify_s"] = round(
+                time.perf_counter() - t0, 2)
+        except Exception as exc:
+            detail["idemix_error"] = str(exc)[:200]
+
     # -- block pipeline p50 --------------------------------------------------
     if os.environ.get("BENCH_SKIP_BLOCK") != "1":
         try:

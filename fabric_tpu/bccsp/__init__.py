@@ -15,10 +15,11 @@ Providers:
             slot" — SURVEY.md §2.1.1 — occupied by the TPU)
 """
 
-from .provider import VerifyItem, SCHEME_P256, SCHEME_ED25519
+from .provider import (VerifyItem, SCHEME_P256, SCHEME_ED25519,
+                       SCHEME_IDEMIX)
 from .factory import get_default, init_factories, FactoryOpts
 
 __all__ = [
-    "VerifyItem", "SCHEME_P256", "SCHEME_ED25519",
+    "VerifyItem", "SCHEME_P256", "SCHEME_ED25519", "SCHEME_IDEMIX",
     "get_default", "init_factories", "FactoryOpts",
 ]

@@ -29,7 +29,8 @@ import numpy as np
 from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
 
 from . import provider as prov
-from .provider import VerifyItem, SCHEME_P256, SCHEME_ED25519
+from .provider import (VerifyItem, SCHEME_P256, SCHEME_ED25519,
+                       SCHEME_IDEMIX)
 from .sw import SoftwareProvider
 
 logger = logging.getLogger("fabric_tpu.bccsp.jaxtpu")
@@ -299,6 +300,17 @@ class JaxTpuProvider(prov.Provider):
             for scheme, idxs in by_scheme.items():
                 if scheme == SCHEME_P256:
                     self._verify_p256(items, idxs, pending)
+                elif scheme == SCHEME_IDEMIX:
+                    # host-verified (BN254 pairing batch on TPU is the
+                    # BASELINE config-4 target); DEFERRED to resolve()
+                    # so the device lanes enqueue first and stay async
+                    idemix_items = [items[i] for i in idxs]
+
+                    def _idemix_out(its=idemix_items):
+                        from fabric_tpu.idemix.msp import verify_item_host
+                        return np.array([verify_item_host(it) for it in its],
+                                        dtype=bool)
+                    pending.append((idxs, _idemix_out))
                 elif scheme == SCHEME_ED25519:
                     keep, arrays = self._pack_ed25519(items, idxs)
                     if keep:
@@ -315,6 +327,8 @@ class JaxTpuProvider(prov.Provider):
         def resolve():
             try:
                 for keep, out in pending:
+                    if callable(out):
+                        out = out()
                     verdicts[np.asarray(keep)] = np.asarray(out)[:len(keep)]
             except Exception:
                 logger.exception(

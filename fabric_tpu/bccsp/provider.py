@@ -16,6 +16,7 @@ import numpy as np
 
 SCHEME_P256 = "ecdsa-p256"
 SCHEME_ED25519 = "ed25519"
+SCHEME_IDEMIX = "idemix-bbs"
 
 HASH_SHA256 = "sha256"
 HASH_SHA384 = "sha384"

@@ -23,7 +23,8 @@ from cryptography.hazmat.primitives.asymmetric.utils import (
 from cryptography.hazmat.primitives import serialization
 
 from . import provider as prov
-from .provider import VerifyItem, SCHEME_P256, SCHEME_ED25519
+from .provider import (VerifyItem, SCHEME_P256, SCHEME_ED25519,
+                       SCHEME_IDEMIX)
 
 P256_N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
 P256_HALF_N = (P256_N - 1) // 2
@@ -108,6 +109,9 @@ class SoftwareProvider(prov.Provider):
                 Ed25519PublicKey.from_public_bytes(it.pubkey).verify(
                     it.signature, it.payload)
                 return True
+            if it.scheme == SCHEME_IDEMIX:
+                from fabric_tpu.idemix.msp import verify_item_host
+                return verify_item_host(it)
             return False
         except (InvalidSignature, ValueError, TypeError):
             return False

@@ -95,6 +95,87 @@ class LifecycleContract(Contract):
             raise SimulationError("cannot derive creator mspid")
 
 
+# ---------------------------------------------------------------------------
+# install / package (lifecycle.go InstallChaincode + persistence/)
+# ---------------------------------------------------------------------------
+
+def package_chaincode(label: str, code: bytes,
+                      metadata: Optional[dict] = None) -> bytes:
+    """Build a chaincode package (the reference's tar.gz package role:
+    persistence/chaincode_package.go) — canonical serde of label +
+    metadata + code bytes."""
+    if not label or any(c in label for c in "/\\:"):
+        raise ValueError("invalid package label")
+    return serde.encode({"label": label, "code": code,
+                         "metadata": metadata or {}})
+
+
+def package_id(pkg: bytes) -> str:
+    """`label:sha256(pkg)` — the hash-addressed package identity
+    (persistence.PackageID)."""
+    import hashlib
+    label = serde.decode(pkg)["label"]
+    return f"{label}:{hashlib.sha256(pkg).hexdigest()}"
+
+
+class ChaincodeInstaller:
+    """Installed-chaincode store (lifecycle.go InstallChaincode /
+    QueryInstalledChaincodes): packages persisted by package id under a
+    directory, content-addressed so re-install is idempotent and a
+    tampered package can never impersonate an id."""
+
+    def __init__(self, root: str):
+        import os
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, pid: str) -> str:
+        # filename = content hash only: labels may contain any filename
+        # character, so the hash (hex) is the unambiguous disk key
+        import os
+        return os.path.join(self.root, pid.rsplit(":", 1)[1] + ".pkg")
+
+    def install(self, pkg: bytes) -> str:
+        import os
+        pid = package_id(pkg)
+        path = self._path(pid)
+        if not os.path.exists(path):
+            tmp = path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(pkg)
+            os.replace(tmp, path)
+        return pid
+
+    def get(self, pid: str) -> Optional[bytes]:
+        import hashlib
+        import os
+        path = self._path(pid)
+        if not os.path.exists(path):
+            return None
+        with open(path, "rb") as f:
+            pkg = f.read()
+        try:
+            ok = package_id(pkg) == pid
+        except Exception:
+            ok = False
+        if not ok:
+            raise ValueError(f"installed package {pid} corrupted on disk")
+        return pkg
+
+    def installed(self) -> List[str]:
+        import os
+        out = []
+        for fname in sorted(os.listdir(self.root)):
+            if not fname.endswith(".pkg"):
+                continue
+            with open(os.path.join(self.root, fname), "rb") as f:
+                try:
+                    out.append(package_id(f.read()))
+                except Exception:
+                    continue       # unreadable package: skip
+        return sorted(out)
+
+
 class LifecyclePolicyProvider:
     """policy_for(namespace) backed by committed _lifecycle state — the
     validator-side lifecycle cache (lifecycle/cache.go) feeding the plugin

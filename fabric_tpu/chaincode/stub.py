@@ -55,6 +55,7 @@ class ChaincodeStub:
         self._pvt_store = pvt_store  # local PvtDataStore for private reads
         self._pvt_writes: Dict[tuple, Dict[str, object]] = {}
         self._builders: Dict[str, _NsBuilder] = {}
+        self._event: bytes = b""
         self._done = False
 
     def _b(self, ns: Optional[str] = None) -> _NsBuilder:
@@ -118,6 +119,17 @@ class ChaincodeStub:
     # backed by statebased/validator_keylevel.go; parameters are ordinary
     # versioned writes in the companion metadata namespace, so MVCC orders
     # concurrent updates and the policy flips at the block boundary.
+
+    def set_event(self, name: str, payload: bytes) -> None:
+        """Chaincode event (shim SetEvent): at most one per invocation,
+        carried in the endorsed ChaincodeAction and surfaced to event
+        listeners after the tx commits VALID (peer/deliver events)."""
+        from fabric_tpu.utils import serde as _serde
+        self._check_open()
+        self._event = _serde.encode({"name": name, "payload": payload})
+
+    def event_bytes(self) -> bytes:
+        return self._event
 
     def set_state_validation_parameter(self, key: str, policy) -> None:
         self._check_open()

@@ -28,11 +28,13 @@ class BlockCommitResult:
 
 class Committer:
     def __init__(self, ledger: KVLedger, validator: TxValidator,
-                 bundle_source=None, provider=None):
+                 bundle_source=None, provider=None, confighistory=None):
         self.ledger = ledger
         self.validator = validator
         self.bundle_source = bundle_source
         self.provider = provider
+        # height-indexed config log (core/ledger/confighistory/mgr.go)
+        self.confighistory = confighistory
         # wire the duplicate-txid oracle to the block store
         self.validator.ledger_has_txid = ledger.blockstore.has_txid
 
@@ -108,6 +110,9 @@ class Committer:
             try:
                 from fabric_tpu.config import Bundle
                 self.bundle_source.update(Bundle(new_cfg))
+                if self.confighistory is not None:
+                    self.confighistory.record(block.header.number,
+                                              new_cfg.serialize())
             except Exception:
                 # the block is already committed; a config-plane failure
                 # must not make the caller believe the commit failed

@@ -384,8 +384,17 @@ class TxValidator:
 
         t0 = time.perf_counter()
         from fabric_tpu.committer.sbe import SbeOverlay
-        overlay = (SbeOverlay(self.sbe_lookup)
-                   if self.sbe_lookup is not None else None)
+        # key-level endorsement is a CHANNEL CAPABILITY
+        # (common/capabilities/application.go KeyLevelEndorsement): on a
+        # channel whose config lacks it, validation parameters are inert
+        # and every key falls back to the namespace policy — peers that
+        # disagreed on this would produce divergent validity bitmaps.
+        use_sbe = self.sbe_lookup is not None
+        if use_sbe and self.bundle_source is not None:
+            from fabric_tpu.config import CAP_KEY_LEVEL_ENDORSEMENT
+            use_sbe = self.bundle_source.current().has_capability(
+                CAP_KEY_LEVEL_ENDORSEMENT)
+        overlay = SbeOverlay(self.sbe_lookup) if use_sbe else None
         for work in works:
             self._gate_tx(work, flags, verdict, overlay)
         gate_s = time.perf_counter() - t0

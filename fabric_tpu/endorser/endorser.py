@@ -66,11 +66,11 @@ class Endorser:
         status to the client in all failure modes."""
         try:
             prop, creator = self._validate(sp)
-            payload, rwset = self._simulate(prop, creator)
+            payload, rwset, events = self._simulate(prop, creator)
             action = ChaincodeAction(
                 prop.chaincode_id,
                 self._version_of(prop.chaincode_id),
-                rwset, response_payload=payload)
+                rwset, response_payload=payload, events=events)
             ta = TransactionAction(prop.hash(), action)
             endorsed = ta.endorsed_bytes()
             # ESCC: sign endorsed-bytes || endorser identity
@@ -130,7 +130,7 @@ class Endorser:
                                              pvt_sets)
             if self.distribute is not None:
                 self.distribute(txid, pvt_sets)
-        return payload, stub.rwset()
+        return payload, stub.rwset(), stub.event_bytes()
 
     def _version_of(self, chaincode_id: str) -> str:
         d = self.registry.definition(chaincode_id)

@@ -1,16 +1,21 @@
 """Gossip transport layer.
 
 Reference parity: gossip/comm/comm_impl.go — a bidirectional message
-stream between peers with an authenticated connection handshake.  Two
+stream between peers with an authenticated connection handshake.  Three
 transports share one interface:
 
   InProcNetwork: N in-process endpoints with explicit `deliver_all()`
     pumping — how the reference's gossip tests run N instances in one
     process (gossip_test.go), deterministic for fault injection.
-  TcpTransport: length-prefixed serde frames over TCP on localhost/LAN,
-    one listener thread per node — the real-socket path (the reference
-    uses gRPC bidi streams; the framing is ours, the trust model — signed
-    handshake, msg signatures checked above this layer — is the same).
+  SecureGossipTransport: THE production path — gossip casts ride the
+    node's authenticated AEAD channel plane (fabric_tpu/comm: X25519 +
+    signed transcript bound to MSP identities, the slot of the
+    reference's mTLS + signed handshake, comm_impl.go:134-169).  Peers
+    outside the channel MSPs are rejected at handshake; each inbound
+    message carries the handshake-verified sender org.
+  TcpTransport: length-prefixed cleartext TCP frames — DEV/TEST ONLY
+    (message signatures are still checked above this layer, but there is
+    no transport confidentiality or org gating).
 """
 
 from __future__ import annotations
@@ -79,6 +84,97 @@ class InProcEndpoint:
 
     def send(self, to: str, msg_type: str, body: dict) -> None:
         self.net.send(self.id, to, msg_type, body)
+
+
+class SecureGossipTransport:
+    """Gossip endpoint on the authenticated RPC plane.
+
+    Registers a `gossip.msg` cast on the node's RpcServer and sends via
+    cached authenticated connections (dropped and re-dialed on failure —
+    gossip tolerates loss).  peer ids are "host:port" strings of peers'
+    RPC endpoints.  The AEAD channel handshake enforces channel-MSP
+    membership (rogue orgs never reach the handler); the verified sender
+    mspid rides to the handler in body["_from_mspid"] for org-scoped
+    decisions above this layer.
+    """
+
+    DIAL_BACKOFF_S = 5.0
+
+    def __init__(self, rpc_server, signer, msps):
+        self.rpc = rpc_server
+        self.signer = signer
+        self.msps = msps
+        self.id = f"{rpc_server.addr[0]}:{rpc_server.addr[1]}"
+        self._handler: Optional[Handler] = None
+        self._conns: Dict[str, object] = {}
+        self._down_until: Dict[str, float] = {}
+        self._lock = threading.Lock()
+        rpc_server.serve_cast("gossip.msg", self._on_msg)
+
+    def start(self, handler: Handler) -> None:
+        self._handler = handler
+
+    def _on_msg(self, body: dict, peer_identity) -> None:
+        if self._handler is None:
+            return
+        try:
+            msg_type = body["type"]
+            frm = body["frm"]
+            inner = dict(body["body"])
+        except (KeyError, TypeError, ValueError):
+            return    # malformed gossip frame: ignore (peer msgs untrusted)
+        inner["_from_mspid"] = getattr(peer_identity, "mspid", None)
+        try:
+            self._handler(msg_type, frm, inner)
+        except Exception:
+            # a processing bug must be VISIBLE, not mistaken for noise
+            import logging
+            logging.getLogger("fabric_tpu.gossip.comm").exception(
+                "gossip handler failed for %s from %s", msg_type, frm)
+
+    def send(self, to: str, msg_type: str, body: dict) -> None:
+        import time as _time
+        from fabric_tpu.comm.rpc import connect
+        payload = {"type": msg_type, "frm": self.id, "body": body}
+        now = _time.monotonic()
+        with self._lock:
+            conn = self._conns.get(to)
+            if conn is None and now < self._down_until.get(to, 0.0):
+                return    # recent dial failure: skip (gossip tolerates loss)
+        try:
+            if conn is None:
+                host, port = to.rsplit(":", 1)
+                conn = connect((host, int(port)), self.signer, self.msps,
+                               timeout=1.0)
+                with self._lock:
+                    existing = self._conns.get(to)
+                    if existing is not None:
+                        # lost a dial race: keep the first connection
+                        conn.close()
+                        conn = existing
+                    else:
+                        self._conns[to] = conn
+                        self._down_until.pop(to, None)
+            conn.cast("gossip.msg", payload)
+        except Exception:
+            with self._lock:
+                conn = self._conns.pop(to, None)
+                self._down_until[to] = _time.monotonic() + self.DIAL_BACKOFF_S
+            if conn is not None:
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            # dropped: gossip tolerates message loss
+
+    def close(self) -> None:
+        with self._lock:
+            for conn in self._conns.values():
+                try:
+                    conn.close()
+                except Exception:
+                    pass
+            self._conns.clear()
 
 
 class TcpTransport:

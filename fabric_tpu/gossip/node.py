@@ -11,6 +11,7 @@ from __future__ import annotations
 from typing import Optional
 
 from fabric_tpu.gossip.blocksprovider import BlocksProvider
+from fabric_tpu.gossip.certstore import CertStore
 from fabric_tpu.gossip.discovery import (
     Discovery,
     MSG_ALIVE,
@@ -18,6 +19,7 @@ from fabric_tpu.gossip.discovery import (
     MSG_MEMBERSHIP_RESP,
 )
 from fabric_tpu.gossip.election import MSG_LEADERSHIP, LeaderElection
+from fabric_tpu.gossip.pull import PULL_MSGS, PullMediator
 from fabric_tpu.gossip.state import (
     GossipState,
     MSG_BLOCK,
@@ -32,9 +34,10 @@ _STATE_MSGS = {MSG_BLOCK, MSG_STATE_REQ, MSG_STATE_RESP}
 class GossipNode:
     def __init__(self, register, peer_id: str, committer, mcs=None,
                  signer=None, deliver_handler=None, bootstrap=None,
-                 window: int = 32):
+                 window: int = 32, msps=None):
         """`register` is a callable(peer_id, handler) -> endpoint
-        (InProcNetwork.register or a TcpTransport starter)."""
+        (InProcNetwork.register, a TcpTransport starter, or a
+        SecureGossipTransport starter)."""
         self.id = peer_id
         self.endpoint = register(peer_id, self.handle)
         identity = signer.serialize() if signer is not None else b""
@@ -43,6 +46,14 @@ class GossipNode:
         self.state = GossipState(self.endpoint, self.discovery, committer,
                                  mcs=mcs)
         self.election = LeaderElection(self.discovery)
+        # certstore: identities replicate via pull-digest anti-entropy
+        # (gossip/gossip/certstore.go + algo/pull.go)
+        self.certstore = (CertStore(msps, identity)
+                          if msps is not None else None)
+        self.cert_pull: Optional[PullMediator] = None
+        if self.certstore is not None:
+            self.cert_pull = PullMediator(self.endpoint, self.discovery,
+                                          "certs", self.certstore)
         self.provider: Optional[BlocksProvider] = None
         if deliver_handler is not None:
             self.provider = BlocksProvider(
@@ -57,6 +68,8 @@ class GossipNode:
             self.state.handle(msg_type, frm, body)
         elif msg_type == MSG_LEADERSHIP:
             self.election.handle(msg_type, frm, body)
+        elif msg_type in PULL_MSGS and self.cert_pull is not None:
+            self.cert_pull.handle(msg_type, frm, body)
 
     def tick(self) -> None:
         """One gossip period: heartbeat, elect, (leader) pull, anti-entropy."""
@@ -65,6 +78,8 @@ class GossipNode:
         if self.election.is_leader and self.provider is not None:
             self.provider.pull_window()
         self.state.anti_entropy_tick()
+        if self.cert_pull is not None:
+            self.cert_pull.tick()
 
     @property
     def height(self) -> int:

@@ -127,13 +127,24 @@ class Presentation:
     z_sprime: int
     z_hidden: Dict[int, int]          # attr index -> response
     disclosed: Dict[int, int]         # attr index -> attribute value
+    nonrev: Optional[dict] = None     # joint non-revocation proof fields
 
 
 def present(ipk: IssuerPublicKey, cred: Credential,
-            disclose: Sequence[int], nonce: bytes) -> Presentation:
+            disclose: Sequence[int], nonce: bytes,
+            nonrev=None, rh_index: Optional[int] = None) -> Presentation:
     """Randomize (A, e, s) and prove possession, disclosing attrs in
-    `disclose` (indices)."""
+    `disclose` (indices).
+
+    nonrev: optional revocation.NonRevProver — its weak-BB proof shares
+    the hidden rh attribute's Schnorr response through the JOINT
+    Fiat-Shamir challenge, binding "some unrevoked handle" to "THIS
+    credential's handle" (nonrevocation-prover.go).  rh_index selects
+    the handle attribute (must be hidden).
+    """
     D = set(disclose)
+    if nonrev is not None and (rh_index is None or rh_index in D):
+        raise ValueError("non-revocation needs a HIDDEN rh attribute")
     r1 = _rand_zr()
     r2 = _rand_zr()
     r3 = pow(r1, -1, bn.R)
@@ -154,7 +165,10 @@ def present(ipk: IssuerPublicKey, cred: Credential,
         t2 = bn.g1_add(t2, bn.g1_mul((-r) % bn.R, ipk.h[i + 1]))
 
     disclosed = {i: cred.attrs[i] for i in D}
-    c = _hash_zr(A_prime, A_bar, d, t1, t2, nonce,
+    extra = ()
+    if nonrev is not None:
+        extra = nonrev.commit(rm[rh_index])
+    c = _hash_zr(A_prime, A_bar, d, t1, t2, *extra, nonce,
                  repr(sorted(disclosed.items())).encode())
 
     return Presentation(
@@ -165,11 +179,13 @@ def present(ipk: IssuerPublicKey, cred: Credential,
         z_sprime=(rs + c * s_prime) % bn.R,
         z_hidden={i: (rm[i] + c * cred.attrs[i]) % bn.R for i in rm},
         disclosed=disclosed,
+        nonrev=nonrev.respond(c) if nonrev is not None else None,
     )
 
 
 def verify_presentation(ipk: IssuerPublicKey, pres: Presentation,
-                        nonce: bytes) -> bool:
+                        nonce: bytes, epoch_pk=None,
+                        rh_index: Optional[int] = None) -> bool:
     # reject (never crash on) degenerate attacker-supplied points
     if any(p is None for p in (pres.A_prime, pres.A_bar, pres.d)):
         return False
@@ -204,6 +220,23 @@ def verify_presentation(ipk: IssuerPublicKey, pres: Presentation,
 
     if t1 is None or t2 is None:
         return False
-    c = _hash_zr(pres.A_prime, pres.A_bar, pres.d, t1, t2, nonce,
+    # (4) non-revocation (when the channel requires an epoch_pk):
+    # recompute the weak-BB commitment from the shared rh response —
+    # the joint challenge below then binds it to THIS credential
+    extra = ()
+    if epoch_pk is not None:
+        from . import revocation as rev
+        if epoch_pk.alg == rev.ALG_NO_REVOCATION:
+            pass                         # empty revocation set attested
+        else:
+            if (not isinstance(pres.nonrev, dict) or rh_index is None
+                    or rh_index not in pres.z_hidden
+                    or pres.nonrev.get("epoch") != epoch_pk.epoch):
+                return False
+            extra = rev.nonrev_commitment_parts(
+                epoch_pk, pres.nonrev, pres.c, pres.z_hidden[rh_index])
+            if extra is None:
+                return False
+    c = _hash_zr(pres.A_prime, pres.A_bar, pres.d, t1, t2, *extra, nonce,
                  repr(sorted(pres.disclosed.items())).encode())
     return c == pres.c

@@ -156,6 +156,28 @@ class BlockStore:
                 block, _Loc(self._open_segment_no, offset,
                             _LEN.size + len(payload)))
 
+    def truncate(self, new_height: int) -> None:
+        """Drop every block numbered >= new_height (the storage half of
+        ledger rollback, blkstorage ResetBlockStore/rollback).  Rewrites
+        the retained prefix — an administrative operation, not a hot
+        path."""
+        with self._lock:
+            if new_height < 0 or new_height >= self.height:
+                return
+            blocks = [self.get_by_number(i) for i in range(new_height)]
+            self._by_number = []
+            self._mem_blocks = []
+            self._by_hash = {}
+            self._by_txid = {}
+            self._cur_hash = b"\x00" * 32
+            self._prev_hash = b"\x00" * 32
+            self._open_segment_no = 0
+            if self.root is not None:
+                for seg in self._segments():
+                    os.unlink(self._seg_path(seg))
+            for block in blocks:
+                self.add_block(block)
+
     # -- reads --------------------------------------------------------------
 
     @property

@@ -159,6 +159,9 @@ class KVLedger:
         """Commit a block whose metadata txflags were finalized by the
         txvalidator.  MVCC runs here (ValidateAndPrepare), then the
         commit-hash chains, then block store, state, history."""
+        if self.paused:
+            raise RuntimeError(
+                f"channel {self.channel_id!r} is paused (resume() first)")
         if META_TXFLAGS not in block.metadata.items:
             raise ValueError("block metadata missing txflags "
                              "(txvalidator must run first)")
@@ -239,7 +242,47 @@ class KVLedger:
             raise RuntimeError("history DB disabled")
         return self.historydb.get_history(ns, key)
 
-    # -- admin (reset.go / rollback.go / rebuild_dbs.go) --------------------
+    # -- admin (reset.go / rollback.go / pause_resume.go / rebuild_dbs.go) --
+
+    @property
+    def paused(self) -> bool:
+        """pause_resume.go: a paused channel refuses commits until
+        resumed; the flag survives restarts via a marker file."""
+        if self.config.root is None:
+            return getattr(self, "_paused_mem", False)
+        return os.path.exists(os.path.join(self.config.root, "PAUSED"))
+
+    def pause(self) -> None:
+        if self.config.root is None:
+            self._paused_mem = True
+            return
+        with open(os.path.join(self.config.root, "PAUSED"), "w") as f:
+            f.write("paused")
+
+    def resume(self) -> None:
+        if self.config.root is None:
+            self._paused_mem = False
+            return
+        try:
+            os.unlink(os.path.join(self.config.root, "PAUSED"))
+        except FileNotFoundError:
+            pass
+
+    def rollback(self, target_height: int) -> None:
+        """Roll the channel back to `target_height` blocks and rebuild
+        the derived DBs from the retained chain (kvledger/rollback.go —
+        there the peer re-fetches dropped blocks from ordering; here the
+        deliver client does the same on restart)."""
+        if target_height >= self.height:
+            return
+        self.blockstore.truncate(target_height)
+        self.rebuild_dbs()
+
+    def reset(self) -> None:
+        """Reset to the genesis block only (kvledger/reset.go): all state
+        re-derivable, blocks re-fetched from ordering by the deliver
+        client."""
+        self.rollback(1 if self.height else 0)
 
     def rebuild_dbs(self) -> None:
         """Drop state+history and rebuild from the block store."""

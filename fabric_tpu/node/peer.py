@@ -210,6 +210,25 @@ class PeerNode:
         # RPC surface
         self.rpc = RpcServer(cfg.get("host", "127.0.0.1"), int(cfg["port"]),
                              self.signer, self.msps)
+
+        # gossip plane on the authenticated transport: membership,
+        # epidemic block dissemination + ordered drain into the
+        # coordinator, certstore pull, leader election
+        from fabric_tpu.gossip.comm import SecureGossipTransport
+        from fabric_tpu.gossip.mcs import MessageCryptoService
+        from fabric_tpu.gossip.node import GossipNode
+
+        self.mcs = MessageCryptoService(self.msps, self.provider)
+        transport = SecureGossipTransport(self.rpc, self.signer, self.msps)
+
+        def register(peer_id, handler):
+            transport.start(handler)
+            return transport
+
+        bootstrap = [f"{p[0]}:{p[1]}" for p in self.peers]
+        self.gossip = GossipNode(register, transport.id, self.coordinator,
+                                 mcs=self.mcs, signer=self.signer,
+                                 bootstrap=bootstrap, msps=self.msps)
         self.rpc.serve("endorse", self._rpc_endorse)
         self.rpc.serve("status", self._rpc_status)
         self.rpc.serve("qscc.chain_info", self._rpc_chain_info)
@@ -241,8 +260,18 @@ class PeerNode:
         if kind in DEV_CONTRACTS:
             return DEV_CONTRACTS[kind]()
         if kind.startswith("extern:"):
-            from fabric_tpu.chaincode.extcc import ExternalContract
-            return ExternalContract(cc_cfg["name"], kind[len("extern:"):])
+            # production mode: the contract runs as its own OS process
+            # speaking the Register/Invoke stream FSM (chaincode/extcc.py)
+            import shlex
+            from fabric_tpu.chaincode.extcc import (
+                ChaincodeSupport,
+                ExtProcessContract,
+            )
+            if getattr(self, "cc_support", None) is None:
+                self.cc_support = ChaincodeSupport(
+                    f"{self.cfg['data_dir']}/cc")
+            return ExtProcessContract(self.cc_support, cc_cfg["name"],
+                                      shlex.split(kind[len("extern:"):]))
         raise ValueError(f"unknown contract {kind!r}")
 
     def _membership(self):
@@ -395,7 +424,9 @@ class PeerNode:
                                        "verification; dropping window",
                                        block.header.number)
                         break
-                    self.coordinator.store_block(block)
+                    # through the gossip state plane: fans out to peers
+                    # and drains strictly in block order
+                    self.gossip.state.add_block(block)
                     got += 1
                 self._deliver_healthy = True
                 backoff = 0.2
@@ -406,6 +437,10 @@ class PeerNode:
                 logger.debug("deliver pull failed; retrying", exc_info=True)
                 time.sleep(backoff)
                 backoff = min(backoff * 2, 3.0)
+            try:
+                self.gossip.tick()
+            except Exception:
+                logger.exception("gossip tick failed")
             if time.monotonic() >= reconcile_at:
                 try:
                     n = self.coordinator.reconcile()
@@ -428,6 +463,8 @@ class PeerNode:
     def stop(self) -> None:
         self._stop.set()
         self.rpc.stop()
+        if getattr(self, "cc_support", None) is not None:
+            self.cc_support.stop()      # kills external chaincode processes
         if self.ops is not None:
             self.ops.stop()
 

@@ -58,6 +58,18 @@ class Coordinator:
         self.fetch = fetch
         self.missing: List[MissingPvtData] = []
 
+    @property
+    def height(self) -> int:
+        return self.committer.height
+
+    @property
+    def validator(self):
+        return self.committer.validator
+
+    @property
+    def ledger(self):
+        return self.committer.ledger
+
     # -- the StoreBlock composition -----------------------------------------
 
     def store_block(self, block):

@@ -1,0 +1,172 @@
+"""Gossip plane: pull-digest anti-entropy, certstore, secure transport.
+
+Reference behaviors covered (VERDICT.md missing #5 / weak #4):
+  - the four-phase pull exchange (gossip/gossip/algo/pull.go): a peer
+    learns exactly the items it is missing; unsolicited digests and
+    poisoned responses are rejected,
+  - the certstore (gossip/gossip/certstore.go): identities replicate via
+    pull, and identities no channel MSP vouches for are refused,
+  - gossip over the authenticated AEAD channel plane
+    (gossip/comm/comm_impl.go:134-169): messages flow between two real
+    RPC endpoints, the handshake-verified sender org reaches the
+    handler, and a rogue-org peer cannot deliver gossip at all.
+"""
+import time
+
+import pytest
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.gossip.certstore import CertStore, identity_digest
+from fabric_tpu.gossip.comm import InProcNetwork, SecureGossipTransport
+from fabric_tpu.gossip.discovery import Discovery
+from fabric_tpu.gossip.pull import (
+    MSG_PULL_DIGEST,
+    MSG_PULL_RESP,
+    PullMediator,
+    PullStore,
+)
+from fabric_tpu.msp import CachedMSP
+from fabric_tpu.msp.ca import DevOrg
+
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+class DictStore(PullStore):
+    def __init__(self, items=None, reject=frozenset()):
+        self.items = dict(items or {})
+        self.reject = set(reject)
+
+    def digests(self):
+        return sorted(self.items)
+
+    def get(self, item_id):
+        return self.items.get(item_id)
+
+    def add(self, item_id, payload):
+        if item_id in self.reject:
+            return False
+        self.items[item_id] = payload
+        return True
+
+
+def _net_pair(store_a, store_b):
+    from fabric_tpu.gossip.discovery import (
+        MSG_ALIVE, MSG_MEMBERSHIP_REQ, MSG_MEMBERSHIP_RESP)
+    disc_msgs = {MSG_ALIVE, MSG_MEMBERSHIP_REQ, MSG_MEMBERSHIP_RESP}
+    net = InProcNetwork()
+
+    class Node:
+        def __init__(self, pid, store, bootstrap):
+            self.endpoint = net.register(pid, self.handle)
+            self.discovery = Discovery(self.endpoint, bootstrap=bootstrap)
+            self.pull = PullMediator(self.endpoint, self.discovery,
+                                     "k", store)
+
+        def handle(self, msg_type, frm, body):
+            if msg_type in disc_msgs:
+                self.discovery.handle(msg_type, frm, body)
+            else:
+                self.pull.handle(msg_type, frm, body)
+
+    a, b = Node("a", store_a, ["b"]), Node("b", store_b, ["a"])
+    for _ in range(2):        # alive exchange establishes membership
+        a.discovery.tick()
+        b.discovery.tick()
+        net.deliver_all()
+    assert a.discovery.is_alive("b") and b.discovery.is_alive("a")
+    return net, a, b
+
+
+def test_pull_exchange_transfers_missing_items():
+    sa = DictStore({"x": b"1", "y": b"2", "z": b"3"})
+    sb = DictStore({"x": b"1"})
+    net, a, b = _net_pair(sa, sb)
+    b.pull.tick()          # b initiates: hello -> digest -> req -> resp
+    net.deliver_all()
+    assert sb.items == sa.items
+    assert b.pull.stats["items_pulled"] == 2
+    # steady state: nothing further transfers
+    b.pull.tick()
+    net.deliver_all()
+    assert b.pull.stats["items_pulled"] == 2
+
+
+def test_pull_ignores_unsolicited_and_rejected():
+    sa = DictStore({"x": b"1"})
+    sb = DictStore({}, reject={"evil"})
+    net, a, b = _net_pair(sa, sb)
+    # unsolicited digest (no prior hello): must not trigger a request
+    b.pull.handle(MSG_PULL_DIGEST, "a", {"kind": "k", "nonce": 999,
+                                         "digests": ["x"]})
+    net.deliver_all()
+    assert sb.items == {}
+    # a poisoned response item the store rejects stays out
+    b.pull.handle(MSG_PULL_RESP, "a", {"kind": "k", "nonce": 1,
+                                       "items": [["evil", b"payload"]]})
+    assert "evil" not in sb.items
+
+
+def test_certstore_validates_identities(provider):
+    org1, org2 = DevOrg("Org1"), DevOrg("Org2")
+    msps = {"Org1": CachedMSP(org1.msp())}
+    me = org1.new_identity("p1").serialize()
+    store = CertStore(msps, me)
+    assert len(store) == 1
+    # a second Org1 identity replicates fine
+    other = org1.new_identity("p2").serialize()
+    assert store.add(identity_digest(other), other)
+    assert store.lookup(other) == other
+    # an identity from an org outside the channel MSPs is refused
+    rogue = org2.new_identity("evil").serialize()
+    assert not store.add(identity_digest(rogue), rogue)
+    # content must match the claimed digest
+    assert not store.add(identity_digest(other), me)
+    assert len(store) == 2
+
+
+def test_secure_transport_gossip_and_rogue_rejection(provider):
+    from fabric_tpu.comm import RpcServer
+
+    org1, org2, rogue_org = DevOrg("Org1"), DevOrg("Org2"), DevOrg("Evil")
+    msps = {"Org1": CachedMSP(org1.msp()), "Org2": CachedMSP(org2.msp())}
+
+    s1 = RpcServer("127.0.0.1", 0, org1.new_identity("p1"), msps).start()
+    s2 = RpcServer("127.0.0.1", 0, org2.new_identity("p2"), msps).start()
+    try:
+        t1 = SecureGossipTransport(s1, org1.new_identity("p1"), msps)
+        t2 = SecureGossipTransport(s2, org2.new_identity("p2"), msps)
+        got = []
+        t2.start(lambda mt, frm, body: got.append((mt, frm, body)))
+        t1.start(lambda *a: None)
+
+        t1.send(t2.id, "gossip.alive", {"x": 1})
+        deadline = time.time() + 5
+        while not got and time.time() < deadline:
+            time.sleep(0.05)
+        assert got, "gossip message did not arrive over the secure channel"
+        mt, frm, body = got[0]
+        assert mt == "gossip.alive" and frm == t1.id
+        # the handshake-verified org rides along for org-scoped decisions
+        assert body["_from_mspid"] == "Org1"
+        assert body["x"] == 1
+
+        # a rogue org (not in the channel MSPs) cannot deliver gossip:
+        # its handshake is rejected before any handler runs
+        s3 = RpcServer("127.0.0.1", 0, rogue_org.new_identity("e"),
+                       {"Evil": CachedMSP(rogue_org.msp()), **msps}).start()
+        try:
+            t3 = SecureGossipTransport(
+                s3, rogue_org.new_identity("e"),
+                {"Evil": CachedMSP(rogue_org.msp()), **msps})
+            before = len(got)
+            t3.send(t2.id, "gossip.alive", {"x": 2})   # dropped at handshake
+            time.sleep(0.5)
+            assert len(got) == before
+        finally:
+            s3.stop()
+    finally:
+        s1.stop()
+        s2.stop()

@@ -112,3 +112,136 @@ def test_presentation_rejects_off_curve_points(setup):
     # out-of-range coordinates are rejected too
     big = (pres.A_prime[0] + bn.P, pres.A_prime[1])
     assert not verify_presentation(ipk, replace(pres, A_prime=big), b"n")
+
+
+# ---------------------------------------------------------------------------
+# round 3: revocation, the idemix MSP, idemixgen, end-to-end validation
+# ---------------------------------------------------------------------------
+
+def test_revocation_nonrev_proof_and_binding(setup):
+    """Weak-BB non-revocation: an unrevoked holder proves membership for
+    the epoch; a revoked handle gets no new epoch credential; and the
+    proof is BOUND to the credential's own rh (a valid signature on a
+    DIFFERENT handle must not verify)."""
+    from fabric_tpu.idemix import revocation as rev
+    from fabric_tpu.idemix.msp import ATTR_RH, N_ATTRS
+
+    isk = IssuerKey.generate(N_ATTRS)
+    ipk = isk.public()
+    rh = 777123
+    cred = issue(isk, [11, 1, 22, rh])
+
+    ra = rev.RevocationAuthority()
+    epk = ra.epoch_pk(epoch=5)
+    assert rev.verify_epoch_pk(epk, ra.public_key_pem())
+    assert not rev.verify_epoch_pk(epk, rev.RevocationAuthority()
+                                   .public_key_pem())
+    hsig = ra.sign_handle(5, rh)
+
+    nonrev = rev.NonRevProver(epk, hsig, rh)
+    pres = present(ipk, cred, disclose=[0, 1], nonce=b"n",
+                   nonrev=nonrev, rh_index=ATTR_RH)
+    assert verify_presentation(ipk, pres, b"n", epoch_pk=epk,
+                               rh_index=ATTR_RH)
+    # the joint challenge covers the non-revocation commitment, so the
+    # verification context must match: without the epoch the challenge
+    # re-derivation differs and the presentation is (correctly) rejected
+    assert not verify_presentation(ipk, pres, b"n")
+    # and a presentation WITHOUT a proof fails when the epoch demands one
+    plain = present(ipk, cred, disclose=[0, 1], nonce=b"n")
+    assert not verify_presentation(ipk, plain, b"n", epoch_pk=epk,
+                                   rh_index=ATTR_RH)
+
+    # binding: a signature on ANOTHER (unrevoked) handle cannot back
+    # this credential's proof
+    other_sig = ra.sign_handle(5, 999555)
+    cheat = rev.NonRevProver(epk, other_sig, 999555)
+    pres2 = present(ipk, cred, disclose=[0, 1], nonce=b"n",
+                    nonrev=cheat, rh_index=ATTR_RH)
+    assert not verify_presentation(ipk, pres2, b"n", epoch_pk=epk,
+                                   rh_index=ATTR_RH)
+
+    # revocation: the RA refuses the next epoch's credential
+    ra.revoke(rh)
+    with pytest.raises(PermissionError):
+        ra.sign_handle(6, rh)
+    # ALG_NO_REVOCATION epochs accept plain presentations
+    epk0 = ra.epoch_pk(7, alg=rev.ALG_NO_REVOCATION)
+    assert verify_presentation(ipk, plain, b"n", epoch_pk=epk0,
+                               rh_index=ATTR_RH)
+
+
+def test_idemix_msp_end_to_end_tx(tmp_path):
+    """An idemix-signed transaction validates end-to-end through the
+    verify-then-gate pipeline: anonymous creator from an IdemixMSP org,
+    X.509 endorsers, one batched dispatch (idemixmsp.go parity)."""
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    from fabric_tpu.committer import Committer, PolicyRegistry, TxValidator
+    from fabric_tpu.idemix import gen as idemixgen
+    from fabric_tpu.idemix.msp import IdemixMSP
+    from fabric_tpu.ledger import KVLedger
+    from fabric_tpu.msp import CachedMSP
+    from fabric_tpu.msp.ca import DevOrg
+    from fabric_tpu.policy import parse_policy
+    from fabric_tpu.protocol import KVWrite, NsRwSet, TxRwSet, build
+    from fabric_tpu.protocol.txflags import ValidationCode
+
+    provider = init_factories(FactoryOpts(default="SW"))
+    out = idemixgen.generate(str(tmp_path), "IdemixOrg",
+                             ["alice:engineering:member"])
+    alice = idemixgen.load_signer(str(tmp_path / "alice.signer"),
+                                  str(tmp_path / "msp_config.bin"))
+
+    org1 = DevOrg("Org1")
+    msps = {"Org1": CachedMSP(org1.msp()),
+            "IdemixOrg": IdemixMSP(out["config"])}
+    ledger = KVLedger("ch")
+    validator = TxValidator(
+        "ch", msps, provider,
+        PolicyRegistry(parse_policy("OR('Org1.member')")))
+    committer = Committer(ledger, validator)
+
+    rwset = TxRwSet((NsRwSet("cc", writes=(KVWrite("k", b"v"),)),))
+    env = build.endorser_tx("ch", "cc", "1.0", rwset, alice,
+                            [org1.new_identity("e1")])
+    block = build.new_block(0, b"\x00" * 32, [env])
+    res = committer.store_block(block)
+    assert [int(c) for c in res.final_flags.codes()] == [ValidationCode.VALID]
+    assert ledger.get_state("cc", "k") == b"v"
+
+    # unlinkability across txs: two signatures by the same signer share
+    # no common bytes beyond the (mspid, ou, role) claim
+    env2 = build.endorser_tx("ch", "cc", "1.0", rwset, alice,
+                             [org1.new_identity("e1")])
+    assert env.signature != env2.signature
+
+    # a tampered role claim (member credential claiming admin) fails
+    from fabric_tpu.utils import serde as _serde
+    ident = _serde.decode(alice.serialize())
+    ident["role"] = 2
+    forged = type(env)(payload=env.payload, signature=env.signature)
+    # splice the forged creator into the payload
+    pd = _serde.decode(env.payload)
+    pd["header"]["signature_header"]["creator"] = _serde.encode(ident)
+    import dataclasses
+    # txid binding breaks too, so recompute what the validator checks first:
+    # simply assert the signature-level binding directly
+    from fabric_tpu.idemix.msp import verify_item_host
+    from fabric_tpu.msp import deserialize_from_msps
+    forged_ident = deserialize_from_msps(msps, _serde.encode(ident))
+    item = forged_ident.verify_item(env.payload, env.signature)
+    assert not verify_item_host(item)
+
+
+def test_idemixgen_files_roundtrip(tmp_path):
+    from fabric_tpu.idemix import gen as idemixgen
+    rc = idemixgen.main([str(tmp_path), "--mspid", "X",
+                         "--user", "u1:ou1:member",
+                         "--user", "boss:hq:admin"])
+    assert rc == 0
+    signer = idemixgen.load_signer(str(tmp_path / "boss.signer"),
+                                   str(tmp_path / "msp_config.bin"))
+    assert signer.role == 2 and signer.ou == "hq"
+    sig = signer.sign(b"payload")
+    assert signer.verify(b"payload", sig)
+    assert not signer.verify(b"other", sig)

@@ -232,3 +232,55 @@ def test_blockstore_in_memory_mode(org):
     assert bs.get_by_number(0).header == b0.header
     assert bs.get_by_hash(b0.hash()).header.number == 0
     assert bs.has_txid(envs[0].header().channel_header.txid)
+
+
+def test_ledger_admin_rollback_reset_pause(tmp_path, org):
+    """kvledger admin surface: rollback to a prior height self-heals the
+    derived DBs; reset keeps only genesis; a paused channel refuses
+    commits until resumed (reset/rollback/pause_resume.go)."""
+    cfg = LedgerConfig(root=str(tmp_path))
+    lg = KVLedger("ch", cfg)
+    for i in range(4):
+        lg.commit(ledger_block(
+            lg, org, [rw(writes=[KVWrite(f"k{i}", b"v%d" % i)])]))
+    assert lg.height == 4 and lg.get_state("cc", "k3") == b"v3"
+
+    lg.rollback(2)
+    assert lg.height == 2
+    assert lg.get_state("cc", "k1") == b"v1"
+    assert lg.get_state("cc", "k3") is None       # rolled back
+    # the chain continues from the rollback point
+    lg.commit(ledger_block(lg, org, [rw(writes=[KVWrite("k9", b"v9")])]))
+    assert lg.height == 3 and lg.get_state("cc", "k9") == b"v9"
+
+    lg.pause()
+    blk = ledger_block(lg, org, [rw(writes=[KVWrite("kA", b"vA")])])
+    with pytest.raises(RuntimeError, match="paused"):
+        lg.commit(blk)
+    # the pause marker survives reopen
+    assert KVLedger("ch", cfg).paused
+    lg.resume()
+    lg.commit(blk)
+    assert lg.get_state("cc", "kA") == b"vA"
+
+    lg.reset()
+    assert lg.height == 1                         # genesis only
+    assert lg.get_state("cc", "kA") is None
+
+
+def test_confighistory_heights(tmp_path):
+    from fabric_tpu.ledger.confighistory import ConfigHistory
+    ch = ConfigHistory(str(tmp_path))
+    assert ch.config_at(5) is None
+    ch.record(2, b"cfg-seq1")
+    ch.record(7, b"cfg-seq2")
+    ch.record(7, b"replayed")                     # idempotent on replay
+    assert ch.config_at(1) is None
+    assert ch.config_at(2) == b"cfg-seq1"
+    assert ch.config_at(6) == b"cfg-seq1"
+    assert ch.config_at(7) == b"cfg-seq2"
+    assert ch.config_at(99) == b"cfg-seq2"
+    # durable across reopen
+    ch2 = ConfigHistory(str(tmp_path))
+    assert ch2.config_at(99) == b"cfg-seq2"
+    assert len(ch2.entries()) == 2

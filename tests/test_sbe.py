@@ -127,3 +127,51 @@ def test_delete_falls_back_to_cc_policy(world):
     assert codes(r) == [ValidationCode.VALID]
     r = commit(committer, [tx(o1, e1, writes=[("k3", b"y")])])
     assert codes(r) == [ValidationCode.VALID]
+
+
+def test_sbe_gated_by_channel_capability(provider):
+    """A channel whose config lacks V1_3_KeyLevelEndorsement skips SBE
+    deterministically: validation parameters become inert and keys fall
+    back to the namespace policy (common/capabilities/application.go)."""
+    from fabric_tpu.config import (
+        Bundle, BundleSource, CAP_V2_0, ChannelConfig, OrgConfig,
+        default_policies)
+
+    o1, o2 = DevOrg("Org1"), DevOrg("Org2")
+
+    def make_world(caps):
+        orgs = []
+        for o in (o1, o2):
+            mc = o.msp_config()
+            orgs.append(OrgConfig(mspid=o.mspid,
+                                  root_certs=tuple(mc.root_certs_pem),
+                                  admins=tuple(mc.admin_certs_pem)))
+        cfg = ChannelConfig(channel_id="ch", sequence=0, orgs=tuple(orgs),
+                            policies=default_policies(["Org1", "Org2"]),
+                            capabilities=caps)
+        src = BundleSource(Bundle(cfg))
+        ledger = KVLedger("ch")
+        validator = TxValidator(
+            "ch", None, provider,
+            PolicyRegistry(parse_policy("OR('Org1.member')")),
+            bundle_source=src,
+            sbe_lookup=sbe.statedb_lookup(ledger.statedb))
+        return Committer(ledger, validator, bundle_source=src,
+                         provider=provider)
+
+    both = parse_policy("AND('Org1.member','Org2.member')")
+    e1 = [o1.new_identity("e1")]
+
+    # capability ON: the round-trip from test_key_policy_overrides
+    com = make_world((CAP_V2_0, "V1_3_KeyLevelEndorsement"))
+    r = commit(com, [tx(o1, e1, writes=[("k", b"v")], sbe_set=[("k", both)])])
+    assert codes(r) == [ValidationCode.VALID]
+    r = commit(com, [tx(o1, e1, writes=[("k", b"v1")])])
+    assert codes(r) == [ValidationCode.ENDORSEMENT_POLICY_FAILURE]
+
+    # capability OFF: the same sequence passes — the key policy is inert
+    com = make_world((CAP_V2_0,))
+    r = commit(com, [tx(o1, e1, writes=[("k", b"v")], sbe_set=[("k", both)])])
+    assert codes(r) == [ValidationCode.VALID]
+    r = commit(com, [tx(o1, e1, writes=[("k", b"v1")])])
+    assert codes(r) == [ValidationCode.VALID]
