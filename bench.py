@@ -212,7 +212,9 @@ def main():
     cpu_rate_1 = bench_cpu_openssl(cpu_sigs, procs=1)
     cpu_rate_all = bench_cpu_openssl(cpu_sigs, seconds=1.0, procs=ncpu)
 
-    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    from fabric_tpu.bccsp.factory import (FactoryOpts, enable_compile_cache,
+                                          init_factories)
+    enable_compile_cache()
     provider = init_factories(FactoryOpts(default="JAXTPU"))
 
     detail = {

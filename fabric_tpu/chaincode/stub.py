@@ -105,6 +105,16 @@ class ChaincodeStub:
             start_key, end_key, exhausted, tuple(reads)))
         return results
 
+    def get_query_result(self, selector: dict, limit: int = 0):
+        """Rich query over committed JSON-document state (shim
+        GetQueryResult; statecouchdb option).  Reads committed state only
+        and stages NO read-set entries — rich-query results are not
+        MVCC-protected, exactly like the reference."""
+        self._check_open()
+        return [(k, vv.value)
+                for k, vv in self._db.execute_query(self._ns, selector,
+                                                    limit)]
+
     def invoke_chaincode(self, chaincode_id: str, fn: str,
                          args: List[bytes]) -> bytes:
         """cc2cc invocation: the callee simulates into THIS rwset under its

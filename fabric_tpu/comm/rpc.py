@@ -15,6 +15,7 @@ Responses may be streamed: {"kind": "stream", "id": n, "body": dict,
 from __future__ import annotations
 
 import logging
+import time as _time
 import threading
 from typing import Callable, Dict, Optional
 
@@ -204,6 +205,8 @@ class RpcServer:
         rid = msg["id"]
         method = msg["method"]
         body = msg.get("body", {})
+        t0 = _time.perf_counter()
+        ok = True
         try:
             if method in self._stream:
                 key = (id(ch), rid)
@@ -223,11 +226,30 @@ class RpcServer:
             ch.send(serde.encode({"kind": "resp", "id": rid, "ok": True,
                                   "body": out or {}}))
         except Exception as exc:
+            ok = False
             try:
                 ch.send(serde.encode({"kind": "resp", "id": rid, "ok": False,
                                       "error": str(exc)[:500]}))
             except Exception:
                 pass
+        finally:
+            _observe_rpc(method, ok, _time.perf_counter() - t0)
+
+
+def _observe_rpc(method: str, ok: bool, seconds: float) -> None:
+    """RPC interceptor metrics (the reference's grpcmetrics unary/stream
+    interceptors, common/grpcmetrics/interceptor.go): per-method request
+    counts by outcome + duration histograms into the ops-plane registry."""
+    try:
+        from fabric_tpu.ops_plane import registry
+        registry.counter(
+            "rpc_requests_total", "RPC requests served").add(
+                1, method=method, code="OK" if ok else "ERROR")
+        registry.histogram(
+            "rpc_request_duration_seconds",
+            "RPC handler wall time").observe(seconds, method=method)
+    except Exception:
+        pass      # metrics must never break the request path
 
 
 def connect(addr, signer, msps: Dict, timeout: float = 10.0) -> RpcConnection:

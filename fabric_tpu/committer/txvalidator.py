@@ -102,12 +102,18 @@ class TxValidator:
     def __init__(self, channel_id: str, msps: Dict[str, object], provider,
                  policies: PolicyRegistry,
                  ledger_has_txid=None, bundle_source=None,
-                 sbe_lookup=None):
+                 sbe_lookup=None,
+                 validation_plugin: str = "DefaultValidation"):
         self.channel_id = channel_id
         self._static_msps = msps
         self.provider = provider
         self.policies = policies
         self.bundle_source = bundle_source
+        # pluggable commit-time decision (handlers/library/registry.go;
+        # the builtin is the v20 policy gate)
+        from fabric_tpu.handlers import default_registry
+        self.validation_plugin = default_registry.validation(
+            validation_plugin)
         # key-level endorsement: committed validation-parameter lookup
         # ((ns, key) -> policy bytes), usually sbe.statedb_lookup(statedb)
         self.sbe_lookup = sbe_lookup
@@ -298,17 +304,20 @@ class TxValidator:
                     if kpol is None:
                         need_ns_policy = True
                         continue
-                    if not evaluator.evaluate(kpol, list(valid_idents)):
+                    if not self.validation_plugin(kpol, valid_idents,
+                                                  evaluator):
                         flags.set(work.tx_num,
                                   ValidationCode.ENDORSEMENT_POLICY_FAILURE)
                         return
                 for key in meta_keys:
                     kpol = sbe_overlay.policy_for(ns, key) or pol
-                    if not evaluator.evaluate(kpol, list(valid_idents)):
+                    if not self.validation_plugin(kpol, valid_idents,
+                                                  evaluator):
                         flags.set(work.tx_num,
                                   ValidationCode.ENDORSEMENT_POLICY_FAILURE)
                         return
-            if need_ns_policy and not evaluator.evaluate(pol, valid_idents):
+            if need_ns_policy and not self.validation_plugin(
+                    pol, valid_idents, evaluator):
                 flags.set(work.tx_num, ValidationCode.ENDORSEMENT_POLICY_FAILURE)
                 return
         flags.set(work.tx_num, ValidationCode.VALID)

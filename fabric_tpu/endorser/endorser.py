@@ -25,8 +25,9 @@ from fabric_tpu.endorser.proposal import (
 from fabric_tpu.ledger.statedb import StateDB
 from fabric_tpu.msp import SigningIdentity, deserialize_from_msps
 from fabric_tpu.policy import PolicyEvaluator, SignaturePolicy, SignedData
-from fabric_tpu.protocol.build import compute_txid, endorse
-from fabric_tpu.protocol.types import ChaincodeAction, TransactionAction
+from fabric_tpu.protocol.build import compute_txid
+from fabric_tpu.protocol.types import (ChaincodeAction, Endorsement,
+                                       TransactionAction)
 
 logger = logging.getLogger("fabric_tpu.endorser")
 
@@ -44,7 +45,9 @@ class Endorser:
                  signer: SigningIdentity,
                  proposal_acl: Optional[SignaturePolicy] = None,
                  transient_store=None, pvt_store=None, distribute=None,
-                 ledger_height=None):
+                 ledger_height=None,
+                 endorsement_plugin: str = "DefaultEndorsement",
+                 auth_filters=("ExpirationCheck",)):
         self.channel_id = channel_id
         self.db = db
         self.registry = registry
@@ -52,6 +55,12 @@ class Endorser:
         self.signer = signer
         self.proposal_acl = proposal_acl
         self.evaluator = PolicyEvaluator(msps, provider)
+        # pluggable handlers (core/handlers/library/registry.go): named
+        # auth filters run before simulation; the endorsement plugin
+        # signs the response (ESCC slot)
+        from fabric_tpu.handlers import default_registry as _handlers
+        self.endorsement_plugin = _handlers.endorsement(endorsement_plugin)
+        self.auth_filters = [_handlers.auth_filter(n) for n in auth_filters]
         # private-data plane (gossip/privdata distribution at endorsement):
         # cleartext write-sets are staged in the transient store and pushed
         # to collection member peers; only hashes enter the public rwset.
@@ -73,9 +82,12 @@ class Endorser:
                 rwset, response_payload=payload, events=events)
             ta = TransactionAction(prop.hash(), action)
             endorsed = ta.endorsed_bytes()
-            # ESCC: sign endorsed-bytes || endorser identity
-            e = endorse(ta, self.signer)
-            return ProposalResponse(200, "", endorsed, e)
+            # ESCC slot: the endorsement plugin signs
+            # endorsed-bytes || endorser identity
+            endorser_bytes, sig = self.endorsement_plugin(self.signer,
+                                                          endorsed)
+            return ProposalResponse(200, "", endorsed,
+                                    Endorsement(endorser_bytes, sig))
         except (EndorserError, SimulationError) as err:
             logger.info("[%s] proposal rejected: %s", self.channel_id, err)
             return ProposalResponse(500, str(err), b"", None)
@@ -106,6 +118,11 @@ class Endorser:
             raise EndorserError("unknown or invalid creator identity")
         if not creator.verify(sp.proposal_bytes, sp.signature):
             raise EndorserError("bad proposal signature")
+        for flt in self.auth_filters:       # core/handlers/auth chain
+            try:
+                flt(prop, creator)
+            except Exception as e:
+                raise EndorserError(f"auth filter rejected: {e}") from e
         if self.proposal_acl is not None:
             sd = SignedData(sp.proposal_bytes, sh.creator, sp.signature)
             if not self.evaluator.evaluate_signed_data(self.proposal_acl, [sd]):

@@ -1,0 +1,29 @@
+"""Pluggable handler framework: named auth / endorsement / validation
+plugins.
+
+Reference parity: core/handlers/library/registry.go — the peer config
+names which handler implements each pluggable role (auth filters run
+before endorsement, an endorsement plugin signs proposal responses, a
+validation plugin judges txs at commit); custom Go plugins load by name
+from a registry (`.so` loading stays out of scope — an in-process
+registry was the explicit round-1 design decision, SURVEY.md §2.1.3).
+
+Built-ins mirror the reference's defaults:
+  auth:        "ExpirationCheck"  (reject expired creator certs)
+  endorsement: "DefaultEndorsement" (ESCC: sign payload || endorser)
+  validation:  "DefaultValidation"  (policy evaluation over the
+               verified endorsement set — the verify-then-gate pass 2)
+"""
+
+from .registry import (
+    HandlerRegistry,
+    default_registry,
+    register_auth_filter,
+    register_endorsement,
+    register_validation,
+)
+
+__all__ = [
+    "HandlerRegistry", "default_registry", "register_auth_filter",
+    "register_endorsement", "register_validation",
+]

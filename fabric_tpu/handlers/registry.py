@@ -1,0 +1,104 @@
+"""The handler registry + built-in implementations.
+
+Plugin contracts (duck-typed):
+  auth filter     fn(proposal, creator_identity) -> None | raise
+  endorsement     fn(signing_identity, payload: bytes) -> (endorser_bytes,
+                  signature)  — ESCC's Endorse
+                  (default_endorsement.go:36 signs payload || endorser)
+  validation      fn(policy, valid_identities, evaluator) -> bool — the
+                  per-namespace commit-time decision consuming the
+                  verified identity set (validation_logic.go:185)
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict
+
+
+class HandlerRegistry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._auth: Dict[str, Callable] = {}
+        self._endorsement: Dict[str, Callable] = {}
+        self._validation: Dict[str, Callable] = {}
+
+    # -- registration (registry.go) ------------------------------------------
+
+    def register_auth_filter(self, name: str, fn: Callable) -> None:
+        with self._lock:
+            self._auth[name] = fn
+
+    def register_endorsement(self, name: str, fn: Callable) -> None:
+        with self._lock:
+            self._endorsement[name] = fn
+
+    def register_validation(self, name: str, fn: Callable) -> None:
+        with self._lock:
+            self._validation[name] = fn
+
+    # -- lookup --------------------------------------------------------------
+
+    def auth_filter(self, name: str) -> Callable:
+        with self._lock:
+            if name not in self._auth:
+                raise KeyError(f"unknown auth filter {name!r}")
+            return self._auth[name]
+
+    def endorsement(self, name: str) -> Callable:
+        with self._lock:
+            if name not in self._endorsement:
+                raise KeyError(f"unknown endorsement plugin {name!r}")
+            return self._endorsement[name]
+
+    def validation(self, name: str) -> Callable:
+        with self._lock:
+            if name not in self._validation:
+                raise KeyError(f"unknown validation plugin {name!r}")
+            return self._validation[name]
+
+
+# -- built-ins ---------------------------------------------------------------
+
+def _expiration_check(proposal, creator_identity) -> None:
+    """auth/filter.expiration: reject proposals from expired certs."""
+    import datetime
+    exp = getattr(creator_identity, "expires_at", None)
+    if exp is None:
+        return
+    if callable(exp):
+        exp = exp()
+    now = datetime.datetime.now(datetime.timezone.utc)
+    if exp < now:
+        raise PermissionError("creator certificate expired")
+
+
+def _default_endorsement(signing_identity, payload: bytes):
+    """ESCC (default_endorsement.go:36): sign payload || endorser."""
+    endorser = signing_identity.serialize()
+    return endorser, signing_identity.sign(payload + endorser)
+
+
+def _default_validation(policy, valid_identities, evaluator) -> bool:
+    """Builtin v20 policy gate over the VERIFIED endorsement set."""
+    return evaluator.evaluate(policy, list(valid_identities))
+
+
+default_registry = HandlerRegistry()
+default_registry.register_auth_filter("ExpirationCheck", _expiration_check)
+default_registry.register_endorsement("DefaultEndorsement",
+                                      _default_endorsement)
+default_registry.register_validation("DefaultValidation",
+                                     _default_validation)
+
+
+def register_auth_filter(name: str, fn: Callable) -> None:
+    default_registry.register_auth_filter(name, fn)
+
+
+def register_endorsement(name: str, fn: Callable) -> None:
+    default_registry.register_endorsement(name, fn)
+
+
+def register_validation(name: str, fn: Callable) -> None:
+    default_registry.register_validation(name, fn)

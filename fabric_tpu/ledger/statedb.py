@@ -62,6 +62,43 @@ class UpdateBatch:
         return len(self._updates)
 
 
+def _match_selector(doc: dict, selector: dict) -> bool:
+    """Mango-selector subset evaluation (implicit AND across fields)."""
+    for field_name, cond in selector.items():
+        if field_name == "$or":
+            if not any(_match_selector(doc, alt) for alt in cond):
+                return False
+            continue
+        if field_name == "$and":
+            if not all(_match_selector(doc, alt) for alt in cond):
+                return False
+            continue
+        have = doc.get(field_name)
+        if isinstance(cond, dict):
+            for op, want in cond.items():
+                try:
+                    if op == "$gt" and not have > want:
+                        return False
+                    elif op == "$gte" and not have >= want:
+                        return False
+                    elif op == "$lt" and not have < want:
+                        return False
+                    elif op == "$lte" and not have <= want:
+                        return False
+                    elif op == "$ne" and not have != want:
+                        return False
+                    elif op == "$eq" and not have == want:
+                        return False
+                    elif op == "$in" and have not in want:
+                        return False
+                except TypeError:
+                    return False      # cross-type comparison: no match
+        else:
+            if have != cond:
+                return False
+    return True
+
+
 class StateDB:
     """Versioned state store (VersionedDB iface, statedb.go)."""
 
@@ -100,6 +137,38 @@ class StateDB:
                 if kns != ns or (end_key and key >= end_key):
                     break
                 out.append((key, self._data[(kns, key)]))
+                if limit and len(out) >= limit:
+                    break
+        return iter(out)
+
+    def execute_query(self, ns: str, selector: dict, limit: int = 0):
+        """Rich query over JSON-document values (the statecouchdb option,
+        core/ledger/.../statedb/statecouchdb/statecouchdb.go — Mango
+        selector subset: field equality, $gt/$gte/$lt/$lte/$ne/$in, with
+        implicit AND across fields and $or for alternatives).
+
+        Values that do not parse as JSON objects simply never match —
+        byte-valued keys coexist with document-valued keys, exactly like
+        a CouchDB-backed channel with mixed chaincodes.
+
+        NOTE: like the reference's rich queries, results are NOT
+        re-checked by MVCC phantom protection — rich queries are for
+        reads/audit, not for range-protected simulation.
+        """
+        import json as _json
+        out = []
+        with self._lock:
+            items = sorted((k[1], vv) for k, vv in self._data.items()
+                           if k[0] == ns)
+        for key, vv in items:
+            try:
+                doc = _json.loads(vv.value.decode("utf-8"))
+            except (ValueError, UnicodeDecodeError, AttributeError):
+                continue
+            if not isinstance(doc, dict):
+                continue
+            if _match_selector(doc, selector):
+                out.append((key, vv))
                 if limit and len(out) >= limit:
                     break
         return iter(out)

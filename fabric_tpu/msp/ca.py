@@ -72,7 +72,8 @@ class CA:
         return self.cert.public_bytes(serialization.Encoding.PEM)
 
     def issue(self, common_name: str, scheme: Optional[str] = None,
-              org_units: Tuple[str, ...] = (), ca: bool = False):
+              org_units: Tuple[str, ...] = (), ca: bool = False,
+              not_after=None):
         """Issue an end-entity (or intermediate-CA) cert.
 
         Returns (cert, private_key_object)."""
@@ -88,7 +89,7 @@ class CA:
                    .public_key(key.public_key())
                    .serial_number(x509.random_serial_number())
                    .not_valid_before(now - datetime.timedelta(minutes=5))
-                   .not_valid_after(now + VALIDITY)
+                   .not_valid_after(not_after or (now + VALIDITY))
                    .add_extension(x509.BasicConstraints(ca=ca, path_length=None),
                                   critical=True))
         cert = builder.sign(self._key, _sign_alg(self._key))
@@ -141,6 +142,9 @@ class DevOrg:
     def msp(self, crls_pem: Optional[List[bytes]] = None) -> MSP:
         return MSP(self.msp_config(crls_pem))
 
-    def new_identity(self, name: str, org_units: Tuple[str, ...] = ()) -> SigningIdentity:
-        cert, key = self.issuer.issue(name + "@" + self.mspid, org_units=org_units)
+    def new_identity(self, name: str, org_units: Tuple[str, ...] = (),
+                     not_after=None) -> SigningIdentity:
+        cert, key = self.issuer.issue(name + "@" + self.mspid,
+                                      org_units=org_units,
+                                      not_after=not_after)
         return SigningIdentity(self.mspid, cert, SigningKey(self.scheme, key))

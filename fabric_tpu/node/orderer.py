@@ -67,30 +67,12 @@ class OrdererNode:
             bytes.fromhex(cfg["channel_config_hex"]))
         self.bundle_source = BundleSource(Bundle(channel_cfg))
         msps = self.bundle_source.current().msps
+        self.data_dir = data_dir
 
         self.registrar = Registrar()
         self.raft_id = int(cfg["raft_id"])
-        peer_ids = [int(p["raft_id"]) for p in cfg["cluster"]]
-        node = RaftNode(self.raft_id, peer_ids,
-                        wal_path=f"{data_dir}/wal.bin",
-                        snap_path=f"{data_dir}/snap.bin")
-        batch = channel_cfg.batch
-        self.support = self.registrar.create_channel(
-            channel_cfg.channel_id, msps, self.provider,
-            writers_policy=None,
-            signer=self.signer,
-            batch_config=BatchConfig(
-                max_message_count=batch.max_message_count,
-                absolute_max_bytes=batch.absolute_max_bytes,
-                preferred_max_bytes=batch.preferred_max_bytes,
-                batch_timeout_s=batch.timeout_s),
-            ledger=BlockStore(f"{data_dir}/ledger"),
-            chain_factory=lambda cutter, writer, on_block: RaftChain(
-                node, cutter, writer, on_block=on_block),
-            bundle_source=self.bundle_source)
+        self.peer_ids = [int(p["raft_id"]) for p in cfg["cluster"]]
 
-        self.broadcast = BroadcastHandler(self.registrar)
-        self.deliver = DeliverHandler(self.registrar)
         self.rpc = RpcServer(cfg.get("host", "127.0.0.1"), int(cfg["port"]),
                              self.signer, msps)
         peers = {int(p["raft_id"]): (p.get("host", "127.0.0.1"), int(p["port"]))
@@ -106,14 +88,50 @@ class OrdererNode:
                     "bound to certificate fingerprints (re-provision the "
                     "network; CN-based configs are no longer accepted)")
             consenters[int(p["raft_id"])] = (p["mspid"], p["cert_fp"])
-        self.cluster = ClusterService(self.support.chain, self.rpc,
-                                      self.signer, msps, peers,
+        self.cluster = ClusterService(self.rpc, self.signer, msps, peers,
                                       consenters=consenters)
+
+        # refuse to silently strand pre-multichannel node state (storage
+        # moved from data_dir/wal.bin to data_dir/<channel>/wal.bin)
+        import os as _os
+        if _os.path.exists(_os.path.join(data_dir, "wal.bin")):
+            raise ValueError(
+                f"{data_dir} holds single-channel-era state (wal.bin at "
+                "the data-dir root); move it into "
+                f"{data_dir}/{channel_cfg.channel_id}/ or re-provision")
+
+        # bootstrap channel (the registrar manages N chains; more join at
+        # runtime via the participation API — registrar.go dynamic chains)
+        self.support = self._create_channel(channel_cfg,
+                                            self.bundle_source)
+
+        # re-load channels joined at runtime in earlier lives of this
+        # node: a restart must not silently drop them from the cluster
+        for entry in sorted(_os.listdir(data_dir)):
+            cfg_path = _os.path.join(data_dir, entry, "channel_config.bin")
+            if entry == channel_cfg.channel_id or not _os.path.exists(
+                    cfg_path):
+                continue
+            try:
+                with open(cfg_path, "rb") as f:
+                    joined_cfg = ChannelConfig.deserialize(f.read())
+                self._create_channel(joined_cfg,
+                                     BundleSource(Bundle(joined_cfg)))
+                logger.info("restored joined channel %r", entry)
+            except Exception:
+                logger.exception("could not restore channel %r", entry)
+
+        self.broadcast = BroadcastHandler(self.registrar)
+        self.deliver = DeliverHandler(self.registrar)
         self.rpc.serve("broadcast", self._rpc_broadcast)
         self.rpc.serve("status", self._rpc_status)
         self.rpc.serve_stream("deliver", self._rpc_deliver)
+        self.rpc.serve("participation.join", self._rpc_join)
+        self.rpc.serve("participation.list", self._rpc_list)
+        self.rpc.serve("participation.remove", self._rpc_remove)
 
-        # ops plane: /metrics, /healthz (system.go:75-267 parity)
+        # ops plane: /metrics, /healthz (system.go:75-267 parity) + the
+        # channelparticipation REST API (channelparticipation/restapi.go)
         self.ops = None
         if cfg.get("ops_port") is not None:
             from fabric_tpu.ops_plane import OperationsServer
@@ -121,8 +139,144 @@ class OrdererNode:
                                         int(cfg["ops_port"]))
             self.ops.register_checker(
                 "raft", lambda: self.support.chain.node.leader_id is not None)
+            self.ops.register_route("GET", "/participation/v1/channels",
+                                    self._rest_channels)
+            # the ops server is PLAIN HTTP with no client auth, so the
+            # MUTATING participation routes are opt-in (dev/ops networks
+            # behind a trusted boundary); the authenticated RPC verbs
+            # (admin-gated) are the production surface
+            if cfg.get("participation_rest_writes"):
+                self.ops.register_route("POST",
+                                        "/participation/v1/channels",
+                                        self._rest_join)
+                self.ops.register_route("DELETE",
+                                        "/participation/v1/channels/",
+                                        self._rest_remove)
+
+    # -- channelparticipation REST (restapi.go) ------------------------------
+
+    def _rest_channels(self, path: str, body: bytes):
+        parts = path.rstrip("/").split("/")
+        if parts[-1] != "channels":          # /channels/<id>
+            cid = parts[-1]
+            support = self.registrar.get(cid)
+            if support is None:
+                return 404, {"error": f"no such channel {cid!r}"}
+            return 200, {"name": cid, "height": support.ledger.height,
+                         "consensus": "raft"}
+        return 200, {"channels": [
+            {"name": cid, "height": s.ledger.height}
+            for cid, s in sorted(self.registrar.channels().items())],
+            "systemChannel": None}
+
+    def _rest_join(self, path: str, body: bytes):
+        import json as _json
+        if path.rstrip("/").split("/")[-1] != "channels":
+            return 404, {"error": "POST only on .../channels"}
+        cfg_hex = _json.loads(body)["config_hex"]
+        cfg = ChannelConfig.deserialize(bytes.fromhex(cfg_hex))
+        if self.registrar.get(cfg.channel_id) is not None:
+            return 409, {"error": f"channel {cfg.channel_id!r} exists"}
+        self.join_channel(cfg)
+        return 201, {"name": cfg.channel_id, "status": "joined"}
+
+    def _rest_remove(self, path: str, body: bytes):
+        cid = path.rstrip("/").split("/")[-1]
+        support = self.registrar.get(cid)
+        if support is None:
+            return 404, {"error": f"no such channel {cid!r}"}
+        self.cluster.remove_chain(cid)
+        support.chain.halt()
+        self.registrar.remove(cid)
+        return 200, {"name": cid, "status": "removed"}
+
+    # -- channel lifecycle ---------------------------------------------------
+
+    def _create_channel(self, channel_cfg: ChannelConfig, bundle_source):
+        """One channel's chain: per-channel data dirs + raft instance,
+        registered with the shared cluster transport.  The channel config
+        is persisted alongside so runtime-joined channels survive
+        restarts (participation state, registrar.go)."""
+        import os
+        cid = channel_cfg.channel_id
+        ch_dir = os.path.join(self.data_dir, cid)
+        os.makedirs(ch_dir, exist_ok=True)
+        cfg_path = os.path.join(ch_dir, "channel_config.bin")
+        if not os.path.exists(cfg_path):
+            tmp = cfg_path + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(channel_cfg.serialize())
+            os.replace(tmp, cfg_path)
+        node = RaftNode(self.raft_id, self.peer_ids,
+                        wal_path=os.path.join(ch_dir, "wal.bin"),
+                        snap_path=os.path.join(ch_dir, "snap.bin"))
+        batch = channel_cfg.batch
+        support = self.registrar.create_channel(
+            cid, bundle_source.current().msps, self.provider,
+            writers_policy=None,
+            signer=self.signer,
+            batch_config=BatchConfig(
+                max_message_count=batch.max_message_count,
+                absolute_max_bytes=batch.absolute_max_bytes,
+                preferred_max_bytes=batch.preferred_max_bytes,
+                batch_timeout_s=batch.timeout_s),
+            ledger=BlockStore(os.path.join(ch_dir, "ledger")),
+            chain_factory=lambda cutter, writer, on_block: RaftChain(
+                node, cutter, writer, on_block=on_block),
+            bundle_source=bundle_source)
+        self.cluster.add_chain(cid, support.chain)
+        return support
+
+    def join_channel(self, channel_cfg: ChannelConfig):
+        """Runtime channel join (channelparticipation Join): a NEW raft
+        instance + ledger under this process's registrar."""
+        src = BundleSource(Bundle(channel_cfg))
+        return self._create_channel(channel_cfg, src)
 
     # -- rpc handlers --------------------------------------------------------
+
+    def _require_admin(self, peer_identity) -> None:
+        """Participation mutations are ADMIN operations: the caller's
+        handshake-verified identity must hold the admin role in some org
+        of the bootstrap channel (the reference gates this API behind
+        client TLS auth; any-member access would let any org drop
+        channels)."""
+        from fabric_tpu.msp.msp import Principal
+        msps = self.bundle_source.current().msps
+        for mspid, msp in msps.items():
+            try:
+                ident = msp.deserialize_identity(peer_identity.serialize())
+                if msp.satisfies_principal(ident, Principal.admin(mspid)):
+                    return
+            except Exception:
+                continue
+        raise PermissionError("channel participation requires an admin "
+                              "identity")
+
+    def _rpc_join(self, body: dict, peer_identity) -> dict:
+        self._require_admin(peer_identity)
+        cfg = ChannelConfig.deserialize(body["config"])
+        if self.registrar.get(cfg.channel_id) is not None:
+            raise ValueError(f"channel {cfg.channel_id!r} already exists")
+        self.join_channel(cfg)
+        return {"channel": cfg.channel_id, "status": "joined"}
+
+    def _rpc_list(self, body: dict, peer_identity) -> dict:
+        out = {}
+        for cid, support in self.registrar.channels().items():
+            out[cid] = {"height": support.ledger.height}
+        return {"channels": out}
+
+    def _rpc_remove(self, body: dict, peer_identity) -> dict:
+        self._require_admin(peer_identity)
+        cid = body["channel"]
+        support = self.registrar.get(cid)
+        if support is None:
+            raise ValueError(f"no such channel {cid!r}")
+        self.cluster.remove_chain(cid)
+        support.chain.halt()
+        self.registrar.remove(cid)
+        return {"channel": cid, "status": "removed"}
 
     def _rpc_broadcast(self, body: dict, peer_identity) -> dict:
         env = Envelope.deserialize(body["envelope"])
@@ -148,19 +302,96 @@ class OrdererNode:
                 "leader": node.leader_id or 0, "term": node.term,
                 "height": self.support.ledger.height}
 
+    # -- onboarding replication (cluster/replication.go) ---------------------
+
+    def _replicate_once(self) -> int:
+        """For every chain stuck behind a compacted raft log (snapshot
+        install set catchup_target), pull the missing blocks from peer
+        OSNs over their deliver stream, verify the orderer signatures,
+        and hand them to the chain's catch_up — the reference's
+        onboarding replication (orderer/common/cluster/replication.go).
+        Returns how many blocks were replicated."""
+        from fabric_tpu.comm.rpc import connect
+        from fabric_tpu.orderer import block_signature_items
+        from fabric_tpu.protocol.types import Block
+
+        total = 0
+        for cid, support in self.registrar.channels().items():
+            target = getattr(support.chain, "catchup_target", None)
+            if not target:
+                continue
+            # per-CHANNEL MSPs: a runtime-joined channel has its own
+            # bundle (and its own config rotations)
+            src = support.bundle_source or self.bundle_source
+            msps = src.current().msps
+            start = support.ledger.height
+            stop = int(target.get("height", 0)) - 1
+            if stop < start:
+                continue
+            payload = b"seek:%s" % cid.encode()
+            sd = {"data": payload, "identity": self.signer.serialize(),
+                  "signature": self.signer.sign(payload)}
+            for nid, addr in self.cluster.peers.items():
+                blocks = []
+                try:
+                    conn = connect(tuple(addr), self.signer, msps,
+                                   timeout=3.0)
+                    try:
+                        for item in conn.call_stream("deliver", {
+                                "channel": cid, "start": start,
+                                "stop": stop, "timeout_s": 10,
+                                "behavior": "fail_if_not_ready",
+                                "signed_data": sd}):
+                            block = Block.deserialize(item["block"])
+                            items = block_signature_items(block, msps)
+                            if not items or not bool(
+                                    self.provider.batch_verify(items).all()):
+                                raise ValueError(
+                                    f"bad orderer signature on block "
+                                    f"{block.header.number}")
+                            blocks.append(block)
+                    finally:
+                        conn.close()
+                except Exception:
+                    logger.debug("replication pull from OSN %s failed",
+                                 nid, exc_info=True)
+                    continue
+                if blocks:
+                    support.chain.catch_up(blocks)
+                    total += len(blocks)
+                    logger.info("[%s] onboarded %d blocks from OSN %s",
+                                cid, len(blocks), nid)
+                    break
+        return total
+
+    def _onboard_loop(self) -> None:
+        while not self._stop_onboard.is_set():
+            try:
+                self._replicate_once()
+            except Exception:
+                logger.exception("onboarding replication failed")
+            self._stop_onboard.wait(1.0)
+
     # -- lifecycle -----------------------------------------------------------
 
     def start(self) -> "OrdererNode":
         self.rpc.start()
         self.cluster.start()
+        self._stop_onboard = threading.Event()
+        self._onboard_thread = threading.Thread(target=self._onboard_loop,
+                                                daemon=True)
+        self._onboard_thread.start()
         if self.ops is not None:
             self.ops.start()
         logger.info("orderer %d serving on %s", self.raft_id, self.rpc.addr)
         return self
 
     def stop(self) -> None:
+        if getattr(self, "_stop_onboard", None) is not None:
+            self._stop_onboard.set()
         self.cluster.stop()
-        self.support.chain.halt()
+        for support in self.registrar.channels().values():
+            support.chain.halt()
         self.rpc.stop()
         if self.ops is not None:
             self.ops.stop()

@@ -96,13 +96,22 @@ def provision_orderers(base_dir: str, n: int, channel_id: str = "ch",
             json.dump(node_cfg, f)
         paths.append(path)
 
-    # client material (for tests/tools): one member + the admin
+    # client material (for tests/tools): one member + the org admin
     client_cert, client_key = org.issuer.issue("client@OrdererOrg")
     with open(os.path.join(base_dir, "client.json"), "w") as f:
         json.dump({
             "mspid": "OrdererOrg",
             "cert_pem": _cert_pem(client_cert).decode(),
             "key_pem": _key_pem(client_key).decode(),
+            "channel_config_hex": cfg_hex,
+            "cluster": cluster,
+            "channel_id": channel_id,
+        }, f)
+    with open(os.path.join(base_dir, "admin.json"), "w") as f:
+        json.dump({
+            "mspid": "OrdererOrg",
+            "cert_pem": _cert_pem(org.admin.cert).decode(),
+            "key_pem": _key_pem(org.admin._key.key).decode(),
             "channel_config_hex": cfg_hex,
             "cluster": cluster,
             "channel_id": channel_id,

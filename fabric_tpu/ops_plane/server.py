@@ -28,6 +28,9 @@ class OperationsServer:
                  metrics: Optional[MetricsRegistry] = None):
         self.metrics = metrics or default_registry
         self._checkers: Dict[str, Callable] = {}
+        # extension routes: (method, path-prefix) -> fn(path, body) ->
+        # (code, json-able) — e.g. the orderer's channelparticipation REST
+        self._routes: Dict[tuple, Callable] = {}
         ops = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -60,7 +63,27 @@ class OperationsServer:
                     self._send(200, json.dumps({"spec": level}).encode(),
                                "application/json")
                 else:
-                    self._send(404, b"not found")
+                    self._route("GET") or self._send(404, b"not found")
+
+            def _route(self, method: str) -> bool:
+                for (m, prefix), fn in ops._routes.items():
+                    if m == method and self.path.startswith(prefix):
+                        try:
+                            ln = int(self.headers.get("Content-Length", "0"))
+                            body = self.rfile.read(ln) if ln else b""
+                            code, out = fn(self.path, body)
+                            self._send(code, json.dumps(out).encode(),
+                                       "application/json")
+                        except Exception as exc:
+                            self._send(400, str(exc).encode())
+                        return True
+                return False
+
+            def do_POST(self):
+                self._route("POST") or self._send(404, b"not found")
+
+            def do_DELETE(self):
+                self._route("DELETE") or self._send(404, b"not found")
 
             def do_PUT(self):
                 if self.path == "/logspec":
@@ -82,6 +105,11 @@ class OperationsServer:
 
     def register_checker(self, name: str, check: Callable) -> None:
         self._checkers[name] = check
+
+    def register_route(self, method: str, path_prefix: str,
+                       fn: Callable) -> None:
+        """fn(path, body_bytes) -> (status_code, json-able body)."""
+        self._routes[(method.upper(), path_prefix)] = fn
 
     def run_checks(self):
         failed = []

@@ -130,19 +130,24 @@ def msg_from_dict(d: dict) -> raftmod.Message:
 
 
 class ClusterService:
-    """Drives one RaftChain over the network.
+    """Drives the node's RaftChains over the network — MULTI-CHANNEL:
+    each channel's chain is registered under its id and raft messages
+    carry the channel tag (the reference's cluster comm dispatches by
+    channel + sender cert, orderer/common/cluster/comm.go:116).
 
     peers: raft node id -> (host, port).  The service registers the
     `raft.step` cast on the node's RpcServer and runs a driver thread:
-      every tick_ms: node election/heartbeat tick + batch-timeout tick,
-      after every step/tick: process_ready() and ship outbound messages.
+      every tick_ms: per-chain election/heartbeat tick + batch-timeout
+      tick; after every step/tick: process_ready() and ship outbound
+      messages.
     """
 
-    def __init__(self, chain, rpc: RpcServer, signer, msps,
+    def __init__(self, rpc: RpcServer, signer, msps,
                  peers: Dict[int, Tuple[str, int]],
                  tick_s: float = 0.05,
-                 consenters: Dict[int, Tuple[str, str]] = None):
-        self.chain = chain
+                 consenters: Dict[int, Tuple[str, str]] = None,
+                 chain=None, channel_id: str = None):
+        self.chains: Dict[str, object] = {}
         self.rpc = rpc
         self.signer = signer
         self.msps = msps
@@ -169,12 +174,37 @@ class ClusterService:
             nid: _PeerSender(nid, addr, signer, msps)
             for nid, addr in self.peers.items()}
         rpc.serve_cast("raft.step", self._on_step)
+        if chain is not None:
+            self.add_chain(channel_id or "ch", chain)
+
+    # -- chain registry (multichannel/registrar.go dynamic chains) -----------
+
+    def add_chain(self, channel_id: str, chain) -> None:
+        with self._lock:
+            self.chains[channel_id] = chain
+        self._wake.set()
+
+    def remove_chain(self, channel_id: str) -> None:
+        with self._lock:
+            self.chains.pop(channel_id, None)
+
+    @property
+    def chain(self):
+        """Single-channel convenience: the only (or first) chain."""
+        with self._lock:
+            for ch in self.chains.values():
+                return ch
+        return None
 
     # -- inbound -------------------------------------------------------------
 
     def _on_step(self, body: dict, peer_identity) -> None:
         msg = msg_from_dict(body["msg"])
-        if msg.frm not in self.peers and msg.frm != self.chain.node.id:
+        with self._lock:
+            chain = self.chains.get(body.get("channel", "ch"))
+        if chain is None:
+            return       # unknown channel (not yet joined): drop
+        if msg.frm not in self.peers and msg.frm != chain.node.id:
             logger.warning("raft message from unknown node %s", msg.frm)
             return
         expected = self.consenters.get(msg.frm)
@@ -191,15 +221,16 @@ class ClusterService:
                 "dropped (consenter authorization)", msg.frm, got_msp,
                 got_fp[:16])
             return
-        self.chain.step(msg)
+        chain.step(msg)
         self._wake.set()
 
     # -- outbound ------------------------------------------------------------
 
-    def _send(self, msg: raftmod.Message) -> None:
+    def _send(self, channel_id: str, msg: raftmod.Message) -> None:
         sender = self._senders.get(msg.to)
         if sender is not None:
-            sender.enqueue({"msg": msg_to_dict(msg)})
+            sender.enqueue({"channel": channel_id,
+                            "msg": msg_to_dict(msg)})
 
     # -- driver --------------------------------------------------------------
 
@@ -220,20 +251,25 @@ class ClusterService:
             self._wake.wait(timeout=self.tick_s / 2)
             self._wake.clear()
             now = time.monotonic()
-            if now - last_tick >= self.tick_s:
+            do_tick = now - last_tick >= self.tick_s
+            if do_tick:
                 last_tick = now
+            with self._lock:
+                chains = list(self.chains.items())
+            for channel_id, chain in chains:
+                if do_tick:
+                    try:
+                        chain.tick()
+                    except Exception:
+                        logger.exception("[%s] raft tick failed", channel_id)
+                    try:
+                        chain.tick_batch(now)
+                    except Exception:
+                        logger.exception("[%s] batch tick failed", channel_id)
                 try:
-                    self.chain.tick()
+                    ready = chain.process_ready()
                 except Exception:
-                    logger.exception("raft tick failed")
-                try:
-                    self.chain.tick_batch(now)
-                except Exception:
-                    logger.exception("batch tick failed")
-            try:
-                ready = self.chain.process_ready()
-            except Exception:
-                logger.exception("process_ready failed")
-                continue
-            for m in ready.messages:
-                self._send(m)
+                    logger.exception("[%s] process_ready failed", channel_id)
+                    continue
+                for m in ready.messages:
+                    self._send(channel_id, m)

@@ -132,6 +132,12 @@ class Registrar:
         with self._lock:
             return self._channels.get(channel_id)
 
+    def remove(self, channel_id: str) -> None:
+        """channelparticipation Remove: drop the chain from this node
+        (the ledger files remain on disk; rejoining resumes them)."""
+        with self._lock:
+            self._channels.pop(channel_id, None)
+
     def channels(self) -> Dict[str, ChainSupport]:
         with self._lock:
             return dict(self._channels)

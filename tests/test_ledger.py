@@ -284,3 +284,32 @@ def test_confighistory_heights(tmp_path):
     ch2 = ConfigHistory(str(tmp_path))
     assert ch2.config_at(99) == b"cfg-seq2"
     assert len(ch2.entries()) == 2
+
+
+def test_rich_query_selectors():
+    """CouchDB-style rich queries over JSON document values
+    (statecouchdb.go Mango-selector subset)."""
+    import json
+    db = StateDB()
+    batch = UpdateBatch()
+    docs = [
+        ("a1", {"type": "asset", "owner": "alice", "value": 10}),
+        ("a2", {"type": "asset", "owner": "bob", "value": 25}),
+        ("a3", {"type": "car", "owner": "alice", "value": 99}),
+        ("a4", {"type": "asset", "owner": "carol", "value": 7}),
+    ]
+    for k, d in docs:
+        batch.put("cc", k, json.dumps(d).encode(), Version(1, 0))
+    batch.put("cc", "raw", b"\xff\xfe not json", Version(1, 0))
+    db.apply_updates(batch, 1)
+
+    def q(sel, **kw):
+        return [k for k, _ in db.execute_query("cc", sel, **kw)]
+
+    assert q({"type": "asset"}) == ["a1", "a2", "a4"]
+    assert q({"type": "asset", "owner": "alice"}) == ["a1"]
+    assert q({"value": {"$gt": 9, "$lt": 50}}) == ["a1", "a2"]
+    assert q({"owner": {"$in": ["bob", "carol"]}}) == ["a2", "a4"]
+    assert q({"$or": [{"owner": "bob"}, {"type": "car"}]}) == ["a2", "a3"]
+    assert q({"type": "asset"}, limit=2) == ["a1", "a2"]
+    assert q({"missing": {"$gt": 1}}) == []    # absent field: no match

@@ -268,7 +268,7 @@ def _orderer_leader(orderers, signer, msps, deadline=45.0):
     raise AssertionError(f"no orderer leader: {last}")
 
 
-def _wait_heights(peers, signer, msps, want, deadline=60.0):
+def _wait_heights(peers, signer, msps, want, deadline=120.0):
     t0 = time.time()
     sts = {}
     while time.time() - t0 < deadline:
@@ -321,7 +321,7 @@ def test_full_topology_endorse_order_commit_privdata(tmp_path):
 
         cc, signer, msps = _load_client(net["clients"]["Org1"])
         orderers = [tuple(o) for o in cc["orderers"]]
-        leader = _orderer_leader(orderers, signer, msps)
+        leader = _orderer_leader(orderers, signer, msps, deadline=90.0)
 
         def submit(sp, endorse_on):
             responses = [_remote_endorse(peer_addrs[k], signer, msps, sp)
@@ -352,7 +352,7 @@ def test_full_topology_endorse_order_commit_privdata(tmp_path):
                              [b"secrets", b"sec1", b"classified"], signer)
         pvt_txid = submit(sp, endorse_on=[org1_peers[0]])
 
-        sts = _wait_heights(peer_addrs, signer, msps, 1, deadline=90.0)
+        sts = _wait_heights(peer_addrs, signer, msps, 1, deadline=150.0)
         # every peer at the same height must hold identical commit hashes
         by_height = {}
         for name, st in sts.items():
@@ -371,7 +371,7 @@ def test_full_topology_endorse_order_commit_privdata(tmp_path):
             sp = signed_proposal("ch", "assets", "create",
                                  [b"asset%d" % i, b"alice"], signer)
             submit(sp, endorse_on=[org1_peers[0], org2_peers[0]])
-        sts = _wait_heights(peer_addrs, signer, msps, pre + 1, deadline=90.0)
+        sts = _wait_heights(peer_addrs, signer, msps, pre + 1, deadline=150.0)
         final_heights = {s["height"] for s in sts.values()}
         assert len(final_heights) >= 1
         hashes = {s["commit_hash"] for s in sts.values()
@@ -391,7 +391,7 @@ def test_full_topology_endorse_order_commit_privdata(tmp_path):
 
         # Org1 client asking an Org1 peer: cleartext present (directly or
         # via the peer's reconcile loop) on BOTH org1 peers eventually
-        deadline = time.time() + 60
+        deadline = time.time() + 120
         got = {}
         while time.time() < deadline:
             got = {k: fetch_pvt(k, signer, msps) for k in org1_peers}

@@ -1,0 +1,255 @@
+"""Channel participation + multi-channel orderer + configtxlator.
+
+Reference behaviors covered (VERDICT.md missing #7/#10):
+  - the orderer registrar manages N channels DYNAMICALLY: a running node
+    joins a new channel at runtime (new raft instance + ledger) and
+    orders on both (multichannel/registrar.go),
+  - the channelparticipation REST surface lists/joins/removes channels
+    (channelparticipation/restapi.go),
+  - configtxlator translation: config <-> reviewable JSON, lossless, and
+    compute-update emits a re-sequenced config + a human diff
+    (internal/configtxlator).
+"""
+import json
+import urllib.request
+
+import pytest
+
+from fabric_tpu.config import BatchConfig, ChannelConfig, OrgConfig, default_policies
+from fabric_tpu.config.lator import compute_update, decode_config, encode_config
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.node.orderer import OrdererNode, load_signing_identity
+from fabric_tpu.node.provision import provision_orderers
+
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    return init_factories(FactoryOpts(default="SW"))
+
+
+def _client(base_dir, who="client"):
+    from fabric_tpu.config import Bundle
+    with open(f"{base_dir}/{who}.json") as f:
+        cc = json.load(f)
+    signer = load_signing_identity(cc["mspid"], cc["cert_pem"].encode(),
+                                   cc["key_pem"].encode())
+    bundle = Bundle(ChannelConfig.deserialize(
+        bytes.fromhex(cc["channel_config_hex"])))
+    return cc, signer, bundle
+
+
+def _env(signer, channel, i):
+    from fabric_tpu.protocol import KVWrite, NsRwSet, TxRwSet, build
+    rw = TxRwSet((NsRwSet("cc", writes=(KVWrite(f"k{i}", b"v"),)),))
+    return build.endorser_tx(channel, "cc", "1.0", rw, signer, [signer])
+
+
+def test_runtime_channel_join_and_rest(tmp_path):
+    import time
+
+    from fabric_tpu.comm.rpc import connect
+
+    paths = provision_orderers(str(tmp_path), 1)
+    with open(paths[0]) as f:
+        cfg = json.load(f)
+    cfg["ops_port"] = 0
+    cfg["participation_rest_writes"] = True
+    node = OrdererNode(cfg, data_dir=cfg["data_dir"])
+    # pick the ephemeral ops port after construction
+    node.ops._httpd.server_address
+    node.start()
+    try:
+        cc, signer, bundle = _client(str(tmp_path))
+        conn = connect(("127.0.0.1", cfg["port"]), signer,
+                       bundle.msps, timeout=5.0)
+
+        # wait for the single-node raft to elect itself
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            if conn.call("status", {}, timeout=5.0)["role"] == "leader":
+                break
+            time.sleep(0.1)
+
+        # order on the bootstrap channel
+        out = conn.call("broadcast",
+                        {"envelope": _env(signer, "ch", 0).serialize()},
+                        timeout=15.0)
+        assert out["status"] == 200
+
+        # join a SECOND channel at runtime (same orgs, new id) — an
+        # ADMIN operation: the member identity is refused, the org
+        # admin succeeds
+        base = ChannelConfig.deserialize(
+            bytes.fromhex(cc["channel_config_hex"]))
+        import dataclasses
+        ch2 = dataclasses.replace(base, channel_id="ch2")
+        from fabric_tpu.comm.rpc import RpcError
+        with pytest.raises(RpcError, match="admin"):
+            conn.call("participation.join",
+                      {"config": ch2.serialize()}, timeout=15.0)
+        _, admin, _ = _client(str(tmp_path), who="admin")
+        aconn = connect(("127.0.0.1", cfg["port"]), admin, bundle.msps,
+                        timeout=5.0)
+        out = aconn.call("participation.join",
+                         {"config": ch2.serialize()}, timeout=15.0)
+        assert out["status"] == "joined"
+
+        # order on the new channel through the SAME broadcast service
+        # (retry until ch2's fresh raft instance elects itself)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            out = conn.call("broadcast",
+                            {"envelope": _env(signer, "ch2", 0).serialize()},
+                            timeout=15.0)
+            if out["status"] == 200:
+                break
+            time.sleep(0.2)
+        assert out["status"] == 200, out
+        out = conn.call("broadcast",
+                        {"envelope": _env(signer, "ch2", 1).serialize()},
+                        timeout=15.0)
+        assert out["status"] == 200, out
+
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            chans = conn.call("participation.list", {},
+                              timeout=5.0)["channels"]
+            if (chans.get("ch", {}).get("height", 0) >= 1
+                    and chans.get("ch2", {}).get("height", 0) >= 1):
+                break
+            time.sleep(0.2)
+        assert chans["ch"]["height"] >= 1 and chans["ch2"]["height"] >= 1, \
+            chans
+
+        # REST surface (channelparticipation/restapi.go)
+        port = node.ops.addr[1]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/participation/v1/channels") as r:
+            listing = json.loads(r.read())
+        names = {c["name"] for c in listing["channels"]}
+        assert names == {"ch", "ch2"}
+        # join ch3 over REST
+        ch3 = dataclasses.replace(base, channel_id="ch3")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/participation/v1/channels",
+            data=json.dumps(
+                {"config_hex": ch3.serialize().hex()}).encode(),
+            method="POST")
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["status"] == "joined"
+        # remove it again
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/participation/v1/channels/ch3",
+            method="DELETE")
+        with urllib.request.urlopen(req) as r:
+            assert json.loads(r.read())["status"] == "removed"
+        chans = conn.call("participation.list", {}, timeout=5.0)["channels"]
+        assert set(chans) == {"ch", "ch2"}
+        conn.close()
+    finally:
+        node.stop()
+
+
+def test_configtxlator_roundtrip_and_update():
+    o1, o2 = DevOrg("Org1"), DevOrg("Org2")
+
+    def org_cfg(dev):
+        mc = dev.msp_config()
+        return OrgConfig(mspid=dev.mspid,
+                         root_certs=tuple(mc.root_certs_pem),
+                         admins=tuple(mc.admin_certs_pem))
+
+    cfg = ChannelConfig(channel_id="ch", sequence=3,
+                        orgs=(org_cfg(o1),),
+                        policies=default_policies(["Org1"]),
+                        batch=BatchConfig(max_message_count=7))
+    raw = cfg.serialize()
+
+    js = decode_config(raw)
+    assert json.loads(js)["channel_id"] == "ch"     # reviewable
+    assert encode_config(js) == raw                 # lossless
+
+    # compute-update: add Org2, change batch size
+    d = json.loads(js)
+    import base64
+    new_cfg = ChannelConfig(channel_id="ch", sequence=0,
+                            orgs=(org_cfg(o1), org_cfg(o2)),
+                            policies=default_policies(["Org1", "Org2"]),
+                            batch=BatchConfig(max_message_count=9))
+    from fabric_tpu.config.lator import jsonify
+    new_js = json.dumps(jsonify(new_cfg.to_dict()))
+    out_raw, diff = compute_update(raw, new_js)
+    out = ChannelConfig.deserialize(out_raw)
+    assert out.sequence == 4                        # re-sequenced
+    assert [o.mspid for o in out.orgs] == ["Org1", "Org2"]
+    assert any(line == "+ org Org2" for line in diff)
+    assert any("batch" in line for line in diff)
+    assert any("sequence 3 -> 4" in line for line in diff)
+
+    with pytest.raises(ValueError, match="channel mismatch"):
+        compute_update(raw, json.dumps(jsonify(
+            ChannelConfig(channel_id="other", sequence=0, orgs=(),
+                          policies={}).to_dict())))
+
+
+def test_onboarding_replication_pull(tmp_path):
+    """A node behind a compacted raft log (catchup_target set by a
+    snapshot install) pulls the missing blocks from a peer OSN's deliver
+    stream, verifies the orderer signatures, and catches up
+    (orderer/common/cluster/replication.go)."""
+    import time
+
+    paths = provision_orderers(str(tmp_path), 2)
+    cfgs = []
+    for p in paths:
+        with open(p) as f:
+            cfgs.append(json.load(f))
+    n1 = OrdererNode(cfgs[0], data_dir=cfgs[0]["data_dir"]).start()
+    n2 = OrdererNode(cfgs[1], data_dir=cfgs[1]["data_dir"]).start()
+    try:
+        cc, signer, bundle = _client(str(tmp_path))
+        from fabric_tpu.comm.rpc import connect
+
+        # find the leader and order 4 envelopes -> 2 blocks
+        import time as _t
+        deadline = _t.time() + 30
+        leader = None
+        while _t.time() < deadline and leader is None:
+            for cfg in cfgs:
+                conn = connect(("127.0.0.1", cfg["port"]), signer,
+                               bundle.msps, timeout=3.0)
+                st = conn.call("status", {}, timeout=5.0)
+                conn.close()
+                if st["role"] == "leader":
+                    leader = cfg
+                    break
+            _t.sleep(0.2)
+        assert leader is not None
+        conn = connect(("127.0.0.1", leader["port"]), signer, bundle.msps)
+        for i in range(4):
+            out = conn.call("broadcast",
+                            {"envelope": _env(signer, "ch", i).serialize()},
+                            timeout=15.0)
+            assert out["status"] == 200
+        deadline = _t.time() + 20
+        while _t.time() < deadline:
+            if conn.call("status", {}, timeout=5.0)["height"] >= 2:
+                break
+            _t.sleep(0.2)
+        conn.close()
+
+        # simulate a lagging node: force a catchup target on n2's chain
+        # as a snapshot install would, then let the onboarding loop pull
+        target_h = n1.support.ledger.height
+        lag = n2 if n2.support.ledger.height <= n1.support.ledger.height \
+            else n1
+        src = n1 if lag is n2 else n2
+        lag.support.chain.catchup_target = {
+            "height": src.support.ledger.height, "index": 10 ** 9}
+        pulled = lag._replicate_once()
+        assert pulled >= 0
+        assert lag.support.ledger.height >= src.support.ledger.height
+    finally:
+        n1.stop()
+        n2.stop()
