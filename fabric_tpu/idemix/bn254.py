@@ -419,6 +419,90 @@ def _final_exp(f: Fp12) -> Fp12:
     return f12_pow_raw(f, _HARD)
 
 
+# -- ate pairing with precomputed lines (the TPU-batch structure) ------------
+#
+# The ate Miller loop runs over multiples of the FIXED G2 point Q, so for
+# a fixed Q every step's line function reduces to constants: evaluated at
+# a G1 point P = (xP, yP), each line is the sparse Fp12 element
+#     l(P) = yP            (component 0, Fp)
+#          + A * xP        (component 1, A in Fp2)
+#          + B              (component 3, B in Fp2)
+# (D-twist untwisting puts the slope in the w^1 component and the
+# constant term in w^3).  ate_precompute emits the flat step list
+# [(is_dbl_step, A, B), ...] that both the host oracle below and the
+# batched TPU kernel (fabric_tpu/ops/bn254_batch.py) consume — the
+# device differentially matches this host implementation bit-for-bit.
+
+ATE_LAMBDA = (T_TRACE - 1) % R        # lambda = t-1 == p (mod r)
+
+
+def ate_precompute(Qpt: G2Point):
+    """-> list of (flag, A, B): flag 1 = this step also squares f (a
+    Miller doubling step), 0 = extra addition step; A, B in Fp2."""
+    if not IS_D_TWIST:
+        raise NotImplementedError("line precompute assumes the D-twist")
+    steps = []
+    Tx, Ty = Qpt
+
+    def dbl_line():
+        nonlocal Tx, Ty
+        lam = f2_mul(f2_mul_scalar(f2_sqr((Tx)), 3),
+                     f2_inv(f2_mul_scalar(Ty, 2)))
+        A = f2_neg(lam)
+        B = f2_sub(f2_mul(lam, Tx), Ty)
+        x3 = f2_sub(f2_sqr(lam), f2_mul_scalar(Tx, 2))
+        Ty = f2_sub(f2_mul(lam, f2_sub(Tx, x3)), Ty)
+        Tx = x3
+        return A, B
+
+    def add_line(Qx, Qy):
+        nonlocal Tx, Ty
+        lam = f2_mul(f2_sub(Ty, Qy), f2_inv(f2_sub(Tx, Qx)))
+        A = f2_neg(lam)
+        B = f2_sub(f2_mul(lam, Tx), Ty)
+        x3 = f2_sub(f2_sub(f2_sqr(lam), Tx), Qx)
+        Ty = f2_sub(f2_mul(lam, f2_sub(Tx, x3)), Ty)
+        Tx = x3
+        return A, B
+
+    bits = bin(ATE_LAMBDA)[2:]
+    for bit in bits[1:]:
+        A, B = dbl_line()
+        steps.append((1, A, B))
+        if bit == "1":
+            A, B = add_line(*Qpt)
+            steps.append((0, A, B))
+    return steps
+
+
+def _sparse013(yP: int, A: Fp2, xP: int, B: Fp2) -> Fp12:
+    out = [F2_ZERO] * 6
+    out[0] = (yP % P, 0)
+    out[1] = f2_mul_scalar(A, xP)
+    out[3] = B
+    return tuple(out)
+
+
+def ate_pairing_lines(Ppt: G1Point, steps) -> Fp12:
+    """Reduced ate pairing from precomputed lines (host oracle for the
+    batched kernel)."""
+    if Ppt is None:
+        return F12_ONE
+    xP, yP = Ppt
+    f = F12_ONE
+    for flag, A, B in steps:
+        if flag:
+            f = f12_sqr(f)
+        f = f12_mul(f, _sparse013(yP, A, xP, B))
+    return _final_exp(f)
+
+
+def ate_pairing(Ppt: G1Point, Qpt: G2Point) -> Fp12:
+    if Ppt is None or Qpt is None:
+        return F12_ONE
+    return ate_pairing_lines(Ppt, ate_precompute(Qpt))
+
+
 def pairing(Ppt: G1Point, Qpt: G2Point) -> Fp12:
     """Reduced Tate pairing e(P, Q): P in G1 = E(Fp)[r], Q on the twist.
 

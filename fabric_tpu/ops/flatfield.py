@@ -398,6 +398,29 @@ class FlatMod:
             t -= 1
         return s
 
+    def reduce_to_kp(self, a, kbound: int, target_k: int = 2):
+        """Lazily-bounded value < kbound*p -> value < target_k*p via
+        conditional subtractions of halving multiples (target_k a power
+        of two).  Cheaper than reduce_to_2p when the consumer tolerates a
+        larger bound (e.g. tower-field accumulators)."""
+        if _is_concrete(a):
+            return _prim_jit(("redkp", self.p, kbound, target_k),
+                             lambda x: self._reduce_to_kp_impl(
+                                 x, kbound, target_k))(a)
+        return self._reduce_to_kp_impl(a, kbound, target_k)
+
+    def _reduce_to_kp_impl(self, a, kbound: int, target_k: int):
+        s = jnp.asarray(a)
+        t = max(0, (kbound - 1).bit_length() - 1)
+        floor_t = max(1, (target_k - 1).bit_length())
+        while t >= floor_t:
+            sub = self._col(self._kp_np(1 << t), s.ndim)
+            d = s - sub
+            neg = is_negative(d)
+            s = jnp.where(neg[None], s, split_rounds(d, 2))
+            t -= 1
+        return s
+
     def is_zero_k(self, a, kbound: int):
         """value(a) == 0 mod p for a lazily-bounded value < kbound*p:
         (B,) bool.  One exact resolve + kbound limb comparisons."""

@@ -79,14 +79,14 @@ def _kernel(expn_ref, comb_ref, qx_ref, qy_ref, r_ref, s_ref, e_ref,
 
     def inv_body(i, acc):
         acc = fn.sqr(fn.sqr(fn.sqr(fn.sqr(acc))))
-        d = expn_ref[0, i]
+        d = expn_ref[i]
         ent = tab[0]
         for k in range(1, 16):
             ent = jnp.where(d == k, tab[k], ent)
         return fn.mul(acc, ent)
 
     w0 = tab[0]
-    d0 = expn_ref[0, 0]
+    d0 = expn_ref[0]
     for k in range(1, 16):
         w0 = jnp.where(d0 == k, tab[k], w0)
     w = lax.fori_loop(1, 64, inv_body, w0)
@@ -256,7 +256,7 @@ def verify_limbs_pallas(qx_l, qy_l, r_l, s_l, e_l, require_low_s=True):
         args.append(a)
     _collect_const_pool()
     out = _run_tiles(jnp.asarray(_CONST_POOL),
-                     jnp.asarray(_inv_digits_n()).reshape(1, -1),
+                     jnp.asarray(_inv_digits_n()),
                      jnp.asarray(ec.comb_table_f32()),
                      *args, require_low_s=require_low_s, n_tiles=n_tiles)
     return out[0, :B] != 0
