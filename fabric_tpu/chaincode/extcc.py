@@ -30,8 +30,10 @@ Message protocol (all serde dicts, u32-framed):
 
 from __future__ import annotations
 
+import hmac
 import logging
 import os
+import secrets
 import socket
 import struct
 import subprocess
@@ -118,7 +120,8 @@ class ChaincodeSupport:
 
     def __init__(self, sock_dir: str, launch_timeout_s: float = 10.0,
                  invoke_timeout_s: float = 30.0):
-        os.makedirs(sock_dir, exist_ok=True)
+        os.makedirs(sock_dir, mode=0o700, exist_ok=True)
+        os.chmod(sock_dir, 0o700)
         self.sock_path = os.path.join(sock_dir, "chaincode.sock")
         if os.path.exists(self.sock_path):
             os.unlink(self.sock_path)
@@ -127,10 +130,21 @@ class ChaincodeSupport:
         self._handles: Dict[str, _CCHandle] = {}
         self._launch_cmds: Dict[str, List[str]] = {}
         self._pending: Dict[str, socket.socket] = {}
+        # per-launch registration tokens: a registration for `name` is
+        # only accepted while a launch() for that name is in flight AND
+        # the register message carries the token handed to that child
+        # via env — the reference authenticates chaincode streams with
+        # peer-generated TLS client certs (core/chaincode handler auth);
+        # here the unix-socket analogue is a random bearer token.
+        self._expected_tokens: Dict[str, str] = {}
         self._cond = threading.Condition()
         self._closing = False
-        self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        self._srv.bind(self.sock_path)
+        old_umask = os.umask(0o077)
+        try:
+            self._srv = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            self._srv.bind(self.sock_path)
+        finally:
+            os.umask(old_umask)
         self._srv.listen(16)
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
@@ -154,6 +168,16 @@ class ChaincodeSupport:
                 conn.close()
                 return
             name = msg["name"]
+            token = msg.get("token", "")
+            with self._cond:
+                expected = self._expected_tokens.get(name)
+            if expected is None or not hmac.compare_digest(
+                    str(token), expected):
+                logger.warning(
+                    "rejecting chaincode registration for %r: no launch "
+                    "in flight or bad token", name)
+                conn.close()
+                return
             _send(conn, {"type": "registered"})
         except (OSError, ValueError, ConnectionError):
             conn.close()
@@ -166,21 +190,47 @@ class ChaincodeSupport:
         """Spawn the chaincode process and wait for its Register (launch
         timeout parity: chaincode_support.go Launch)."""
         self._launch_cmds[name] = list(argv)
+        token = secrets.token_hex(16)
         env = dict(os.environ)
         env["FABRIC_TPU_CC_SOCK"] = self.sock_path
         env["FABRIC_TPU_CC_NAME"] = name
-        proc = subprocess.Popen(argv, env=env)
-        deadline = time.monotonic() + self.launch_timeout_s
+        env["FABRIC_TPU_CC_TOKEN"] = token
         with self._cond:
-            while name not in self._pending:
-                left = deadline - time.monotonic()
-                if left <= 0 or proc.poll() is not None:
-                    proc.kill()
-                    raise SimulationError(
-                        f"chaincode {name!r} failed to register within "
-                        f"{self.launch_timeout_s}s")
-                self._cond.wait(timeout=min(left, 0.5))
-            conn = self._pending.pop(name)
+            # purge any stale registration from a PREVIOUS launch whose
+            # child passed the token check but registered after that
+            # launch timed out — pairing a new process with the old
+            # child's socket would route invokes to the wrong process
+            stale = self._pending.pop(name, None)
+            self._expected_tokens[name] = token
+        if stale is not None:
+            try:
+                stale.close()
+            except OSError:
+                pass
+        try:
+            proc = subprocess.Popen(argv, env=env)
+            deadline = time.monotonic() + self.launch_timeout_s
+            with self._cond:
+                while name not in self._pending:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or proc.poll() is not None:
+                        proc.kill()
+                        raise SimulationError(
+                            f"chaincode {name!r} failed to register within "
+                            f"{self.launch_timeout_s}s")
+                    self._cond.wait(timeout=min(left, 0.5))
+                conn = self._pending.pop(name)
+        finally:
+            with self._cond:
+                self._expected_tokens.pop(name, None)
+                late = self._pending.pop(name, None)
+            if late is not None:
+                # registered between the timeout and the token purge:
+                # nothing will ever consume this socket — close it
+                try:
+                    late.close()
+                except OSError:
+                    pass
         old = self._handles.get(name)
         if old is not None:
             old.close()
@@ -347,10 +397,11 @@ def shim_main(contract, name: Optional[str] = None,
     """
     name = name or os.environ["FABRIC_TPU_CC_NAME"]
     sock_path = sock_path or os.environ["FABRIC_TPU_CC_SOCK"]
+    token = os.environ.get("FABRIC_TPU_CC_TOKEN", "")
     invoke = (contract.invoke if hasattr(contract, "invoke") else contract)
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.connect(sock_path)
-    _send(sock, {"type": "register", "name": name})
+    _send(sock, {"type": "register", "name": name, "token": token})
     msg = _recv(sock, timeout=10.0)
     if msg.get("type") != "registered":
         raise RuntimeError("registration rejected")

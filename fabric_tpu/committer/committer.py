@@ -78,18 +78,53 @@ class Committer:
                 else:
                     err = None
                 if cfg is not None and cfg.sequence <= bundle.sequence:
-                    # Historical replay (a peer bootstrapped at a later
-                    # config catching up through old config blocks) or a
-                    # raced duplicate update that lost: authorization was
-                    # validated when the block was cut.  Re-judging it
-                    # against the CURRENT bundle would permanently flag a
-                    # historically-valid config tx INVALID and diverge
-                    # from peers that validated it at the tip — keep the
-                    # flags, apply nothing.
-                    logger.debug(
-                        "config block %d sequence %d <= bundle sequence "
-                        "%d: already applied, skipping",
-                        block.header.number, cfg.sequence, bundle.sequence)
+                    # A stale-sequence config tx is only acceptable as
+                    # HISTORICAL REPLAY — a peer bootstrapped at a later
+                    # config catching up through the old config blocks
+                    # that produced it.  Genuine replay is recognizable:
+                    # the block number is at or below the height the
+                    # current config was taken/applied at (BundleSource
+                    # .config_height, advanced on every application, or
+                    # covered by confighistory).  A brand-NEW block above
+                    # that height carrying a stale-sequence config tx is
+                    # a wrong-sequence config (e.g. a byzantine orderer
+                    # replaying an old authorized update) and is flagged
+                    # INVALID like any other wrong-sequence config — the
+                    # reference invalidates it at commit
+                    # (configtx/validator.go sequence check).
+                    covered = block.header.number <= getattr(
+                        self.bundle_source, "config_height", 0)
+                    if not covered and self.confighistory is not None:
+                        latest = self.confighistory.latest_height()
+                        covered = (latest is not None
+                                   and block.header.number <= latest)
+                    if (not covered and cfg is not None
+                            and cfg.sequence == bundle.sequence
+                            and cfg.serialize()
+                            == bundle.config.serialize()):
+                        # byte-identical to the live config: this is the
+                        # very config block that produced the bootstrap
+                        # bundle (a fresh peer bootstrapped at sequence S
+                        # replaying the block that applied S) — a
+                        # harmless idempotent replay, and flagging it
+                        # INVALID would diverge from tip peers.  Configs
+                        # strictly OLDER than the bootstrap one still
+                        # need config_height seeded in the node config.
+                        covered = True
+                        self.bundle_source.config_height = max(
+                            getattr(self.bundle_source, "config_height", 0),
+                            block.header.number)
+                    if covered:
+                        logger.debug(
+                            "config block %d sequence %d <= bundle "
+                            "sequence %d: catch-up replay, skipping",
+                            block.header.number, cfg.sequence,
+                            bundle.sequence)
+                    else:
+                        err = ConfigError(
+                            f"config sequence {cfg.sequence} <= current "
+                            f"{bundle.sequence} in new block "
+                            f"{block.header.number}")
                 elif err is None:
                     try:
                         new_cfg = validate_parsed_config_update(
@@ -110,6 +145,7 @@ class Committer:
             try:
                 from fabric_tpu.config import Bundle
                 self.bundle_source.update(Bundle(new_cfg))
+                self.bundle_source.config_height = block.header.number
                 if self.confighistory is not None:
                     self.confighistory.record(block.header.number,
                                               new_cfg.serialize())

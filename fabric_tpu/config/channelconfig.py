@@ -215,10 +215,17 @@ class BundleSource:
     at each use so a committed config block takes effect atomically
     (channelconfig/bundlesource.go)."""
 
-    def __init__(self, bundle: Bundle):
+    def __init__(self, bundle: Bundle, config_height: int = 0):
         self._lock = threading.Lock()
         self._bundle = bundle
         self._listeners: List = []
+        # block number at/below which config txs are genuine catch-up
+        # replay: the height of the block that carried the bootstrap
+        # config (0 for a genesis bootstrap).  The committer advances it
+        # as config blocks are applied, and uses it to tell historical
+        # replay apart from a fresh block carrying a stale-sequence
+        # config tx (which must be flagged INVALID, configtx semantics).
+        self.config_height = int(config_height)
 
     def current(self) -> Bundle:
         with self._lock:

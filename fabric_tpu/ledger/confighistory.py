@@ -66,6 +66,11 @@ class ConfigHistory:
             i = bisect.bisect_right(nums, block_num)
             return self._entries[i - 1][1] if i else None
 
+    def latest_height(self) -> Optional[int]:
+        """Block number of the newest recorded config, or None."""
+        with self._lock:
+            return self._entries[-1][0] if self._entries else None
+
     def entries(self) -> List[Tuple[int, bytes]]:
         with self._lock:
             return list(self._entries)

@@ -192,6 +192,26 @@ class OrdererNode:
 
     # -- channel lifecycle ---------------------------------------------------
 
+    def _channel_cluster_maps(self, channel_cfg: ChannelConfig):
+        """Derive THIS channel's raft membership from its config.
+
+        Rich consenter entries ({raft_id, host, port, mspid, cert_fp})
+        yield per-channel peer ids, addresses, and consenter identity
+        bindings — the reference authenticates cluster traffic against
+        per-channel consenter sets (orderer/common/cluster/comm.go).
+        Legacy int-only entries (or none) fall back to the bootstrap
+        cluster maps."""
+        rich = [c for c in channel_cfg.consenters if isinstance(c, dict)]
+        if not rich:
+            return self.peer_ids, None, None
+        ids = sorted(int(c["raft_id"]) for c in rich)
+        consenters = {int(c["raft_id"]): (c["mspid"], c["cert_fp"])
+                      for c in rich}
+        peers = {int(c["raft_id"]): (c.get("host", "127.0.0.1"),
+                                     int(c["port"]))
+                 for c in rich if int(c["raft_id"]) != self.raft_id}
+        return ids, consenters, peers
+
     def _create_channel(self, channel_cfg: ChannelConfig, bundle_source):
         """One channel's chain: per-channel data dirs + raft instance,
         registered with the shared cluster transport.  The channel config
@@ -207,7 +227,9 @@ class OrdererNode:
             with open(tmp, "wb") as f:
                 f.write(channel_cfg.serialize())
             os.replace(tmp, cfg_path)
-        node = RaftNode(self.raft_id, self.peer_ids,
+        peer_ids, ch_consenters, ch_peers = self._channel_cluster_maps(
+            channel_cfg)
+        node = RaftNode(self.raft_id, peer_ids,
                         wal_path=os.path.join(ch_dir, "wal.bin"),
                         snap_path=os.path.join(ch_dir, "snap.bin"))
         batch = channel_cfg.batch
@@ -224,7 +246,8 @@ class OrdererNode:
             chain_factory=lambda cutter, writer, on_block: RaftChain(
                 node, cutter, writer, on_block=on_block),
             bundle_source=bundle_source)
-        self.cluster.add_chain(cid, support.chain)
+        self.cluster.add_chain(cid, support.chain,
+                               consenters=ch_consenters, peers=ch_peers)
         return support
 
     def join_channel(self, channel_cfg: ChannelConfig):
@@ -331,7 +354,9 @@ class OrdererNode:
             payload = b"seek:%s" % cid.encode()
             sd = {"data": payload, "identity": self.signer.serialize(),
                   "signature": self.signer.sign(payload)}
-            for nid, addr in self.cluster.peers.items():
+            # pull from THIS channel's consenters (a runtime-joined
+            # channel may have a different orderer set than bootstrap)
+            for nid, addr in self.cluster.peers_for(cid).items():
                 blocks = []
                 try:
                     conn = connect(tuple(addr), self.signer, msps,

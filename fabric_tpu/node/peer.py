@@ -143,7 +143,13 @@ class PeerNode:
 
         channel_cfg = ChannelConfig.deserialize(
             bytes.fromhex(cfg["channel_config_hex"]))
-        self.bundle_source = BundleSource(Bundle(channel_cfg))
+        # config_height: the block number the bootstrap config was taken
+        # at (0 = genesis).  A peer bootstrapped at a later config MUST
+        # carry it so catch-up replay of older config blocks is
+        # recognized instead of being flagged INVALID (committer.py).
+        self.bundle_source = BundleSource(
+            Bundle(channel_cfg),
+            config_height=int(cfg.get("config_height", 0)))
         self.msps = self.bundle_source.current().msps
 
         self.ledger = KVLedger(self.channel_id,

@@ -54,6 +54,17 @@ def provision_orderers(base_dir: str, n: int, channel_id: str = "ch",
     for s in socks:
         s.close()
 
+    # issue every consenter identity first so both the channel config and
+    # the shared cluster list can bind raft ids to certificate
+    # fingerprints (not forgeable CN strings)
+    from fabric_tpu.orderer.cluster import cert_fingerprint
+
+    creds = [org.issuer.issue(f"orderer{i + 1}@OrdererOrg") for i in range(n)]
+    cluster = [{"raft_id": i + 1, "host": "127.0.0.1", "port": ports[i],
+                "mspid": "OrdererOrg",
+                "cert_fp": cert_fingerprint(creds[i][0])}
+               for i in range(n)]
+
     cfg = ChannelConfig(
         channel_id=channel_id,
         sequence=0,
@@ -62,19 +73,9 @@ def provision_orderers(base_dir: str, n: int, channel_id: str = "ch",
                         admins=tuple(mc.admin_certs_pem)),),
         policies=default_policies(["OrdererOrg"]),
         batch=batch or BatchConfig(max_message_count=2, timeout_s=0.2),
-        consenters=tuple(range(1, n + 1)),
+        consenters=tuple(cluster),
     )
     cfg_hex = cfg.serialize().hex()
-
-    # issue every consenter identity first so the shared cluster list can
-    # bind raft ids to certificate fingerprints (not forgeable CN strings)
-    from fabric_tpu.orderer.cluster import cert_fingerprint
-
-    creds = [org.issuer.issue(f"orderer{i + 1}@OrdererOrg") for i in range(n)]
-    cluster = [{"raft_id": i + 1, "host": "127.0.0.1", "port": ports[i],
-                "mspid": "OrdererOrg",
-                "cert_fp": cert_fingerprint(creds[i][0])}
-               for i in range(n)]
     paths = []
     for i in range(n):
         node_dir = os.path.join(base_dir, f"orderer{i + 1}")
@@ -162,13 +163,22 @@ def provision_network(base_dir: str, n_orderers: int = 3,
         org_cfgs.append(OrgConfig(mspid=name,
                                   root_certs=tuple(mc.root_certs_pem),
                                   admins=tuple(mc.admin_certs_pem)))
+    # consenter identities first: the channel config itself carries the
+    # rich consenter entries (raft id -> addr + mspid + cert fingerprint)
+    creds = [ord_org.issuer.issue(f"orderer{i + 1}@OrdererOrg")
+             for i in range(n_orderers)]
+    cluster = [{"raft_id": i + 1, "host": "127.0.0.1", "port": ord_ports[i],
+                "mspid": "OrdererOrg",
+                "cert_fp": cert_fingerprint(creds[i][0])}
+               for i in range(n_orderers)]
+
     cfg = ChannelConfig(
         channel_id=channel_id,
         sequence=0,
         orgs=tuple(org_cfgs),
         policies=default_policies(list(all_orgs)),
         batch=batch or BatchConfig(max_message_count=8, timeout_s=0.2),
-        consenters=tuple(range(1, n_orderers + 1)),
+        consenters=tuple(cluster),
     )
     cfg_hex = cfg.serialize().hex()
 
@@ -179,12 +189,6 @@ def provision_network(base_dir: str, n_orderers: int = 3,
     collections = collections or []
 
     # orderers
-    creds = [ord_org.issuer.issue(f"orderer{i + 1}@OrdererOrg")
-             for i in range(n_orderers)]
-    cluster = [{"raft_id": i + 1, "host": "127.0.0.1", "port": ord_ports[i],
-                "mspid": "OrdererOrg",
-                "cert_fp": cert_fingerprint(creds[i][0])}
-               for i in range(n_orderers)]
     orderer_paths = []
     for i in range(n_orderers):
         node_dir = os.path.join(base_dir, f"orderer{i + 1}")

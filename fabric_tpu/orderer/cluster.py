@@ -157,6 +157,11 @@ class ClusterService:
         # forge raft traffic claiming to be a consenter (cluster/comm.go
         # authenticates the sender's actual cert against the consenter
         # set).  Bound to the full cert hash, not a forgeable CN string.
+        # This map is the BOOTSTRAP-channel set; channels registered via
+        # add_chain may carry their own set (the reference keys consenter
+        # authorization per channel, cluster/comm.go stub-per-channel) —
+        # a node authorized on one channel is NOT thereby authorized to
+        # step raft on another.
         if not consenters:
             raise ValueError(
                 "ClusterService requires the consenter identity map "
@@ -168,25 +173,62 @@ class ClusterService:
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._thread = threading.Thread(target=self._drive, daemon=True)
-        # per-peer sender threads: dial/retry must never block the raft
-        # clock (a blackholed peer would otherwise starve heartbeats)
-        self._senders: Dict[int, _PeerSender] = {
-            nid: _PeerSender(nid, addr, signer, msps)
-            for nid, addr in self.peers.items()}
+        # per-channel overrides: channel -> (consenters map, peer addrs)
+        self._chan_consenters: Dict[str, Dict[int, Tuple[str, str]]] = {}
+        self._chan_peers: Dict[str, Dict[int, Tuple[str, int]]] = {}
+        # per-ADDRESS sender threads (shared across channels): dial/retry
+        # must never block the raft clock (a blackholed peer would
+        # otherwise starve heartbeats)
+        self._senders: Dict[Tuple[str, int], _PeerSender] = {}
+        with self._lock:
+            for nid, addr in self.peers.items():
+                self._sender_for(tuple(addr))
         rpc.serve_cast("raft.step", self._on_step)
         if chain is not None:
             self.add_chain(channel_id or "ch", chain)
 
+    def _sender_for(self, addr: Tuple[str, int]) -> Optional["_PeerSender"]:
+        """Get-or-create the sender thread for an address.  Callers must
+        hold self._lock (dynamic growth from add_chain/_send).  Returns
+        None once the service is stopping."""
+        if self._stop.is_set():
+            return None
+        addr = tuple(addr)
+        sender = self._senders.get(addr)
+        if sender is None:
+            sender = _PeerSender(0, addr, self.signer, self.msps)
+            self._senders[addr] = sender
+        return sender
+
+    def peers_for(self, channel_id: str) -> Dict[int, Tuple[str, int]]:
+        """THIS channel's raft-id -> address map (bootstrap fallback)."""
+        with self._lock:
+            return dict(self._chan_peers.get(channel_id, self.peers))
+
     # -- chain registry (multichannel/registrar.go dynamic chains) -----------
 
-    def add_chain(self, channel_id: str, chain) -> None:
+    def add_chain(self, channel_id: str, chain,
+                  consenters: Dict[int, Tuple[str, str]] = None,
+                  peers: Dict[int, Tuple[str, int]] = None) -> None:
+        """Register a channel's chain.  `consenters`/`peers` are that
+        CHANNEL's consenter identity map and node addresses; when omitted
+        the bootstrap channel's maps apply (single-channel deployments)."""
         with self._lock:
             self.chains[channel_id] = chain
+            if consenters is not None:
+                self._chan_consenters[channel_id] = dict(consenters)
+            if peers is not None:
+                self._chan_peers[channel_id] = {
+                    nid: tuple(a) for nid, a in peers.items()}
+            for addr in (peers or self.peers).values():
+                self._sender_for(tuple(addr))
         self._wake.set()
 
     def remove_chain(self, channel_id: str) -> None:
         with self._lock:
             self.chains.pop(channel_id, None)
+            self._chan_consenters.pop(channel_id, None)
+            self._chan_peers.pop(channel_id, None)
 
     @property
     def chain(self):
@@ -200,17 +242,23 @@ class ClusterService:
 
     def _on_step(self, body: dict, peer_identity) -> None:
         msg = msg_from_dict(body["msg"])
+        channel_id = body.get("channel", "ch")
         with self._lock:
-            chain = self.chains.get(body.get("channel", "ch"))
+            chain = self.chains.get(channel_id)
+            consenters = self._chan_consenters.get(channel_id,
+                                                   self.consenters)
+            peers = self._chan_peers.get(channel_id, self.peers)
         if chain is None:
             return       # unknown channel (not yet joined): drop
-        if msg.frm not in self.peers and msg.frm != chain.node.id:
+        if msg.frm not in peers and msg.frm != chain.node.id:
             logger.warning("raft message from unknown node %s", msg.frm)
             return
-        expected = self.consenters.get(msg.frm)
+        # authorization is per CHANNEL: the sender must be in THIS
+        # channel's consenter set (not merely some channel's)
+        expected = consenters.get(msg.frm)
         if expected is None:
-            logger.warning("raft message from non-consenter node %s — "
-                           "dropped", msg.frm)
+            logger.warning("[%s] raft message from non-consenter node %s "
+                           "— dropped", channel_id, msg.frm)
             return
         mspid, fp = expected
         got_msp = getattr(peer_identity, "mspid", None)
@@ -227,7 +275,10 @@ class ClusterService:
     # -- outbound ------------------------------------------------------------
 
     def _send(self, channel_id: str, msg: raftmod.Message) -> None:
-        sender = self._senders.get(msg.to)
+        with self._lock:
+            peers = self._chan_peers.get(channel_id, self.peers)
+            addr = peers.get(msg.to)
+            sender = self._sender_for(addr) if addr is not None else None
         if sender is not None:
             sender.enqueue({"channel": channel_id,
                             "msg": msg_to_dict(msg)})
@@ -242,7 +293,11 @@ class ClusterService:
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=2.0)
-        for s in self._senders.values():
+        # snapshot under the lock: _senders grows dynamically (_send /
+        # add_chain), and _sender_for refuses creation once _stop is set
+        with self._lock:
+            senders = list(self._senders.values())
+        for s in senders:
             s.stop()
 
     def _drive(self) -> None:
