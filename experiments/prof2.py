@@ -1,0 +1,69 @@
+import os, time, sys
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.expanduser("~/.cache/fabric_tpu_xla"))
+import numpy as np, jax, jax.numpy as jnp
+from jax import lax
+from fabric_tpu.ops import ecp256 as ec
+from fabric_tpu.ops import flatfield as ff
+fp = ec.fp
+B = 32768
+K = 64
+rng = np.random.default_rng(0)
+def rand_limbs(b=B):
+    return jnp.asarray(rng.integers(0, 1 << 12, size=(ff.L, b), dtype=np.int64).astype(np.int32))
+a, b = rand_limbs(), rand_limbs()
+
+def timeit(name, fn, *args, n=5, scale=1.0, reduce_out=True):
+    out = fn(*args)
+    _ = np.asarray(jax.tree_util.tree_leaves(out)[0])  # force
+    ts = []
+    for _i in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        _ = np.asarray(jax.tree_util.tree_leaves(out)[0])
+        ts.append(time.perf_counter() - t0)
+    dt = float(np.median(ts))
+    print(f"{name:36s} {dt*1e3:9.3f} ms   {scale/dt:12.3e}/s  (min {min(ts)*1e3:.2f} max {max(ts)*1e3:.2f})")
+    return dt
+
+# dispatch overhead probe
+@jax.jit
+def ident(x): return x + 1
+timeit("dispatch probe (tiny)", ident, jnp.zeros((8,), jnp.int32), scale=1)
+
+@jax.jit
+def mul_chain(a, b):
+    def body(acc, _):
+        return fp.mul(acc, b), None
+    acc, _ = lax.scan(body, a, None, length=K)
+    return acc
+t = timeit(f"mul chain x{K} (B={B})", mul_chain, a, b, scale=K*B)
+
+# sum-reduced output (tiny transfer) version
+@jax.jit
+def mul_chain_sum(a, b):
+    def body(acc, _):
+        return fp.mul(acc, b), None
+    acc, _ = lax.scan(body, a, None, length=K)
+    return acc.sum()
+timeit(f"mul chain x{K} sum-out", mul_chain_sum, a, b, scale=K*B)
+
+from fabric_tpu.ops.ecp256 import dbl, add_mixed
+X, Y, Z = rand_limbs(), rand_limbs(), rand_limbs()
+inf = jnp.zeros((B,), jnp.int32)
+@jax.jit
+def dbl_chain_sum(X, Y, Z, inf):
+    def body(acc, _):
+        return dbl(acc), None
+    acc, _ = lax.scan(body, (X, Y, Z, inf), None, length=K)
+    return acc[0].sum() + acc[1].sum() + acc[2].sum()
+timeit(f"dbl chain x{K} sum-out", dbl_chain_sum, X, Y, Z, inf, scale=K*B)
+
+x2, y2 = rand_limbs(), rand_limbs()
+qa = jnp.zeros((B,), bool)
+@jax.jit
+def addm_chain_sum(X, Y, Z, inf, x2, y2, qa):
+    def body(acc, _):
+        return add_mixed(acc, x2, y2, qa), None
+    acc, _ = lax.scan(body, (X, Y, Z, inf), None, length=K)
+    return acc[0].sum() + acc[1].sum() + acc[2].sum()
+timeit(f"add_mixed chain x{K} sum-out", addm_chain_sum, X, Y, Z, inf, x2, y2, qa, scale=K*B)

@@ -1,0 +1,121 @@
+"""Microbenchmark the P-256 kernel pieces on the real device.
+
+Measures: raw field-mul throughput, dbl / add_mixed cost, comb lookup
+matmul cost, full fast-path and generic verify — to find where the
+per-sig time actually goes before optimizing (round-4)."""
+import os, time, sys
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.expanduser("~/.cache/fabric_tpu_xla"))
+import numpy as np
+import jax, jax.numpy as jnp
+from jax import lax
+
+from fabric_tpu.ops import ecp256 as ec
+from fabric_tpu.ops import flatfield as ff
+from fabric_tpu.ops import bignum as bn
+
+fp = ec.fp
+B = int(sys.argv[1]) if len(sys.argv) > 1 else 32768
+K = 64  # muls per timed program
+
+rng = np.random.default_rng(0)
+def rand_limbs(b=B):
+    v = rng.integers(0, 1 << 12, size=(ff.L, b), dtype=np.int64).astype(np.int32)
+    return jnp.asarray(v)
+
+a = rand_limbs(); b = rand_limbs()
+
+def timeit(name, fn, *args, n=5, scale=1.0):
+    out = fn(*args); jax.block_until_ready(out)
+    ts = []
+    for _ in range(n):
+        t0 = time.perf_counter()
+        out = fn(*args); jax.block_until_ready(out)
+        ts.append(time.perf_counter() - t0)
+    dt = np.median(ts)
+    print(f"{name:34s} {dt*1e3:9.3f} ms  {scale/dt:14.3e} /s")
+    return dt
+
+# --- raw mul throughput: scan of K dependent muls ---
+@jax.jit
+def mul_chain(a, b):
+    def body(acc, _):
+        return fp.mul(acc, b), None
+    acc, _ = lax.scan(body, a, None, length=K)
+    return acc
+t = timeit(f"mul chain x{K} (B={B})", mul_chain, a, b, scale=K*B)
+print(f"  -> field muls/s: {K*B/t:.3e}")
+
+# --- dbl chain ---
+from fabric_tpu.ops.ecp256 import dbl, add_mixed, add_nodbl
+X, Y, Z = rand_limbs(), rand_limbs(), rand_limbs()
+inf = jnp.zeros((B,), jnp.int32)
+@jax.jit
+def dbl_chain(X, Y, Z, inf):
+    def body(acc, _):
+        return dbl(acc), None
+    acc, _ = lax.scan(body, (X, Y, Z, inf), None, length=K)
+    return acc
+t = timeit(f"dbl chain x{K}", dbl_chain, X, Y, Z, inf, scale=K*B)
+
+# --- add_mixed chain ---
+x2, y2 = rand_limbs(), rand_limbs()
+qa = jnp.zeros((B,), bool)
+@jax.jit
+def addm_chain(X, Y, Z, inf, x2, y2):
+    def body(acc, _):
+        return add_mixed(acc, x2, y2, qa), None
+    acc, _ = lax.scan(body, (X, Y, Z, inf), None, length=K)
+    return acc
+t = timeit(f"add_mixed chain x{K}", addm_chain, X, Y, Z, inf, x2, y2, scale=K*B)
+
+# --- comb lookup matmul alone (43 windows batched dot) ---
+tab = ec.comb_table_f32()
+u = rand_limbs()
+@jax.jit
+def comb_lookup(u_can):
+    cd = jnp.stack(ec.comb_digits(u_can))
+    tabr = jnp.asarray(tab).reshape(ec.COMB_WINDOWS, ec.COMB_ENTRIES, 2*ff.L)
+    iota = jnp.arange(ec.COMB_ENTRIES, dtype=jnp.int32).reshape(1, ec.COMB_ENTRIES, 1)
+    onehot = (iota == cd[:, None, :]).astype(jnp.float32)
+    sel = lax.dot_general(tabr, onehot,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        precision=lax.Precision.HIGHEST).astype(jnp.int32)
+    return sel
+timeit("comb onehot lookup (43w)", comb_lookup, u, scale=B)
+
+# --- full comb accumulate ---
+@jax.jit
+def comb_acc(u_can):
+    return ec.comb_accumulate(tab, u_can, (B,))
+timeit("comb_accumulate (43 adds)", comb_acc, u, scale=B)
+
+# --- batched inversion ---
+@jax.jit
+def invt(a):
+    return ec.fn.inv_tree(a)
+timeit("inv_tree (fn)", invt, a, scale=B)
+
+# --- full generic verify (jitted words path) ---
+from fabric_tpu.ops import p256
+items_r = rng.integers(0, 1<<32, size=(8, B), dtype=np.int64).astype(np.uint32)
+def mkwords(): return jnp.asarray(items_r)
+qx, qy, r, s, e = (mkwords() for _ in range(5))
+low_s = True
+@jax.jit
+def gen_verify(qx, qy, r, s, e):
+    args = [bn.words_be_to_limbs(v) for v in (qx, qy, r, s, e)]
+    return ec.verify_body(*args, tab, require_low_s=low_s)
+timeit("generic verify_body", gen_verify, qx, qy, r, s, e, n=3, scale=B)
+
+# --- fast-path multikey verify (NK=4) ---
+from fabric_tpu.ops import p256_fixed, p256_tables
+NK = 4
+priv = [int(rng.integers(1, 2**63)) for _ in range(NK)]
+tabs = np.stack([p256_tables.comb_table_for_point(
+    *ec._aff_mul(p, (ec.GX, ec.GY))) for p in priv]).astype(np.float32)
+key_idx = jnp.asarray(rng.integers(0, NK, size=B, dtype=np.int64).astype(np.int32))
+@jax.jit
+def fast_verify(tabs, key_idx, r, s, e):
+    return p256_fixed.verify_words_multikey(tabs, key_idx, r, s, e)
+timeit("fast multikey verify (NK=4)", fast_verify, jnp.asarray(tabs), key_idx, r, s, e, n=3, scale=B)

@@ -24,7 +24,7 @@ HASH_SHA3_256 = "sha3_256"
 HASH_SHA3_384 = "sha3_384"
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class VerifyItem:
     """One signature-verification work item.
 

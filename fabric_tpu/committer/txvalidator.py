@@ -35,7 +35,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from fabric_tpu.bccsp import VerifyItem
+from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
 from fabric_tpu.msp import Identity
 from fabric_tpu.policy import PolicyEvaluator, SignaturePolicy, SignedData
 from fabric_tpu.protocol import (
@@ -49,6 +49,28 @@ from fabric_tpu.protocol.txflags import TxFlags, ValidationCode
 from fabric_tpu.protocol.types import META_TXFLAGS, TX_CONFIG, TX_ENDORSER
 
 logger = logging.getLogger("fabric_tpu.committer")
+
+# C pass-1 walker (fabric_tpu/native/fastcollect.c): decodes envelopes,
+# checks structure/txid, and splices the signed byte spans without
+# materializing Python object trees — the single-core answer to the
+# reference's per-tx goroutine fan-out (validator.go:194-209).  The
+# pure-Python path below stays as the no-compiler fallback and the
+# differential oracle (tests/test_committer.py).
+try:
+    from fabric_tpu.native import load as _load_native
+    _fastcollect = _load_native("_fastcollect")
+except Exception:               # pragma: no cover - broken toolchain
+    _fastcollect = None
+
+# fastcollect error-code -> ValidationCode (must match fastcollect.c)
+_FC_CODES = {
+    1: ValidationCode.NIL_ENVELOPE,
+    2: ValidationCode.BAD_PAYLOAD,
+    3: ValidationCode.TARGET_CHAIN_NOT_FOUND,
+    4: ValidationCode.BAD_PROPOSAL_TXID,
+    5: ValidationCode.UNKNOWN_TX_TYPE,
+    6: ValidationCode.NIL_TXACTION,
+}
 
 
 class PolicyRegistry:
@@ -68,7 +90,7 @@ class PolicyRegistry:
         return self._policies.get(namespace, self._default)
 
 
-@dataclass
+@dataclass(slots=True)
 class _TxWork:
     """Collected verification workload for one transaction."""
     tx_num: int
@@ -277,6 +299,105 @@ class TxValidator:
                 work.namespaces.append((ns, pol, sigset))
         return work
 
+    def _collect_tx_fast(self, tx_num: int, rec, flags: TxFlags,
+                         seen_txids: Dict[str, int],
+                         items: Dict[Tuple, VerifyItem],
+                         memo: dict, n_txs: int = 1) -> Optional[_TxWork]:
+        """Pass-1 tail for one tx whose structural walk already ran in C
+        (fastcollect.collect).  Must reproduce _collect_tx's decisions
+        exactly — tests run both paths differentially."""
+        if isinstance(rec, int):
+            # pre-registration structural failure: the txid never
+            # entered seen_txids on the Python path either
+            flags.set(tx_num, _FC_CODES[rec])
+            return None
+        if len(rec) == 2:
+            # post-registration failure (unknown type / nil action /
+            # malformed body AFTER a valid txid): the Python path
+            # registers the txid BEFORE flagging, so later duplicates
+            # still read DUPLICATE_TXID — bitmaps must not diverge
+            # between the C and no-compiler paths
+            code, txid = rec
+            if txid in seen_txids or self.ledger_has_txid(txid):
+                flags.set(tx_num, ValidationCode.DUPLICATE_TXID)
+                return None
+            seen_txids[txid] = tx_num
+            flags.set(tx_num, _FC_CODES[code])
+            return None
+        txtype, txid, creator_bytes, payload, pdigest, signature, actions = rec
+        if txid in seen_txids or self.ledger_has_txid(txid):
+            flags.set(tx_num, ValidationCode.DUPLICATE_TXID)
+            return None
+        seen_txids[txid] = tx_num
+
+        if txtype == 0 and n_txs != 1:
+            flags.set(tx_num, ValidationCode.INVALID_CONFIG_TRANSACTION)
+            return None
+        work = _TxWork(tx_num)
+
+        # creator identity: deserialize + chain-validate, memoized per
+        # block (the msp/cache role for this hot loop)
+        ckey = (0, creator_bytes)
+        creator = memo.get(ckey, memo)
+        if creator is memo:
+            creator = self._deserialize(creator_bytes)
+            if creator is not None and not _msp_validates(self.msps, creator):
+                creator = None
+            memo[ckey] = creator
+        if creator is None:
+            flags.set(tx_num, ValidationCode.BAD_CREATOR_SIGNATURE)
+            return None
+        if getattr(creator, "scheme", None) == SCHEME_P256:
+            item = VerifyItem(SCHEME_P256, creator._pub_wire, signature,
+                              pdigest)
+        else:      # ed25519 (raw message) or idemix (own item shape)
+            item = creator.verify_item(payload, signature)
+        key = self._item_key(item)
+        items.setdefault(key, item)
+        work.creator_key = key
+        work.creator_identity = creator
+
+        if txtype == 0:
+            return work
+
+        for cc_id, endorsed, endorsements, ns_writes, meta in actions:
+            namespaces = {cc_id}
+            for ns, keys in ns_writes:
+                namespaces.add(ns)
+                prev = work.written_keys.get(ns, ())
+                work.written_keys[ns] = prev + tuple(keys)
+            for base, k, v in meta:
+                namespaces.add(base)
+                work.meta_writes.append((base, k, v))
+            sigset: List[Tuple[Tuple, Identity]] = []
+            seen_idents = set()
+            for endorser, esig, edigest in endorsements:
+                if endorser in seen_idents:   # policy.go:385-387 dedup
+                    continue
+                seen_idents.add(endorser)
+                ekey = (1, endorser)
+                ident = memo.get(ekey, memo)
+                if ident is memo:
+                    ident = self._deserialize(endorser)
+                    memo[ekey] = ident
+                if ident is None:
+                    continue
+                if getattr(ident, "scheme", None) == SCHEME_P256:
+                    it = VerifyItem(SCHEME_P256, ident._pub_wire, esig,
+                                    edigest)
+                else:
+                    it = ident.verify_item(endorsed + endorser, esig)
+                k = self._item_key(it)
+                items.setdefault(k, it)
+                sigset.append((k, ident))
+            for ns in sorted(namespaces):
+                pol = self.policies.policy_for(ns)
+                if pol is None:
+                    flags.set(tx_num, ValidationCode.INVALID_CHAINCODE)
+                    return None
+                work.namespaces.append((ns, pol, sigset))
+        return work
+
     # -- pass 2: gate + evaluate --------------------------------------------
 
     def _gate_tx(self, work: _TxWork, flags: TxFlags,
@@ -329,10 +450,27 @@ class TxValidator:
     # -- the block entry point (validator.go:181) ---------------------------
 
     def validate(self, block: Block) -> ValidationResult:
+        return self.validate_finish(self.validate_begin(block))
+
+    def validate_begin(self, block: Block) -> dict:
+        """Pass 1 + async device enqueue for one block; returns the
+        in-flight state for validate_finish.
+
+        Splitting begin/finish lets a block-stream driver overlap host
+        collection of block N+1 with device verification of block N
+        (BASELINE config 5's 32-block streamed window; the reference
+        has no analogue — its validator is synchronous per block)."""
         self._msps_snapshot = (self.bundle_source.current().msps
                                if self.bundle_source is not None else None)
         try:
-            return self._validate_inner(block)
+            return self._begin_inner(block)
+        finally:
+            self._msps_snapshot = None
+
+    def validate_finish(self, state: dict) -> ValidationResult:
+        self._msps_snapshot = state["msps"]
+        try:
+            return self._finish_inner(state)
         finally:
             self._msps_snapshot = None
 
@@ -350,7 +488,7 @@ class TxValidator:
         return int(os.environ.get("FABRIC_TPU_VALIDATE_CHUNK",
                                   "1000000000"))
 
-    def _validate_inner(self, block: Block) -> ValidationResult:
+    def _begin_inner(self, block: Block) -> dict:
         n = len(block.data)
         flags = TxFlags(n)
 
@@ -372,20 +510,43 @@ class TxValidator:
                         [items[k] for k in new]), new))
                 flushed = len(keys)
 
-        for tx_num, env_bytes in enumerate(block.data):
-            work = self._collect_tx(tx_num, env_bytes, flags, seen_txids,
-                                    items, n_txs=n)
-            if work is not None:
-                works.append(work)
-            if (tx_num + 1) % chunk == 0:
-                flush()
+        use_fast = (_fastcollect is not None
+                    and not getattr(self, "force_python_collect", False))
+        if use_fast:
+            memo: dict = {}
+            recs = _fastcollect.collect(block.data, self.channel_id)
+            for tx_num, rec in enumerate(recs):
+                work = self._collect_tx_fast(tx_num, rec, flags, seen_txids,
+                                             items, memo, n_txs=n)
+                if work is not None:
+                    works.append(work)
+                if (tx_num + 1) % chunk == 0:
+                    flush()
+        else:
+            for tx_num, env_bytes in enumerate(block.data):
+                work = self._collect_tx(tx_num, env_bytes, flags, seen_txids,
+                                        items, n_txs=n)
+                if work is not None:
+                    works.append(work)
+                if (tx_num + 1) % chunk == 0:
+                    flush()
         flush()
-        collect_s = time.perf_counter() - t0
+        return {"block": block, "flags": flags, "items": items,
+                "works": works, "resolvers": resolvers,
+                "msps": self._msps_snapshot,
+                "collect_s": time.perf_counter() - t0}
+
+    def _finish_inner(self, state: dict) -> ValidationResult:
+        block = state["block"]
+        flags = state["flags"]
+        items = state["items"]
+        works = state["works"]
+        collect_s = state["collect_s"]
 
         t0 = time.perf_counter()
         keys = list(items.keys())
         verdict: Dict[Tuple, bool] = {}
-        for resolve, chunk_keys in resolvers:
+        for resolve, chunk_keys in state["resolvers"]:
             out = resolve()
             verdict.update(
                 (k, bool(v)) for k, v in zip(chunk_keys, out))
@@ -413,8 +574,9 @@ class TxValidator:
         logger.info(
             "[%s] validated block %d: %d/%d valid | collect=%.1fms "
             "dispatch=%.1fms (%d uniq sigs) gate=%.1fms",
-            self.channel_id, block.header.number, flags.valid_count(), n,
-            collect_s * 1e3, dispatch_s * 1e3, len(keys), gate_s * 1e3)
+            self.channel_id, block.header.number, flags.valid_count(),
+            len(block.data), collect_s * 1e3, dispatch_s * 1e3, len(keys),
+            gate_s * 1e3)
         return ValidationResult(flags, collect_s, dispatch_s, gate_s,
                                 n_refs, len(keys))
 

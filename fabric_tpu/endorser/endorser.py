@@ -47,13 +47,18 @@ class Endorser:
                  transient_store=None, pvt_store=None, distribute=None,
                  ledger_height=None,
                  endorsement_plugin: str = "DefaultEndorsement",
-                 auth_filters=("ExpirationCheck",)):
+                 auth_filters=("ExpirationCheck",), acl=None):
         self.channel_id = channel_id
         self.db = db
         self.registry = registry
         self.msps = msps
         self.signer = signer
         self.proposal_acl = proposal_acl
+        # aclmgmt provider: when set, the proposal gate is the
+        # "peer/Propose" resource policy from the channel config
+        # (core/endorser ACL check through core/aclmgmt); proposal_acl
+        # stays as the static fallback
+        self.acl = acl
         self.evaluator = PolicyEvaluator(msps, provider)
         # pluggable handlers (core/handlers/library/registry.go): named
         # auth filters run before simulation; the endorsement plugin
@@ -123,8 +128,13 @@ class Endorser:
                 flt(prop, creator)
             except Exception as e:
                 raise EndorserError(f"auth filter rejected: {e}") from e
-        if self.proposal_acl is not None:
-            sd = SignedData(sp.proposal_bytes, sh.creator, sp.signature)
+        sd = SignedData(sp.proposal_bytes, sh.creator, sp.signature)
+        if self.acl is not None:
+            try:
+                self.acl.check_acl("peer/Propose", sd)
+            except PermissionError as e:
+                raise EndorserError(str(e)) from e
+        elif self.proposal_acl is not None:
             if not self.evaluator.evaluate_signed_data(self.proposal_acl, [sd]):
                 raise EndorserError("creator fails proposal ACL policy")
         return prop, sh.creator

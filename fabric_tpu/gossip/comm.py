@@ -177,6 +177,54 @@ class SecureGossipTransport:
             self._conns.clear()
 
 
+class ChannelMux:
+    """Channel multiplexer over ONE underlying transport.
+
+    The reference runs one gossip instance per peer with per-CHANNEL
+    state inside it (gossip/gossip_impl.go channel registry); here each
+    channel keeps its own GossipNode, and this mux lets them share one
+    authenticated transport: outbound messages carry a "_ch" tag,
+    inbound messages route to the owning channel's handler.  Untagged
+    messages route to the default (bootstrap) channel.
+    """
+
+    def __init__(self, transport, default_channel: str):
+        self.transport = transport
+        self.default_channel = default_channel
+        self._handlers: Dict[str, Handler] = {}
+        self._started = False
+        self._lock = threading.Lock()
+
+    def _route(self, msg_type: str, frm: str, body: dict) -> None:
+        ch = body.pop("_ch", None) or self.default_channel
+        handler = self._handlers.get(ch)
+        if handler is not None:
+            handler(msg_type, frm, body)
+
+    def register_for(self, channel_id: str):
+        """-> a `register(peer_id, handler)` callable for GossipNode."""
+        mux = self
+
+        class _Facade:
+            id = self.transport.id
+
+            @staticmethod
+            def send(to: str, msg_type: str, body: dict) -> None:
+                tagged = dict(body)
+                tagged["_ch"] = channel_id
+                mux.transport.send(to, msg_type, tagged)
+
+        def register(peer_id, handler):
+            with mux._lock:
+                mux._handlers[channel_id] = handler
+                if not mux._started:
+                    mux.transport.start(mux._route)
+                    mux._started = True
+            return _Facade()
+
+        return register
+
+
 class TcpTransport:
     """Real-socket endpoint: serde frames over TCP, handler per message.
 

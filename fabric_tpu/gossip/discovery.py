@@ -56,13 +56,21 @@ class Discovery:
 
     def tick(self) -> None:
         """One heartbeat period: send alive to known members (and
-        bootstrap anchors), then expire the silent."""
+        bootstrap anchors), probe one peer for ITS membership view
+        (transitive learning — the reference's MembershipRequest
+        exchange, gossip/discovery/discovery_impl.go), then expire the
+        silent."""
         self._tick += 1
         self._seq += 1
         body = self._alive_body()
-        for to in set(self.alive_ids()) | set(self._bootstrap):
+        targets = sorted(set(self.alive_ids()) | set(self._bootstrap))
+        for to in targets:
             if to != self.id:
                 self.endpoint.send(to, MSG_ALIVE, body)
+        peers = [t for t in targets if t != self.id]
+        if peers:
+            self.endpoint.send(peers[self._tick % len(peers)],
+                               MSG_MEMBERSHIP_REQ, {})
         self._expire()
 
     def _alive_body(self) -> dict:

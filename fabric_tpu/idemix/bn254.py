@@ -194,15 +194,64 @@ def g1_add(a: G1Point, b: G1Point) -> G1Point:
     return (x3, (lam * (a[0] - x3) - a[1]) % P)
 
 
+def _jac_dbl(p):
+    X1, Y1, Z1 = p
+    A = X1 * X1 % P
+    B = Y1 * Y1 % P
+    C = B * B % P
+    Dv = 2 * ((X1 + B) * (X1 + B) - A - C) % P
+    E = 3 * A % P
+    X3 = (E * E - 2 * Dv) % P
+    Y3 = (E * (Dv - X3) - 8 * C) % P
+    Z3 = 2 * Y1 * Z1 % P
+    return X3, Y3, Z3
+
+
+def _jac_add_aff(p, q):
+    """Jacobian + affine (q), None handling by the caller."""
+    X1, Y1, Z1 = p
+    x2, y2 = q
+    Z1Z1 = Z1 * Z1 % P
+    U2 = x2 * Z1Z1 % P
+    S2 = y2 * Z1 * Z1Z1 % P
+    H = (U2 - X1) % P
+    r = (S2 - Y1) % P
+    if H == 0:
+        if r == 0:
+            return _jac_dbl(p)
+        return None
+    HH = H * H % P
+    HHH = H * HH % P
+    V = X1 * HH % P
+    X3 = (r * r - HHH - 2 * V) % P
+    Y3 = (r * (V - X3) - Y1 * HHH) % P
+    Z3 = Z1 * H % P
+    return X3, Y3, Z3
+
+
 def g1_mul(k: int, pt: G1Point) -> G1Point:
+    """Scalar mult with Jacobian accumulation and a single final
+    inversion (the round-3 affine double-and-add paid a ~256-bit modexp
+    inversion per BIT — the t1/t2 recomputation of every idemix
+    presentation runs ~8 of these, so this is the host hot path)."""
     k %= R
-    acc = None
-    while k:
-        if k & 1:
-            acc = g1_add(acc, pt)
-        pt = g1_add(pt, pt)
-        k >>= 1
-    return acc
+    if k == 0 or pt is None:
+        return None
+    acc = None                       # jacobian accumulator, MSB-first
+    for bit in bin(k)[2:]:
+        if acc is not None:
+            acc = _jac_dbl(acc)
+        if bit == "1":
+            acc = ((pt[0], pt[1], 1) if acc is None
+                   else _jac_add_aff(acc, pt))
+    if acc is None:
+        return None
+    X, Y, Z = acc
+    if Z == 0:
+        return None
+    zi = pow(Z, P - 2, P)
+    zi2 = zi * zi % P
+    return X * zi2 % P, Y * zi2 % P * zi % P
 
 
 def g1_neg(a: G1Point) -> G1Point:

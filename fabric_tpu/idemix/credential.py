@@ -186,17 +186,35 @@ def present(ipk: IssuerPublicKey, cred: Credential,
 def verify_presentation(ipk: IssuerPublicKey, pres: Presentation,
                         nonce: bytes, epoch_pk=None,
                         rh_index: Optional[int] = None) -> bool:
+    ok, pair = verify_presentation_parts(ipk, pres, nonce,
+                                         epoch_pk=epoch_pk,
+                                         rh_index=rh_index)
+    if not ok:
+        return False
+    a_prime, a_bar = pair
+    # (1) pairing check: e(A', w) == e(A_bar, g2) — host path; the TPU
+    # provider batches this equation instead (ops/bn254_batch.py
+    # pairing_check_batch)
+    return bn.pairing(a_prime, ipk.w) == bn.pairing(a_bar, bn.G2_GEN)
+
+
+def verify_presentation_parts(ipk: IssuerPublicKey, pres: Presentation,
+                              nonce: bytes, epoch_pk=None,
+                              rh_index: Optional[int] = None):
+    """Everything in verify_presentation EXCEPT the pairing equation.
+
+    Returns (ok, (A_prime, A_bar)): when ok, the presentation is valid
+    iff e(A_prime, w) == e(A_bar, g2) — the caller either checks it on
+    host or collects it into the TPU pairing batch (BASELINE config 4).
+    """
     # reject (never crash on) degenerate attacker-supplied points
     if any(p is None for p in (pres.A_prime, pres.A_bar, pres.d)):
-        return False
-    # invalid-curve gate: the group ops and the Tate pairing operate
-    # blindly on off-curve coordinates; soundness requires membership
+        return False, None
+    # invalid-curve gate: the group ops and the pairing operate blindly
+    # on off-curve coordinates; soundness requires membership
     if not all(bn.g1_on_curve(p)
                for p in (pres.A_prime, pres.A_bar, pres.d)):
-        return False
-    # (1) pairing check: e(A', w) == e(A_bar, g2)
-    if bn.pairing(pres.A_prime, ipk.w) != bn.pairing(pres.A_bar, bn.G2_GEN):
-        return False
+        return False, None
     # (2) recompute t1: t1 = -z_e*A' + z_r2*h0 - c*(A_bar - d)
     abar_minus_d = bn.g1_add(pres.A_bar, bn.g1_neg(pres.d))
     t1 = bn.g1_add(
@@ -209,17 +227,17 @@ def verify_presentation(ipk: IssuerPublicKey, pres: Presentation,
                    bn.g1_mul((-pres.z_sprime) % bn.R, ipk.h[0]))
     for i, z in pres.z_hidden.items():
         if i in pres.disclosed or not 0 <= i < ipk.n_attrs:
-            return False
+            return False, None
         t2 = bn.g1_add(t2, bn.g1_mul((-z) % bn.R, ipk.h[i + 1]))
     if set(pres.z_hidden) | set(pres.disclosed) != set(range(ipk.n_attrs)):
-        return False
+        return False, None
     pub = bn.G1_GEN
     for i, m in pres.disclosed.items():
         pub = bn.g1_add(pub, bn.g1_mul(m, ipk.h[i + 1]))
     t2 = bn.g1_add(t2, bn.g1_mul((-pres.c) % bn.R, pub))
 
     if t1 is None or t2 is None:
-        return False
+        return False, None
     # (4) non-revocation (when the channel requires an epoch_pk):
     # recompute the weak-BB commitment from the shared rh response —
     # the joint challenge below then binds it to THIS credential
@@ -232,11 +250,13 @@ def verify_presentation(ipk: IssuerPublicKey, pres: Presentation,
             if (not isinstance(pres.nonrev, dict) or rh_index is None
                     or rh_index not in pres.z_hidden
                     or pres.nonrev.get("epoch") != epoch_pk.epoch):
-                return False
+                return False, None
             extra = rev.nonrev_commitment_parts(
                 epoch_pk, pres.nonrev, pres.c, pres.z_hidden[rh_index])
             if extra is None:
-                return False
+                return False, None
     c = _hash_zr(pres.A_prime, pres.A_bar, pres.d, t1, t2, *extra, nonce,
                  repr(sorted(pres.disclosed.items())).encode())
-    return c == pres.c
+    if c != pres.c:
+        return False, None
+    return True, (pres.A_prime, pres.A_bar)

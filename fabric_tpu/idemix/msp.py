@@ -200,6 +200,23 @@ def _config_key(config: IdemixMSPConfig) -> bytes:
 def verify_item_host(item: VerifyItem) -> bool:
     """Host-side verification of one idemix VerifyItem (the provider
     plane's scheme handler)."""
+    ok, ipk_bytes, pair = collect_item_parts(item)
+    if not ok:
+        return False
+    from . import bn254 as bn
+    ipk = deserialize_ipk(ipk_bytes)
+    a_prime, a_bar = pair
+    return bn.pairing(a_prime, ipk.w) == bn.pairing(a_bar, bn.G2_GEN)
+
+
+def collect_item_parts(item: VerifyItem):
+    """Everything host-side EXCEPT the pairing equation.
+
+    -> (ok, ipk_bytes, (A_prime, A_bar)).  When ok, the item is valid
+    iff e(A_prime, w_ipk) == e(A_bar, g2) — the TPU provider batches
+    that check per issuer (ops/bn254_batch.pairing_check_batch,
+    BASELINE config 4); verify_item_host checks it with host ints.
+    """
     try:
         outer = serde.decode(item.pubkey)
         kd = serde.decode(outer["cfg"])
@@ -208,7 +225,7 @@ def verify_item_host(item: VerifyItem) -> bool:
         ipk = deserialize_ipk(kd["ipk"])
         pres = deserialize_presentation(item.signature)
     except Exception:
-        return False
+        return False, None, None
     epoch_pk = None
     if kd.get("epoch"):
         try:
@@ -216,22 +233,25 @@ def verify_item_host(item: VerifyItem) -> bool:
             epoch_pk = rev.EpochPK(int(ed["epoch"]), int(ed["alg"]),
                                    ed["w"], ed["sig"])
         except Exception:
-            return False
+            return False, None, None
         if not rev.verify_epoch_pk(epoch_pk, kd["ra"]):
-            return False
+            return False, None, None
     # the presentation must disclose exactly OU+role, and they must
     # MATCH the identity's claims — the binding between the anonymous
     # credential and what policy evaluation believes about it
     if pres.disclosed != {ATTR_OU: attr_int(claimed_ou.encode()),
                           ATTR_ROLE: claimed_role}:
-        return False
+        return False, None, None
     try:
-        return cred.verify_presentation(ipk, pres, item.payload,
-                                        epoch_pk=epoch_pk, rh_index=ATTR_RH)
+        ok, pair = cred.verify_presentation_parts(
+            ipk, pres, item.payload, epoch_pk=epoch_pk, rh_index=ATTR_RH)
     except Exception:
         # attacker-shaped structures must yield False, never crash the
         # batch path (policy.go:390-393 per-signature failure semantics)
-        return False
+        return False, None, None
+    if not ok:
+        return False, None, None
+    return True, kd["ipk"], pair
 
 
 # -- the MSP -----------------------------------------------------------------

@@ -62,6 +62,16 @@ class UpdateBatch:
         return len(self._updates)
 
 
+def _doc_of(value) -> Optional[dict]:
+    """Parse a state value as a JSON document; None when not one."""
+    import json as _json
+    try:
+        doc = _json.loads(value.decode("utf-8"))
+    except (ValueError, UnicodeDecodeError, AttributeError):
+        return None
+    return doc if isinstance(doc, dict) else None
+
+
 def _match_selector(doc: dict, selector: dict) -> bool:
     """Mango-selector subset evaluation (implicit AND across fields)."""
     for field_name, cond in selector.items():
@@ -99,6 +109,61 @@ def _match_selector(doc: dict, selector: dict) -> bool:
     return True
 
 
+def _index_sort_key(v):
+    """Type-tagged sort key for an indexable scalar, or None when the
+    value is not indexable.  Numbers (incl. bool — Python equality
+    semantics, which _match_selector uses) share one collation class;
+    strings another."""
+    if isinstance(v, (int, float)) and not isinstance(v, complex):
+        try:
+            return (0, float(v))
+        except (OverflowError, ValueError):
+            return None
+    if isinstance(v, str):
+        return (1, v)
+    return None
+
+
+class _FieldIndex:
+    """Sorted (sort_key, key) entries for one (namespace, field).
+
+    Lossy float collation is fine: index lookups return a SUPERSET of
+    candidates (inclusive bounds) and execute_query re-checks each doc
+    with the exact selector — mirroring how the reference's CouchDB
+    indexes only narrow the scan (statecouchdb query with index hint).
+    """
+
+    def __init__(self):
+        self.by_key: Dict[str, tuple] = {}      # key -> sort_key
+        self.sorted: List[Tuple[tuple, str]] = []
+
+    def remove(self, key: str) -> None:
+        sk = self.by_key.pop(key, None)
+        if sk is not None:
+            i = bisect.bisect_left(self.sorted, (sk, key))
+            if i < len(self.sorted) and self.sorted[i] == (sk, key):
+                self.sorted.pop(i)
+
+    def put(self, key: str, value) -> None:
+        self.remove(key)
+        sk = _index_sort_key(value)
+        if sk is not None:
+            self.by_key[key] = sk
+            bisect.insort(self.sorted, (sk, key))
+
+    def candidates(self, lo, hi) -> List[str]:
+        """Keys whose sort key is within [lo, hi] (inclusive; None =
+        unbounded on that side)."""
+        i = 0 if lo is None else bisect.bisect_left(self.sorted, (lo,))
+        if hi is None:
+            j = len(self.sorted)
+        else:
+            j = bisect.bisect_right(self.sorted, (hi,))
+            while j < len(self.sorted) and self.sorted[j][0] == hi:
+                j += 1
+        return [k for _, k in self.sorted[i:j]]
+
+
 class StateDB:
     """Versioned state store (VersionedDB iface, statedb.go)."""
 
@@ -111,6 +176,12 @@ class StateDB:
         self._sorted_keys: List[Tuple[str, str]] = []
         self._savepoint: Optional[int] = None
         self._batches_since_snapshot = 0
+        # field indexes: (ns, field) -> _FieldIndex, maintained at every
+        # apply_updates (the statecouchdb index slot — reference indexes
+        # ship in chaincode META-INF/statedb/couchdb/indexes and are
+        # created at deploy; here create_index is called at chaincode
+        # install, node/peer.py)
+        self._indexes: Dict[Tuple[str, str], _FieldIndex] = {}
         if root is not None:
             os.makedirs(root, exist_ok=True)
             self._recover()
@@ -141,11 +212,102 @@ class StateDB:
                     break
         return iter(out)
 
-    def execute_query(self, ns: str, selector: dict, limit: int = 0):
+    # -- field indexes + rich queries ---------------------------------------
+
+    def create_index(self, ns: str, field: str) -> None:
+        """Register (and build from current state) a field index for a
+        namespace.  Idempotent — peers re-register at startup and the
+        index rebuilds from the recovered state."""
+        with self._lock:
+            idx_key = (ns, field)
+            idx = _FieldIndex()
+            self._indexes[idx_key] = idx
+            lo = bisect.bisect_left(self._sorted_keys, (ns, ""))
+            for i in range(lo, len(self._sorted_keys)):
+                kns, key = self._sorted_keys[i]
+                if kns != ns:
+                    break
+                doc = _doc_of(self._data[(kns, key)].value)
+                if doc is not None:
+                    idx.put(key, doc.get(field))
+
+    def indexes_for(self, ns: str) -> List[str]:
+        with self._lock:
+            return [f for (n, f) in self._indexes if n == ns]
+
+    def _index_candidates(self, ns: str, selector: dict):
+        """Planner: if some top-level selector field is indexed with an
+        index-coverable condition, return the candidate key list (a
+        SUPERSET of matches, re-checked by the caller); else None.
+
+        Coverable: scalar $eq / bare equality, $gt/$gte/$lt/$lte, and
+        $in over scalars — conditions a field-missing or non-scalar
+        document can never satisfy.  ($ne and friends match missing
+        fields, so they cannot be served from the index alone.)
+        """
+        for field_name, cond in selector.items():
+            if field_name.startswith("$"):
+                continue
+            idx = self._indexes.get((ns, field_name))
+            if idx is None:
+                continue
+            if not isinstance(cond, dict):
+                sk = _index_sort_key(cond)
+                if sk is None:
+                    continue
+                return idx.candidates(sk, sk)
+            lo = hi = None
+            usable = False
+            bad = False
+            for op, want in cond.items():
+                sk = None
+                if op in ("$eq", "$gt", "$gte", "$lt", "$lte"):
+                    sk = _index_sort_key(want)
+                    if sk is None:
+                        bad = True
+                        break
+                if op == "$eq":
+                    lo = sk if lo is None or sk > lo else lo
+                    hi = sk if hi is None or sk < hi else hi
+                    usable = True
+                elif op in ("$gt", "$gte"):
+                    lo = sk if lo is None or sk > lo else lo
+                    usable = True
+                elif op in ("$lt", "$lte"):
+                    hi = sk if hi is None or sk < hi else hi
+                    usable = True
+                elif op == "$in":
+                    if (isinstance(want, (list, tuple))
+                            and all(_index_sort_key(w) is not None
+                                    for w in want)):
+                        out = []
+                        for w in want:
+                            sw = _index_sort_key(w)
+                            out.extend(idx.candidates(sw, sw))
+                        return sorted(set(out))
+            if bad or not usable:
+                continue
+            # inclusive float bounds: candidate superset, exact
+            # re-check downstream (strictness enforced by the matcher)
+            return idx.candidates(lo, hi)
+        return None
+
+    def execute_query(self, ns: str, selector: dict, limit: int = 0,
+                      bookmark: str = ""):
         """Rich query over JSON-document values (the statecouchdb option,
         core/ledger/.../statedb/statecouchdb/statecouchdb.go — Mango
         selector subset: field equality, $gt/$gte/$lt/$lte/$ne/$in, with
         implicit AND across fields and $or for alternatives).
+
+        Field indexes (create_index) make constrained queries sublinear:
+        the planner takes candidates from one indexed field and re-checks
+        the full selector — full-namespace scans only happen for
+        unindexed selectors, like a CouchDB query with no matching index.
+
+        Pagination: results come in key order; `bookmark` resumes AFTER
+        the given key and `limit` caps the page (statecouchdb paginated
+        queries, QueryResultsIteratorWithBookmark).  Use query_page() to
+        also receive the next bookmark.
 
         Values that do not parse as JSON objects simply never match —
         byte-valued keys coexist with document-valued keys, exactly like
@@ -155,23 +317,43 @@ class StateDB:
         re-checked by MVCC phantom protection — rich queries are for
         reads/audit, not for range-protected simulation.
         """
-        import json as _json
-        out = []
+        return iter(self._query(ns, selector, limit, bookmark))
+
+    def query_page(self, ns: str, selector: dict, limit: int,
+                   bookmark: str = ""):
+        """-> (results, next_bookmark); next_bookmark '' when the result
+        set is exhausted."""
+        out = self._query(ns, selector, limit, bookmark)
+        nb = out[-1][0] if (limit and len(out) == limit) else ""
+        return out, nb
+
+    def _query(self, ns: str, selector: dict, limit: int,
+               bookmark: str) -> list:
         with self._lock:
-            items = sorted((k[1], vv) for k, vv in self._data.items()
-                           if k[0] == ns)
-        for key, vv in items:
-            try:
-                doc = _json.loads(vv.value.decode("utf-8"))
-            except (ValueError, UnicodeDecodeError, AttributeError):
+            cand = self._index_candidates(ns, selector)
+            if cand is None:
+                lo = bisect.bisect_left(self._sorted_keys, (ns, ""))
+                keys = []
+                for i in range(lo, len(self._sorted_keys)):
+                    kns, key = self._sorted_keys[i]
+                    if kns != ns:
+                        break
+                    keys.append(key)
+            else:
+                keys = sorted(cand)
+            pairs = [(k, self._data.get((ns, k))) for k in keys
+                     if k > bookmark]
+        out = []
+        for key, vv in pairs:
+            if vv is None:
                 continue
-            if not isinstance(doc, dict):
+            doc = _doc_of(vv.value)
+            if doc is None or not _match_selector(doc, selector):
                 continue
-            if _match_selector(doc, selector):
-                out.append((key, vv))
-                if limit and len(out) >= limit:
-                    break
-        return iter(out)
+            out.append((key, vv))
+            if limit and len(out) >= limit:
+                break
+        return out
 
     @property
     def savepoint(self) -> Optional[int]:
@@ -199,6 +381,7 @@ class StateDB:
                     self._write_snapshot()
 
     def _apply_in_memory(self, batch: UpdateBatch, block_num: int) -> None:
+        ns_indexed = {n for (n, _f) in self._indexes}
         for (ns, key), vv in batch.items():
             k = (ns, key)
             if vv is None:
@@ -207,10 +390,23 @@ class StateDB:
                     i = bisect.bisect_left(self._sorted_keys, k)
                     if i < len(self._sorted_keys) and self._sorted_keys[i] == k:
                         self._sorted_keys.pop(i)
+                if ns in ns_indexed:
+                    for (n, f), idx in self._indexes.items():
+                        if n == ns:
+                            idx.remove(key)
             else:
                 if k not in self._data:
                     bisect.insort(self._sorted_keys, k)
                 self._data[k] = vv
+                if ns in ns_indexed:
+                    doc = _doc_of(vv.value)
+                    for (n, f), idx in self._indexes.items():
+                        if n != ns:
+                            continue
+                        if doc is None:
+                            idx.remove(key)
+                        else:
+                            idx.put(key, doc.get(f))
         self._savepoint = block_num
 
     # -- persistence --------------------------------------------------------

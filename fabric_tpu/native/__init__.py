@@ -7,7 +7,8 @@ first import with the system compiler and cached next to their sources;
 set FABRIC_TPU_NO_NATIVE=1 to force the pure-Python fallbacks.
 
 Current extensions:
-  _ftlv  — the canonical serde codec (fabric_tpu/utils/serde.py contract)
+  _ftlv        — the canonical serde codec (fabric_tpu/utils/serde.py)
+  _fastcollect — txvalidator pass-1 block walker + SHA-256 (SHA-NI)
 """
 
 from __future__ import annotations
@@ -32,7 +33,7 @@ def _build(name: str):
         cc = os.environ.get("CC", "cc")
         inc = sysconfig.get_path("include")
         tmp = so + f".tmp{os.getpid()}"
-        cmd = [cc, "-O2", "-shared", "-fPIC", f"-I{inc}", src, "-o", tmp]
+        cmd = [cc, "-O3", "-shared", "-fPIC", f"-I{inc}", src, "-o", tmp]
         subprocess.run(cmd, check=True, capture_output=True)
         os.replace(tmp, so)    # atomic: concurrent builders race benignly
     return importlib.import_module(f"fabric_tpu.native.{name}")

@@ -238,9 +238,16 @@ static int rd_u32(cur_t *c, uint32_t *out)
     return 0;
 }
 
-/* skip one encoded value; returns 0 ok / -1 malformed */
-static int skip_value(cur_t *c)
+/* Nesting cap: legitimate framework messages are a few levels deep; an
+ * attacker-crafted envelope of ~150k nested lists would otherwise blow
+ * the C stack (the Python fallback raises RecursionError -> BAD_PAYLOAD;
+ * the C walker must degrade identically, never segfault). */
+#define MAX_DEPTH 64
+
+/* skip one encoded value; returns 0 ok / -1 malformed-or-too-deep */
+static int skip_value_d(cur_t *c, int depth)
 {
+    if (depth > MAX_DEPTH) return -1;
     if (c->p >= c->end) return -1;
     uint8_t tag = *c->p++;
     uint32_t n;
@@ -258,7 +265,7 @@ static int skip_value(cur_t *c)
     case 'L':
         if (rd_u32(c, &n) < 0) return -1;
         while (n--)
-            if (skip_value(c) < 0) return -1;
+            if (skip_value_d(c, depth + 1) < 0) return -1;
         return 0;
     case 'D':
         if (rd_u32(c, &n) < 0) return -1;
@@ -267,12 +274,17 @@ static int skip_value(cur_t *c)
             if (rd_u32(c, &kn) < 0
                 || (uint32_t)(c->end - c->p) < kn) return -1;
             c->p += kn;
-            if (skip_value(c) < 0) return -1;
+            if (skip_value_d(c, depth + 1) < 0) return -1;
         }
         return 0;
     default:
         return -1;
     }
+}
+
+static int skip_value(cur_t *c)
+{
+    return skip_value_d(c, 0);
 }
 
 /* Enter a dict ('D'): returns entry count or -1. */
@@ -766,45 +778,54 @@ static PyObject *collect_env(const uint8_t *env, size_t env_n,
             return PyLong_FromLong(E_BAD_TXID);
     }
 
+    /* Failures from here on happen AFTER the txid is known-good: the
+     * Python reference path registers the txid in seen_txids BEFORE
+     * type/body validation, so later duplicates of such a tx must
+     * still flag DUPLICATE_TXID.  These return (code, txid) pairs so
+     * the Python tail can register the txid first — bare-int codes
+     * are strictly pre-registration failures. */
+#define LATE_ERR(code)  Py_BuildValue("(is#)", (code), \
+        (const char *)txid_p, (Py_ssize_t)txid_n)
+
     int is_config = key_is(type_p, type_n, "config");
     if (!is_config && !key_is(type_p, type_n, "endorser_transaction"))
-        return PyLong_FromLong(E_UNKNOWN_TYPE);
+        return LATE_ERR(E_UNKNOWN_TYPE);
 
     PyObject *actions = NULL;
     if (!is_config) {
         /* data: {"actions": [TransactionAction...]} */
         if (!data_p)
-            return PyLong_FromLong(E_BAD_PAYLOAD);
+            return LATE_ERR(E_BAD_PAYLOAD);
         cur_t dc = {data_p, data_end};
         uint32_t nd;
         const uint8_t *acts_p = NULL, *acts_end = NULL;
         if (dict_enter(&dc, &nd) < 0)
-            return PyLong_FromLong(E_BAD_PAYLOAD);
+            return LATE_ERR(E_BAD_PAYLOAD);
         while (nd--) {
             const uint8_t *key; uint32_t klen;
             if (dict_key(&dc, &key, &klen) < 0)
-                return PyLong_FromLong(E_BAD_PAYLOAD);
+                return LATE_ERR(E_BAD_PAYLOAD);
             if (key_is(key, klen, "actions")) {
                 acts_p = dc.p;
                 if (skip_value(&dc) < 0)
-                    return PyLong_FromLong(E_BAD_PAYLOAD);
+                    return LATE_ERR(E_BAD_PAYLOAD);
                 acts_end = dc.p;
             } else {
                 if (skip_value(&dc) < 0)
-                    return PyLong_FromLong(E_BAD_PAYLOAD);
+                    return LATE_ERR(E_BAD_PAYLOAD);
             }
         }
         if (!acts_p)
-            return PyLong_FromLong(E_BAD_PAYLOAD);
+            return LATE_ERR(E_BAD_PAYLOAD);
         cur_t ac = {acts_p, acts_end};
         uint32_t na;
         if (ac.p >= ac.end || *ac.p != 'L')
-            return PyLong_FromLong(E_BAD_PAYLOAD);
+            return LATE_ERR(E_BAD_PAYLOAD);
         ac.p++;
         if (rd_u32(&ac, &na) < 0)
-            return PyLong_FromLong(E_BAD_PAYLOAD);
+            return LATE_ERR(E_BAD_PAYLOAD);
         if (na == 0)
-            return PyLong_FromLong(E_NIL_TXACTION);
+            return LATE_ERR(E_NIL_TXACTION);
         actions = PyList_New(0);
         if (!actions)
             return NULL;
@@ -814,7 +835,7 @@ static PyObject *collect_env(const uint8_t *env, size_t env_n,
             if (!act) {
                 Py_DECREF(actions);
                 if (malformed && !PyErr_Occurred())
-                    return PyLong_FromLong(E_BAD_PAYLOAD);
+                    return LATE_ERR(E_BAD_PAYLOAD);
                 return NULL;
             }
             if (PyList_Append(actions, act) < 0) {

@@ -139,6 +139,9 @@ class OrdererNode:
                                         int(cfg["ops_port"]))
             self.ops.register_checker(
                 "raft", lambda: self.support.chain.node.leader_id is not None)
+            # profiling surface (orderer/common/server/main.go:408 slot)
+            from fabric_tpu.ops_plane.profiling import register_routes
+            register_routes(self.ops, enabled=bool(cfg.get("profiling")))
             self.ops.register_route("GET", "/participation/v1/channels",
                                     self._rest_channels)
             # the ops server is PLAIN HTTP with no client auth, so the

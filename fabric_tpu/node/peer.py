@@ -129,54 +129,46 @@ class RemoteDeliver:
             raise last
 
 
-class PeerNode:
-    """One peer process (library form; `main` wraps it)."""
+class PeerChannel:
+    """One channel's kernel inside a peer process: ledger + validator +
+    committer + endorser + query/privdata/gossip planes + deliver loop.
 
-    def __init__(self, cfg: dict, data_dir: str):
-        self.cfg = cfg
-        self.channel_id = cfg.get("channel_id", "ch")
-        self.provider = init_factories(
-            FactoryOpts(default=cfg.get("bccsp", "SW")))
-        self.signer = load_signing_identity(
-            cfg["mspid"], cfg["cert_pem"].encode(), cfg["key_pem"].encode())
-        self.mspid = cfg["mspid"]
+    The slot of the reference's per-channel wiring in
+    core/peer/peer.go:207-371 CreateChannel — the peer binary hosts N
+    of these with independent ledgers, validators, and config bundles.
+    """
 
-        channel_cfg = ChannelConfig.deserialize(
-            bytes.fromhex(cfg["channel_config_hex"]))
-        # config_height: the block number the bootstrap config was taken
-        # at (0 = genesis).  A peer bootstrapped at a later config MUST
-        # carry it so catch-up replay of older config blocks is
-        # recognized instead of being flagged INVALID (committer.py).
-        self.bundle_source = BundleSource(
-            Bundle(channel_cfg),
-            config_height=int(cfg.get("config_height", 0)))
+    def __init__(self, node: "PeerNode", channel_cfg: ChannelConfig,
+                 ch_dir: str, config_height: int = 0):
+        self.node = node
+        self.channel_id = channel_cfg.channel_id
+        self.bundle_source = BundleSource(Bundle(channel_cfg),
+                                          config_height=config_height)
         self.msps = self.bundle_source.current().msps
-
         self.ledger = KVLedger(self.channel_id,
-                               LedgerConfig(root=f"{data_dir}/ledger"))
+                               LedgerConfig(root=f"{ch_dir}/ledger"))
 
-        # chaincode runtime (dev mode: in-process contracts; external
-        # chaincode processes are handled by chaincode/extcc.py)
-        self.cc_registry = ChaincodeRegistry()
+        cfg = node.cfg
         self.policies = LifecyclePolicyProvider(self.ledger.statedb)
         self._cc_policies: Dict[str, object] = {}
         for cc in cfg.get("chaincodes", []):
-            contract = self._make_contract(cc)
-            self.cc_registry.install(
-                ChaincodeDefinition(cc["name"], cc.get("version", "1.0")),
-                contract)
             if cc.get("policy"):
                 pol = parse_policy(cc["policy"])
                 self.policies.set_policy(cc["name"], pol)
                 self._cc_policies[cc["name"]] = pol
+            # field indexes declared with the chaincode (the reference
+            # ships CouchDB index definitions in the chaincode package's
+            # META-INF/statedb/couchdb/indexes, created at deploy)
+            for field in cc.get("indexes", []):
+                self.ledger.statedb.create_index(cc["name"], field)
 
         self.validator = TxValidator(
-            self.channel_id, None, self.provider, self.policies,
+            self.channel_id, None, node.provider, self.policies,
             bundle_source=self.bundle_source,
             sbe_lookup=statedb_lookup(self.ledger.statedb))
         self.committer = Committer(self.ledger, self.validator,
                                    bundle_source=self.bundle_source,
-                                   provider=self.provider)
+                                   provider=node.provider)
 
         # private data plane
         self.collections = CollectionRegistry()
@@ -188,53 +180,226 @@ class PeerNode:
         self.pvt_store = PvtDataStore()
         self.coordinator = Coordinator(
             self.committer, self.collections, self.transient,
-            self.pvt_store, mspid=self.mspid,
+            self.pvt_store, mspid=node.mspid,
             fetch=self._privdata_fetch_remote)
 
+        # aclmgmt: resource-name -> channel-policy authorization, live
+        # against the bundle so config-tx ACL changes take effect
+        # (core/aclmgmt/aclmgmt.go:15 + resources.go)
+        from fabric_tpu.policy import ACLProvider
+        self.acl = ACLProvider(self.bundle_source, node.provider)
+
         self.endorser = Endorser(
-            self.channel_id, self.ledger.statedb, self.cc_registry,
-            self.msps, self.provider, self.signer,
+            self.channel_id, self.ledger.statedb, node.cc_registry,
+            self.msps, node.provider, node.signer,
             transient_store=self.transient, pvt_store=self.pvt_store,
             distribute=self._privdata_distribute,
-            ledger_height=lambda: self.ledger.height)
+            ledger_height=lambda: self.ledger.height,
+            acl=self.acl)
 
-        # system chaincodes + discovery
-        self.qscc = Qscc(self.channel_id, self.ledger.blockstore)
-        self.cscc = Cscc()
-        self.cscc.register(self.channel_id, self)
-        self.peers = [tuple(p) for p in cfg.get("peers", [])]
-        self.peer_orgs = {tuple(p[:2]): p[2] if len(p) > 2 else None
-                          for p in cfg.get("peers", [])}
+        self.qscc = Qscc(self.channel_id, self.ledger.blockstore,
+                         acl=self.acl)
         self.discovery = DiscoveryService(
-            membership=self._membership,
+            membership=node._membership,
             policy_for=self.policies.policy_for)
-
-        self.orderers = [tuple(o) for o in cfg.get("orderers", [])]
-        self.deliver_client = RemoteDeliver(self.orderers, self.signer,
+        self.deliver_client = RemoteDeliver(node.orderers, node.signer,
                                             self.msps)
 
-        # RPC surface
-        self.rpc = RpcServer(cfg.get("host", "127.0.0.1"), int(cfg["port"]),
-                             self.signer, self.msps)
-
-        # gossip plane on the authenticated transport: membership,
-        # epidemic block dissemination + ordered drain into the
-        # coordinator, certstore pull, leader election
-        from fabric_tpu.gossip.comm import SecureGossipTransport
+        # per-channel gossip node on the SHARED authenticated transport
+        # (gossip/comm.ChannelMux — the reference keys gossip state by
+        # channel inside one instance, gossip_impl.go channel registry)
         from fabric_tpu.gossip.mcs import MessageCryptoService
         from fabric_tpu.gossip.node import GossipNode
 
-        self.mcs = MessageCryptoService(self.msps, self.provider)
-        transport = SecureGossipTransport(self.rpc, self.signer, self.msps)
+        self.mcs = MessageCryptoService(self.msps, node.provider)
+        bootstrap = [f"{p[0]}:{p[1]}" for p in node.peers]
+        self.gossip = GossipNode(
+            node.gossip_mux.register_for(self.channel_id),
+            node.gossip_mux.transport.id, self.coordinator,
+            mcs=self.mcs, signer=node.signer,
+            bootstrap=bootstrap, msps=self.msps)
 
-        def register(peer_id, handler):
-            transport.start(handler)
-            return transport
+        self.deliver_healthy = True
+        self._thread = threading.Thread(target=self._deliver_loop,
+                                        daemon=True)
 
-        bootstrap = [f"{p[0]}:{p[1]}" for p in self.peers]
-        self.gossip = GossipNode(register, transport.id, self.coordinator,
-                                 mcs=self.mcs, signer=self.signer,
-                                 bootstrap=bootstrap, msps=self.msps)
+    # -- privdata client side -------------------------------------------
+
+    def _privdata_distribute(self, txid: str, pvt_sets: dict) -> None:
+        """Push endorsement-time cleartext to collection member peers."""
+        recs = []
+        for (ns, coll), kv in pvt_sets.items():
+            recs.append({"namespace": ns, "collection": coll,
+                         "keys": list(kv.keys()),
+                         "values": [v if v is not None else b""
+                                    for v in kv.values()],
+                         "deleted": [v is None for v in kv.values()]})
+        if not recs:
+            return
+        body = {"txid": txid, "height": self.ledger.height, "sets": recs,
+                "channel": self.channel_id}
+        for addr in self.node.peers:
+            try:
+                conn = connect(tuple(addr[:2]), self.node.signer,
+                               self.msps, timeout=2.0)
+                try:
+                    conn.cast("privdata.push", body)
+                finally:
+                    conn.close()
+            except Exception:
+                logger.debug("privdata push to %s failed", addr,
+                             exc_info=True)
+
+    def _privdata_fetch_remote(self, txid: str, ns: str,
+                               coll: str) -> Optional[dict]:
+        """Reconciliation pull from member peers (reconcile.go)."""
+        for addr in self.node.peers:
+            try:
+                conn = connect(tuple(addr[:2]), self.node.signer,
+                               self.msps, timeout=2.0)
+                try:
+                    out = conn.call("privdata.fetch", {
+                        "txid": txid, "namespace": ns, "collection": coll,
+                        "channel": self.channel_id}, timeout=5.0)
+                finally:
+                    conn.close()
+            except Exception:
+                continue
+            if out.get("found"):
+                return {k: (None if d else v) for k, v, d in
+                        zip(out["keys"], out["values"], out["deleted"])}
+        return None
+
+    # -- deliver / commit loop ------------------------------------------
+
+    def _deliver_loop(self) -> None:
+        from fabric_tpu.orderer.deliver import SeekInfo
+        backoff = 0.2
+        reconcile_at = time.monotonic() + 5.0
+        while not self.node._stop.is_set():
+            height = self.ledger.height
+            try:
+                got = 0
+                for block in self.deliver_client.deliver(
+                        self.channel_id,
+                        SeekInfo(start=height, stop=height + 31,
+                                 behavior="block_until_ready"),
+                        timeout_s=5):
+                    items = block_signature_items(block, self.msps)
+                    if not items or not bool(
+                            self.node.provider.batch_verify(items).all()):
+                        logger.warning("block %d failed orderer-signature "
+                                       "verification; dropping window",
+                                       block.header.number)
+                        break
+                    # through the gossip state plane: fans out to peers
+                    # and drains strictly in block order
+                    self.gossip.state.add_block(block)
+                    got += 1
+                self.deliver_healthy = True
+                backoff = 0.2
+                if not got:
+                    time.sleep(0.1)
+            except Exception:
+                self.deliver_healthy = False
+                logger.debug("deliver pull failed; retrying", exc_info=True)
+                time.sleep(backoff)
+                backoff = min(backoff * 2, 3.0)
+            try:
+                self.gossip.tick()
+            except Exception:
+                logger.exception("gossip tick failed")
+            if time.monotonic() >= reconcile_at:
+                try:
+                    n = self.coordinator.reconcile()
+                    if n:
+                        logger.info("[%s] reconciled %d private "
+                                    "collections", self.channel_id, n)
+                except Exception:
+                    logger.exception("privdata reconcile failed")
+                reconcile_at = time.monotonic() + 5.0
+
+    def start(self) -> None:
+        self._thread.start()
+
+
+class PeerNode:
+    """One peer process hosting N channels (library form; `main` wraps
+    it).  Single-channel attribute surface (ledger/validator/...)
+    delegates to the bootstrap channel."""
+
+    def __init__(self, cfg: dict, data_dir: str):
+        import os
+
+        self.cfg = cfg
+        self.data_dir = data_dir
+        self.channel_id = cfg.get("channel_id", "ch")
+        self.provider = init_factories(
+            FactoryOpts(default=cfg.get("bccsp", "SW")))
+        self.signer = load_signing_identity(
+            cfg["mspid"], cfg["cert_pem"].encode(), cfg["key_pem"].encode())
+        self.mspid = cfg["mspid"]
+
+        channel_cfg = ChannelConfig.deserialize(
+            bytes.fromhex(cfg["channel_config_hex"]))
+
+        self.peers = [tuple(p) for p in cfg.get("peers", [])]
+        self.peer_orgs = {tuple(p[:2]): p[2] if len(p) > 2 else None
+                          for p in cfg.get("peers", [])}
+        self.orderers = [tuple(o) for o in cfg.get("orderers", [])]
+
+        # chaincode runtime, shared across channels (installs are
+        # peer-scoped in the reference too; per-channel policy state
+        # lives in each PeerChannel)
+        self.cc_registry = ChaincodeRegistry()
+        for cc in cfg.get("chaincodes", []):
+            contract = self._make_contract(cc)
+            self.cc_registry.install(
+                ChaincodeDefinition(cc["name"], cc.get("version", "1.0")),
+                contract)
+
+        # RPC + shared gossip transport (ONE bundle: the server and the
+        # transport share the same CachedMSP instances)
+        boot_msps = Bundle(channel_cfg).msps
+        self.rpc = RpcServer(cfg.get("host", "127.0.0.1"), int(cfg["port"]),
+                             self.signer, boot_msps)
+        from fabric_tpu.gossip.comm import ChannelMux, SecureGossipTransport
+        transport = SecureGossipTransport(self.rpc, self.signer, boot_msps)
+        self.gossip_mux = ChannelMux(transport, channel_cfg.channel_id)
+
+        self._stop = threading.Event()
+        self.channels: Dict[str, PeerChannel] = {}
+        self.cscc = Cscc(create_channel=self._cscc_create)
+
+        # bootstrap channel.  config_height: the block number the
+        # bootstrap config was taken at (0 = genesis) — a peer
+        # bootstrapped at a later config MUST carry it so catch-up
+        # replay of older config blocks is recognized (committer.py).
+        # Legacy layout detection keys on the OLD LEDGER ITSELF
+        # (data_dir/ledger) — a stable marker; keying on the channels/
+        # dir would silently relocate the bootstrap ledger after the
+        # first runtime join created it.
+        self._create_channel(channel_cfg,
+                             config_height=int(cfg.get("config_height", 0)),
+                             legacy_dir=os.path.isdir(
+                                 os.path.join(data_dir, "ledger")))
+
+        # restore channels joined at runtime in earlier lives
+        ch_root = os.path.join(data_dir, "channels")
+        if os.path.isdir(ch_root):
+            for entry in sorted(os.listdir(ch_root)):
+                cfg_path = os.path.join(ch_root, entry,
+                                        "channel_config.bin")
+                if entry in self.channels or not os.path.exists(cfg_path):
+                    continue
+                try:
+                    with open(cfg_path, "rb") as f:
+                        joined = ChannelConfig.deserialize(f.read())
+                    self._create_channel(joined)
+                    logger.info("restored joined channel %r", entry)
+                except Exception:
+                    logger.exception("could not restore channel %r", entry)
+
         self.rpc.serve("endorse", self._rpc_endorse)
         self.rpc.serve("status", self._rpc_status)
         self.rpc.serve("qscc.chain_info", self._rpc_chain_info)
@@ -242,6 +407,7 @@ class PeerNode:
         self.rpc.serve("qscc.tx_by_id", self._rpc_tx_by_id)
         self.rpc.serve("cscc.channels", lambda b, p:
                        {"channels": self.cscc.get_channels()})
+        self.rpc.serve("cscc.join", self._rpc_cscc_join)
         self.rpc.serve("discovery.endorsers", self._rpc_discovery)
         self.rpc.serve("privdata.fetch", self._rpc_privdata_fetch)
         self.rpc.serve_cast("privdata.push", self._rpc_privdata_push)
@@ -253,11 +419,143 @@ class PeerNode:
                                         int(cfg["ops_port"]))
             self.ops.register_checker(
                 "deliver", lambda: self._deliver_healthy)
+            # /debug/profile (jax.profiler) + /debug/pprof (host), the
+            # peer.profile.enabled slot (internal/peer/node/start.go:813)
+            from fabric_tpu.ops_plane.profiling import register_routes
+            register_routes(self.ops, enabled=bool(cfg.get("profiling")))
 
-        self._stop = threading.Event()
-        self._deliver_healthy = True
-        self._deliver_thread = threading.Thread(target=self._deliver_loop,
-                                                daemon=True)
+    # -- channel lifecycle ---------------------------------------------------
+
+    def _channel_dir(self, channel_id: str, legacy: bool = False) -> str:
+        import os
+        if legacy:
+            # pre-multichannel layout: the bootstrap channel's ledger
+            # lived at data_dir/ledger
+            return self.data_dir
+        return os.path.join(self.data_dir, "channels", channel_id)
+
+    def _create_channel(self, channel_cfg: ChannelConfig,
+                        config_height: int = 0,
+                        legacy_dir: bool = False) -> PeerChannel:
+        import os
+        cid = channel_cfg.channel_id
+        ch_dir = self._channel_dir(cid, legacy=legacy_dir)
+        os.makedirs(ch_dir, exist_ok=True)
+        if not legacy_dir:
+            cfg_path = os.path.join(ch_dir, "channel_config.bin")
+            if not os.path.exists(cfg_path):
+                tmp = cfg_path + ".tmp"
+                with open(tmp, "wb") as f:
+                    f.write(channel_cfg.serialize())
+                os.replace(tmp, cfg_path)
+        ch = PeerChannel(self, channel_cfg, ch_dir,
+                         config_height=config_height)
+        self.channels[cid] = ch
+        self.cscc.register(cid, ch)
+        if not self._stop.is_set() and getattr(self, "_started", False):
+            ch.start()
+        return ch
+
+    def _cscc_create(self, channel_id: str, channel_config):
+        if isinstance(channel_config, (bytes, bytearray)):
+            channel_config = ChannelConfig.deserialize(bytes(channel_config))
+        if channel_config.channel_id != channel_id:
+            raise ValueError("channel id mismatch")
+        return self._create_channel(channel_config)
+
+    def join_channel(self, channel_cfg: ChannelConfig) -> PeerChannel:
+        """Runtime channel join (cscc JoinChain,
+        core/scc/cscc/configure.go) — a new per-channel kernel in this
+        process."""
+        if channel_cfg.channel_id in self.channels:
+            raise ValueError(
+                f"already joined {channel_cfg.channel_id!r}")
+        return self.cscc.join_chain(channel_cfg.channel_id, channel_cfg)
+
+    def _chan(self, body: dict) -> PeerChannel:
+        cid = body.get("channel") or self.channel_id
+        ch = self.channels.get(cid)
+        if ch is None:
+            raise ValueError(f"peer has not joined channel {cid!r}")
+        return ch
+
+    # -- bootstrap-channel delegation (single-channel API compat) ------------
+
+    @property
+    def _bootstrap(self) -> PeerChannel:
+        return self.channels[self.channel_id]
+
+    @property
+    def bundle_source(self):
+        return self._bootstrap.bundle_source
+
+    @property
+    def msps(self):
+        return self._bootstrap.msps
+
+    @property
+    def ledger(self):
+        return self._bootstrap.ledger
+
+    @property
+    def policies(self):
+        return self._bootstrap.policies
+
+    @property
+    def validator(self):
+        return self._bootstrap.validator
+
+    @property
+    def committer(self):
+        return self._bootstrap.committer
+
+    @property
+    def collections(self):
+        return self._bootstrap.collections
+
+    @property
+    def transient(self):
+        return self._bootstrap.transient
+
+    @property
+    def pvt_store(self):
+        return self._bootstrap.pvt_store
+
+    @property
+    def coordinator(self):
+        return self._bootstrap.coordinator
+
+    @property
+    def acl(self):
+        return self._bootstrap.acl
+
+    @property
+    def endorser(self):
+        return self._bootstrap.endorser
+
+    @property
+    def qscc(self):
+        return self._bootstrap.qscc
+
+    @property
+    def discovery(self):
+        return self._bootstrap.discovery
+
+    @property
+    def deliver_client(self):
+        return self._bootstrap.deliver_client
+
+    @property
+    def gossip(self):
+        return self._bootstrap.gossip
+
+    @property
+    def mcs(self):
+        return self._bootstrap.mcs
+
+    @property
+    def _deliver_healthy(self):
+        return all(ch.deliver_healthy for ch in self.channels.values())
 
     # -- wiring helpers ------------------------------------------------------
 
@@ -295,7 +593,7 @@ class PeerNode:
 
     def _rpc_endorse(self, body: dict, peer_identity) -> dict:
         sp = SignedProposal(body["proposal"], body["signature"])
-        resp = self.endorser.process_proposal(sp)
+        resp = self._chan(body).endorser.process_proposal(sp)
         out = {"status": resp.status, "message": resp.message,
                "payload": resp.payload}
         if resp.endorsement is not None:
@@ -304,38 +602,58 @@ class PeerNode:
         return out
 
     def _rpc_status(self, body: dict, peer_identity) -> dict:
-        return {"mspid": self.mspid, "channel": self.channel_id,
-                "height": self.ledger.height,
-                "commit_hash": (self.ledger.commit_hash or b"").hex()}
+        ch = self._chan(body)
+        return {"mspid": self.mspid, "channel": ch.channel_id,
+                "channels": sorted(self.channels),
+                "height": ch.ledger.height,
+                "commit_hash": (ch.ledger.commit_hash or b"").hex()}
 
     def _rpc_chain_info(self, body: dict, peer_identity) -> dict:
-        return self.qscc.get_chain_info()
+        return self._chan(body).qscc.get_chain_info(peer_identity)
 
     def _rpc_block_by_number(self, body: dict, peer_identity) -> dict:
-        blk = self.qscc.get_block_by_number(int(body["number"]))
+        blk = self._chan(body).qscc.get_block_by_number(
+            int(body["number"]), peer_identity)
         return {"block": blk.serialize()}
 
     def _rpc_tx_by_id(self, body: dict, peer_identity) -> dict:
-        env = self.qscc.get_transaction_by_id(body["txid"])
+        env = self._chan(body).qscc.get_transaction_by_id(
+            body["txid"], peer_identity)
         return {"envelope": env.serialize()}
 
+    def _rpc_cscc_join(self, body: dict, peer_identity) -> dict:
+        """Runtime channel join over RPC (cscc JoinChain,
+        core/scc/cscc/configure.go) — gated by the PEER'S OWN
+        cscc/JoinChain ACL (Admins of the bootstrap channel).  The
+        incoming config must NEVER authorize its own join: it is
+        attacker-supplied, and judging the caller against its MSPs
+        would let anyone self-authorize with a crafted config (the
+        reference checks JoinChain against the local MSP policy)."""
+        self._bootstrap.acl.check("cscc/JoinChain", peer_identity)
+        channel_cfg = ChannelConfig.deserialize(body["config"])
+        ch = self.join_channel(channel_cfg)
+        return {"channel": ch.channel_id, "status": "joined"}
+
     def _rpc_discovery(self, body: dict, peer_identity) -> dict:
-        out = self.discovery.endorsers(body["namespace"])
+        ch = self._chan(body)
+        ch.acl.check("discovery/Discover", peer_identity)
+        out = ch.discovery.endorsers(body["namespace"])
         out["layouts"] = [l.as_dict() for l in out["layouts"]]
         return out
 
     def _rpc_privdata_fetch(self, body: dict, peer_identity) -> dict:
         """Collection pull: ONLY collection-member orgs may read cleartext
         (gossip/privdata/pvtdataprovider.go membership check)."""
+        ch = self._chan(body)
         ns, coll = body["namespace"], body["collection"]
-        cfg = self.collections.get(ns, coll)
+        cfg = ch.collections.get(ns, coll)
         if cfg is None or not cfg.is_member(
                 getattr(peer_identity, "mspid", None)):
             return {"found": False, "denied": True}
-        data = self.pvt_store.get_tx_set(ns, coll, body["txid"])
+        data = ch.pvt_store.get_tx_set(ns, coll, body["txid"])
         if data is None:
             # also try the transient store (pre-commit staging)
-            for sets in self.transient.get(body["txid"]):
+            for sets in ch.transient.get(body["txid"]):
                 if (ns, coll) in sets:
                     data = sets[(ns, coll)]
                     break
@@ -350,111 +668,18 @@ class PeerNode:
     def _rpc_privdata_push(self, body: dict, peer_identity) -> None:
         """Endorsement-time distribution: a member peer pushes cleartext
         into our transient store (gossip/privdata/distributor.go)."""
+        ch = self._chan(body)
         sets = {}
         for rec in body["sets"]:
             ns, coll = rec["namespace"], rec["collection"]
-            cfg = self.collections.get(ns, coll)
+            cfg = ch.collections.get(ns, coll)
             if cfg is None or not cfg.is_member(self.mspid):
                 continue      # we are not a member: refuse cleartext
             sets[(ns, coll)] = {k: (None if d else v) for k, v, d in
                                 zip(rec["keys"], rec["values"],
                                     rec["deleted"])}
         if sets:
-            self.transient.persist(body["txid"], int(body["height"]), sets)
-
-    # -- privdata client side ------------------------------------------------
-
-    def _privdata_distribute(self, txid: str, pvt_sets: dict) -> None:
-        """Push endorsement-time cleartext to collection member peers."""
-        recs = []
-        for (ns, coll), kv in pvt_sets.items():
-            recs.append({"namespace": ns, "collection": coll,
-                         "keys": list(kv.keys()),
-                         "values": [v if v is not None else b""
-                                    for v in kv.values()],
-                         "deleted": [v is None for v in kv.values()]})
-        if not recs:
-            return
-        body = {"txid": txid, "height": self.ledger.height, "sets": recs}
-        for addr in self.peers:
-            try:
-                conn = connect(tuple(addr[:2]), self.signer, self.msps,
-                               timeout=2.0)
-                try:
-                    conn.cast("privdata.push", body)
-                finally:
-                    conn.close()
-            except Exception:
-                logger.debug("privdata push to %s failed", addr,
-                             exc_info=True)
-
-    def _privdata_fetch_remote(self, txid: str, ns: str,
-                               coll: str) -> Optional[dict]:
-        """Reconciliation pull from member peers (reconcile.go)."""
-        for addr in self.peers:
-            try:
-                conn = connect(tuple(addr[:2]), self.signer, self.msps,
-                               timeout=2.0)
-                try:
-                    out = conn.call("privdata.fetch", {
-                        "txid": txid, "namespace": ns, "collection": coll},
-                        timeout=5.0)
-                finally:
-                    conn.close()
-            except Exception:
-                continue
-            if out.get("found"):
-                return {k: (None if d else v) for k, v, d in
-                        zip(out["keys"], out["values"], out["deleted"])}
-        return None
-
-    # -- deliver / commit loop ----------------------------------------------
-
-    def _deliver_loop(self) -> None:
-        from fabric_tpu.orderer.deliver import SeekInfo
-        backoff = 0.2
-        reconcile_at = time.monotonic() + 5.0
-        while not self._stop.is_set():
-            height = self.ledger.height
-            try:
-                got = 0
-                for block in self.deliver_client.deliver(
-                        self.channel_id,
-                        SeekInfo(start=height, stop=height + 31,
-                                 behavior="block_until_ready"),
-                        timeout_s=5):
-                    items = block_signature_items(block, self.msps)
-                    if not items or not bool(
-                            self.provider.batch_verify(items).all()):
-                        logger.warning("block %d failed orderer-signature "
-                                       "verification; dropping window",
-                                       block.header.number)
-                        break
-                    # through the gossip state plane: fans out to peers
-                    # and drains strictly in block order
-                    self.gossip.state.add_block(block)
-                    got += 1
-                self._deliver_healthy = True
-                backoff = 0.2
-                if not got:
-                    time.sleep(0.1)
-            except Exception:
-                self._deliver_healthy = False
-                logger.debug("deliver pull failed; retrying", exc_info=True)
-                time.sleep(backoff)
-                backoff = min(backoff * 2, 3.0)
-            try:
-                self.gossip.tick()
-            except Exception:
-                logger.exception("gossip tick failed")
-            if time.monotonic() >= reconcile_at:
-                try:
-                    n = self.coordinator.reconcile()
-                    if n:
-                        logger.info("reconciled %d private collections", n)
-                except Exception:
-                    logger.exception("privdata reconcile failed")
-                reconcile_at = time.monotonic() + 5.0
+            ch.transient.persist(body["txid"], int(body["height"]), sets)
 
     # -- lifecycle -----------------------------------------------------------
 
@@ -462,8 +687,11 @@ class PeerNode:
         self.rpc.start()
         if self.ops is not None:
             self.ops.start()
-        self._deliver_thread.start()
-        logger.info("peer %s serving on %s", self.mspid, self.rpc.addr)
+        self._started = True
+        for ch in self.channels.values():
+            ch.start()
+        logger.info("peer %s serving on %s (%d channels)", self.mspid,
+                    self.rpc.addr, len(self.channels))
         return self
 
     def stop(self) -> None:

@@ -74,7 +74,7 @@ def gen_ed25519_sigs(n: int, n_keys: int = 4, seed: int = 7):
     return items
 
 
-def warmup(buckets, schemes=("p256", "p256-multikey", "ed25519"),
+def warmup(buckets, schemes=("p256", "p256-rows", "ed25519"),
            verbose: bool = True) -> dict:
     from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
 
@@ -87,14 +87,14 @@ def warmup(buckets, schemes=("p256", "p256-multikey", "ed25519"),
             t0 = time.perf_counter()
             provider.batch_verify((items * reps)[:bucket])
             timings[f"p256@{bucket}"] = round(time.perf_counter() - t0, 1)
-        if "p256-multikey" in schemes:
+        if "p256-rows" in schemes:
             items = gen_p256_sigs(min(bucket, 64), n_keys=2, seed=5)
             for it in items:
                 provider.key_tables.get_or_build(it.pubkey)
             reps = (bucket // len(items)) + 1
             t0 = time.perf_counter()
             provider.batch_verify((items * reps)[:bucket])
-            timings[f"p256-multikey@{bucket}"] = round(
+            timings[f"p256-rows@{bucket}"] = round(
                 time.perf_counter() - t0, 1)
         if "ed25519" in schemes:
             items = gen_ed25519_sigs(min(bucket, 64))
@@ -114,7 +114,7 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fabric-tpu-warmup")
     ap.add_argument("--buckets", default="16384,32768",
                     help="comma-separated batch bucket sizes")
-    ap.add_argument("--schemes", default="p256,p256-multikey,ed25519")
+    ap.add_argument("--schemes", default="p256,p256-rows,ed25519")
     args = ap.parse_args(argv)
     timings = warmup([int(b) for b in args.buckets.split(",")],
                      tuple(args.schemes.split(",")))

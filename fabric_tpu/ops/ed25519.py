@@ -8,9 +8,20 @@ Split of labor:
   message, reduced mod L — hashing never goes on device (mirrors the
   reference's design where bccsp.Verify receives a fixed-size digest,
   msp/identities.go:178);
-- device (this module): batched decompression of A and R, scalar ladder
-  [S]B + [k](-A), projective comparison against R.  Cofactorless equation
-  ([S]B == R + [k]A), matching RFC 8032 / OpenSSL / Go crypto/ed25519.
+- device (this module): the cofactorless equation [S]B == R + [k]A,
+  matching RFC 8032 / OpenSSL / Go crypto/ed25519, computed as
+  [S]B + [k](-A) and compared against the ENCODED R by recompression
+  (one batch-amortized inversion instead of a ~250-squaring sqrt per
+  signature — R never needs decompressing).
+
+Two lanes (the P-256 two-lane design, bccsp/jaxtpu.py):
+  verify_words       — generic: decompress A on device, [S]B via the
+                       fixed-base signed comb, [k](-A) via a 4-bit
+                       windowed ladder of complete adds;
+  verify_words_rows  — fast: A's table is cached (ops/ed25519_tables),
+                       BOTH halves are fixed-base combs; signatures
+                       pack key-major into a (R, C) row grid exactly
+                       like ops/p256_fixed.verify_words_rows.
 
 Kernel inputs are (8, B) uint32 big-endian words of the *integer values*
 (the host unpacks the little-endian wire encoding) plus (B,) sign bits.
@@ -25,10 +36,16 @@ import jax.numpy as jnp
 
 from . import bignum as bn
 from . import edwards as ed
+from . import flatfield as ff
+
+
+def _sb_comb(s_l, bshape):
+    from . import ed25519_tables as tabs
+    return ed.comb_accumulate(tabs.basepoint_table(), s_l, bshape)
 
 
 def verify_words(ay, a_sign, ry, r_sign, s, k) -> jnp.ndarray:
-    """Batched ed25519 verify.
+    """Generic-lane batched ed25519 verify (uncached A).
 
     ay, ry: (8, B) uint32 big-endian words of the A / R y-coordinates
     a_sign, r_sign: (B,) int32 x-parity bits from the encodings
@@ -36,22 +53,54 @@ def verify_words(ay, a_sign, ry, r_sign, s, k) -> jnp.ndarray:
     k: (8, B) words of SHA512(R||A||M) already reduced mod L by the host
     Returns (B,) bool.
     """
-    fp = ed.fp
     ay_l = bn.words_be_to_limbs(ay)
     ry_l = bn.words_be_to_limbs(ry)
     s_l = bn.words_be_to_limbs(s)
     k_l = bn.words_be_to_limbs(k)
+    bshape = s_l.shape[1:]
 
-    s_ok = bn.limbs_lt_const(s_l, ed.L)
+    s_ok = ff.lt_const(s_l, ed.L)
     (ax_m, ay_m), a_ok = ed.decompress(ay_l, a_sign)
-    (rx_m, ry_m), r_ok = ed.decompress(ry_l, r_sign)
 
-    A = ed.from_affine(ax_m, ay_m)
-    R = ed.from_affine(rx_m, ry_m)
-    # [S]B + [k](-A) == R
-    lhs = ed.shamir(s_l, k_l, ed.neg(A), n_bits=253)
-    ok_eq = ed.eq_points(lhs, R)
-    return s_ok & a_ok & r_ok & ok_eq
+    lhs = ed.add(_sb_comb(s_l, bshape),
+                 ed.windowed_mul(k_l, ed.neg(ed.from_affine(ax_m, ay_m)),
+                                 bshape))
+    # gate the inversion on a_ok: garbage "points" from a failed
+    # decompression may break the completeness guarantee (Z == 0 would
+    # poison the product tree); their verdict is False regardless.
+    zinv = ed.batch_zinv(lhs[2], a_ok)
+    return s_ok & a_ok & ed.compressed_equals(lhs, ry_l, r_sign, zinv)
+
+
+def verify_words_rows(bank_f32, row_key, ry, r_sign, s, k) -> jnp.ndarray:
+    """Fast-lane batched verify over a key-major (R, C) row grid.
+
+    bank_f32: (K, COMB_WINDOWS*COMB_ROWS, 3L) stacked niels tables of
+    the NEGATED public keys (Ed25519KeyTableCache layout); row_key:
+    (R,) int32; ry/s/k: (8, R, C) uint32 words; r_sign: (R, C) int32.
+    Returns (R, C) bool.  A-validity was established at table build.
+    """
+    ry_l = bn.words_be_to_limbs(ry)
+    s_l = bn.words_be_to_limbs(s)
+    k_l = bn.words_be_to_limbs(k)
+    R, C = s_l.shape[1], s_l.shape[2]
+
+    def flat(x):
+        return x.reshape(x.shape[0], R * C)
+
+    s_ok = ff.lt_const(flat(s_l), ed.L)
+    acc_b = _sb_comb(flat(s_l), (R * C,))
+    acc_a = ed.comb_accumulate_rows(bank_f32, row_key, k_l, (R, C))
+    lhs = ed.add(acc_b, tuple(
+        flat(c) if c.ndim == 3 else c.reshape(R * C) for c in acc_a))
+    # every point here is a valid curve point (tables are built from
+    # validated keys; combs of valid points stay valid): completeness
+    # guarantees Z != 0, so the tree is safe ungated.
+    ones = jnp.ones((R * C,), bool)
+    zinv = ed.batch_zinv(lhs[2], ones)
+    ok = s_ok & ed.compressed_equals(lhs, flat(ry_l),
+                                     r_sign.reshape(R * C), zinv)
+    return ok.reshape(R, C)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,198 @@
+"""Host-side niels-form comb tables for ed25519 verification.
+
+The ed25519 verify equation [S]B - [k]A == R has a FIXED base B (the
+RFC 8032 generator) and, on the key-repetitive workloads this framework
+exists for (SURVEY.md §3.2 — endorser/client identities repeat; the
+reference's msp/cache embodies the same assumption), a heavily repeated
+A.  Both scalar halves therefore run as fixed-base signed combs over
+host-precomputed tables (ops/edwards.py comb_accumulate*), the exact
+strategy of the P-256 fast lane (ops/p256_tables.py).
+
+Tables store "niels" triples (y-x, y+x, 2dxy) — Montgomery-form,
+canonical — because the mixed add then costs 7 muls and signed digits
+negate by a swap.  Row j*COMB_ROWS + m = niels(m * 2^(7j) * T) for
+m = 1..64; row j*COMB_ROWS + 0 = niels(identity) = (1, 1, 0), which the
+complete formulas absorb with no masking.
+
+Per-key tables are built for -A (the verification equation needs the
+negation), keyed by the 32-byte compressed public key; decompression
+and the on-curve/canonicality checks happen ONCE here at build time.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional
+
+import numpy as np
+
+from . import bignum as bn
+from . import edwards as ed
+
+P = ed.P
+D = ed.D
+COMB_W = ed.COMB_W
+COMB_WINDOWS = ed.COMB_WINDOWS
+COMB_ROWS = ed.COMB_ROWS
+L = bn.N_LIMBS
+
+
+# -- python-int extended-coordinate arithmetic -------------------------------
+
+def _ext_add(p1, p2):
+    X1, Y1, Z1, T1 = p1
+    X2, Y2, Z2, T2 = p2
+    A = (Y1 - X1) * (Y2 - X2) % P
+    B = (Y1 + X1) * (Y2 + X2) % P
+    C = 2 * D * T1 % P * T2 % P
+    Dv = 2 * Z1 * Z2 % P
+    E, F, G, H = B - A, Dv - C, Dv + C, B + A
+    return E * F % P, G * H % P, F * G % P, E * H % P
+
+
+def _ext_dbl(p):
+    X1, Y1, Z1, _ = p
+    A = X1 * X1 % P
+    B = Y1 * Y1 % P
+    C = 2 * Z1 * Z1 % P
+    H = A + B
+    E = H - (X1 + Y1) * (X1 + Y1)
+    G = A - B
+    F = C + G
+    return E * F % P, G * H % P, F * G % P, E * H % P
+
+
+def _batch_to_affine(points):
+    """Extended -> affine with one inversion (Montgomery's trick)."""
+    zs = [pt[2] for pt in points]
+    prefix = [1]
+    for z in zs:
+        prefix.append(prefix[-1] * z % P)
+    inv_all = pow(prefix[-1], P - 2, P)
+    out = [None] * len(points)
+    for i in range(len(points) - 1, -1, -1):
+        z_inv = inv_all * prefix[i] % P
+        inv_all = inv_all * zs[i] % P
+        X, Y, _, _ = points[i]
+        out[i] = (X * z_inv % P, Y * z_inv % P)
+    return out
+
+
+def on_curve(x: int, y: int) -> bool:
+    """-x^2 + y^2 == 1 + d x^2 y^2 (twisted Edwards, a = -1)."""
+    x2, y2 = x * x % P, y * y % P
+    return (y2 - x2 - 1 - D * x2 % P * y2) % P == 0
+
+
+def decompress_int(pk: bytes) -> Optional[tuple]:
+    """RFC 8032 §5.1.3 decompression with python ints; None if invalid."""
+    if len(pk) != 32:
+        return None
+    enc = int.from_bytes(pk, "little")
+    sign = (enc >> 255) & 1
+    y = enc & ((1 << 255) - 1)
+    if y >= P:
+        return None
+    u = (y * y - 1) % P
+    v = (D * y * y + 1) % P
+    x = u * pow(v, P - 2, P) % P           # x^2
+    cand = pow(x, (P + 3) // 8, P)
+    if cand * cand % P != x:
+        cand = cand * ed.SQRT_M1 % P
+        if cand * cand % P != x:
+            return None
+    if cand == 0 and sign == 1:
+        return None
+    if cand & 1 != sign:
+        cand = (-cand) % P
+    return cand, y
+
+
+def comb_table_for_point(x: int, y: int) -> np.ndarray:
+    """(COMB_WINDOWS * COMB_ROWS, 3L) f32 niels comb table for T=(x,y).
+
+    Raises ValueError for points not on the curve — the single on-curve
+    gate for the fixed-base fast path (the kernel never sees T).
+    """
+    if not (0 <= x < P and 0 <= y < P and on_curve(x, y)):
+        raise ValueError("point not on edwards25519")
+    ext = []
+    base = (x, y, 1, x * y % P)
+    for j in range(COMB_WINDOWS):
+        acc = base
+        ext.append(acc)
+        for _ in range(COMB_ROWS - 2):
+            acc = _ext_add(acc, base)
+            ext.append(acc)
+        for _ in range(COMB_W):
+            base = _ext_dbl(base)
+    affine = _batch_to_affine(ext)
+    rows = np.zeros((COMB_WINDOWS * COMB_ROWS, 3 * L), dtype=np.float32)
+    R = ed.fp.R
+    one_m = bn.int_to_limbs(R % P)
+    idx = 0
+    for j in range(COMB_WINDOWS):
+        # row 0: identity niels (1, 1, 0) in Montgomery form
+        rows[j * COMB_ROWS, :L] = one_m
+        rows[j * COMB_ROWS, L:2 * L] = one_m
+        for m in range(1, COMB_ROWS):
+            px, py = affine[idx]
+            idx += 1
+            rows[j * COMB_ROWS + m, :L] = bn.int_to_limbs(
+                (py - px) % P * R % P)
+            rows[j * COMB_ROWS + m, L:2 * L] = bn.int_to_limbs(
+                (py + px) % P * R % P)
+            rows[j * COMB_ROWS + m, 2 * L:] = bn.int_to_limbs(
+                2 * D % P * px % P * py % P * R % P)
+    return rows
+
+
+_B_CACHE = {}
+
+
+def basepoint_table() -> np.ndarray:
+    """The global comb table for the RFC 8032 basepoint B."""
+    if "t" not in _B_CACHE:
+        _B_CACHE["t"] = comb_table_for_point(ed.BX, ed.BY)
+    return _B_CACHE["t"]
+
+
+class Ed25519KeyTableCache:
+    """LRU cache of per-key niels comb tables for -A, keyed by the
+    32-byte compressed public key.  ~640 KB per key."""
+
+    def __init__(self, max_keys: int = 128):
+        self.max_keys = max_keys
+        self._lru: "OrderedDict[bytes, np.ndarray]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.stats = {"hits": 0, "builds": 0, "rejects": 0}
+
+    def __contains__(self, pubkey: bytes) -> bool:
+        with self._lock:
+            return pubkey in self._lru
+
+    def get(self, pubkey: bytes) -> Optional[np.ndarray]:
+        with self._lock:
+            tab = self._lru.get(pubkey)
+            if tab is not None:
+                self._lru.move_to_end(pubkey)
+                self.stats["hits"] += 1
+            return tab
+
+    def get_or_build(self, pubkey: bytes) -> Optional[np.ndarray]:
+        tab = self.get(pubkey)
+        if tab is not None:
+            return tab
+        aff = decompress_int(bytes(pubkey))
+        if aff is None:
+            self.stats["rejects"] += 1
+            return None
+        ax, ay = aff
+        tab = comb_table_for_point((-ax) % P, ay)    # table is for -A
+        with self._lock:
+            self.stats["builds"] += 1
+            self._lru[pubkey] = tab
+            while len(self._lru) > self.max_keys:
+                self._lru.popitem(last=False)
+        return tab

@@ -1,22 +1,43 @@
-"""Batched twisted-Edwards (a=-1) extended-coordinate arithmetic for ed25519.
+"""Batched twisted-Edwards (a=-1) arithmetic for ed25519 on the flat field.
 
 The ed25519 capability is NEW relative to the reference (verified in
-SURVEY.md §2: no ed25519 anywhere in /root/reference — BCCSP is ECDSA-only);
-it exists because BASELINE.json configs 2-3 call for ed25519 and mixed-curve
-batch verification on TPU.
+SURVEY.md §2: no ed25519 anywhere in /root/reference — BCCSP is
+ECDSA-only); it exists because BASELINE.json configs 2-3 call for
+ed25519 and mixed-curve batch verification on TPU.
 
-Extended homogeneous coordinates (X : Y : Z : T) with x = X/Z, y = Y/Z,
-T = XY/Z.  The unified addition law (add-2008-hwcd-3) is COMPLETE for
-a = -1 with non-square d, so there are no degenerate cases at all — ideal
-for a branchless batched TPU ladder.  Identity is (0 : 1 : 1 : 0).
+Round-4 rework: the round-1 module ran a 253-iteration bit ladder on the
+scan-heavy bignum.Mont layer; this one runs on the lazy-reduction flat
+field (ops/flatfield.py, the P-256 hot-path layer) with fixed-base COMB
+scalar multiplication:
+
+  * extended homogeneous coordinates (X : Y : Z : T), x = X/Z, y = Y/Z,
+    T = XY/Z; the unified add (add-2008-hwcd-3) and dbl (dbl-2008-hwcd)
+    are COMPLETE for a = -1 with non-square d — no degenerate cases, no
+    infinity flags, ideal for branchless batched kernels;
+  * table entries in "niels" form (y-x, y+x, 2d*x*y): the mixed add
+    costs 7 muls (vs 11 for the P-256 Jacobian mixed add), the identity
+    (1, 1, 0) is handled by the formulas natively (digit-0 rows need no
+    masking), and negation is a coordinate swap + one negate — so
+    SIGNED comb digits are free, which completeness makes safe (the
+    P-256 comb must stay unsigned because its incomplete mixed add
+    would need reachability analysis per window);
+  * signed 7-bit comb: 37 windows of |digit| <= 64 over a 65-row
+    one-hot lookup per window (row 0 = identity).
+
+Lazy bounds (operand values < 16p keep the CIOS contract; tracked
+inline): all point coordinates stay < 2p out of every mul; sums/diffs
+peak at 8p inside the formulas.
 """
 
 from __future__ import annotations
 
+import numpy as np
 import jax.numpy as jnp
 from jax import lax
 
 from . import bignum as bn
+from . import flatfield as ff
+from .flatfield import FlatMod, L as NL, LB
 
 P = 2**255 - 19
 L = 2**252 + 27742317777372353535851937790883648493  # group order
@@ -25,29 +46,41 @@ SQRT_M1 = pow(2, (P - 1) // 4, P)  # sqrt(-1) mod p
 BX = 15112221349535400772501151409588531511454012693041857206046113283949847762202
 BY = 46316835694926478169428394003475163141307993866256225615783033603165251855960
 
-fp = bn.Mont(P, "ed25519.p")
-fl = bn.Mont(L, "ed25519.l")
+COMB_W = 7
+COMB_WINDOWS = 37            # 37*7 = 259 >= 253 (+ signed carry headroom)
+COMB_ROWS = 65               # |digit| in 0..64; row 0 = identity niels
 
-D_M = fp.const(D)
-D2_M = fp.const(2 * D % P)
-SQRT_M1_M = fp.const(SQRT_M1)
-B_AFF = (fp.const(BX), fp.const(BY))
+fp = FlatMod(P, "ed25519.p")
+fl = FlatMod(L, "ed25519.l")
 
+_D_M = fp.const_mont(D)
+_D2_M = fp.const_mont(2 * D % P)
+_SQRT_M1_M = fp.const_mont(SQRT_M1)
+
+
+def _c(np_col, ndim):
+    return ff.const_col(np_col, ndim)
+
+
+# ---------------------------------------------------------------------------
+# Extended-coordinate point ops (values lazily bounded, coords < 2p)
+# ---------------------------------------------------------------------------
 
 def identity(bshape) -> tuple:
     one = fp.one_bc(bshape)
-    zero = jnp.zeros((bn.N_LIMBS,) + tuple(bshape), dtype=jnp.int32)
+    zero = fp.zero_bc(bshape)
     return zero, one, one, zero
 
 
 def from_affine(x_m, y_m) -> tuple:
     one = fp.one_bc(jnp.asarray(x_m).shape[1:])
-    return jnp.asarray(x_m), jnp.asarray(y_m), one, fp.mul(x_m, y_m)
+    return (jnp.asarray(x_m), jnp.asarray(y_m), one, fp.mul(x_m, y_m))
 
 
 def neg(Pt) -> tuple:
     X, Y, Z, T = Pt
-    return fp.neg(X), Y, Z, fp.neg(T)
+    z = fp.zero_bc(jnp.asarray(X).shape[1:])
+    return fp.subl(z, X, 2), Y, Z, fp.subl(z, T, 2)
 
 
 def select(cond, A, Bp) -> tuple:
@@ -55,96 +88,305 @@ def select(cond, A, Bp) -> tuple:
 
 
 def add(Pt, Qt) -> tuple:
-    """Complete unified addition (add-2008-hwcd-3, a=-1, k=2d)."""
+    """Complete unified addition (add-2008-hwcd-3, a=-1, k=2d).
+    Inputs < 2p -> outputs < 2p; 9 muls, no conditional subtractions."""
     X1, Y1, Z1, T1 = Pt
     X2, Y2, Z2, T2 = Qt
-    A = fp.mul(fp.sub(Y1, X1), fp.sub(Y2, X2))
-    Bv = fp.mul(fp.add(Y1, X1), fp.add(Y2, X2))
-    C = fp.mul(fp.mul(T1, jnp.asarray(D2_M)), T2)
-    Dv = fp.mul_small(fp.mul(Z1, Z2), 2)
-    E = fp.sub(Bv, A)
-    F = fp.sub(Dv, C)
-    G = fp.add(Dv, C)
-    H = fp.add(Bv, A)
+    ndim = jnp.asarray(X1).ndim
+    A = fp.mul(fp.subl(Y1, X1, 2), fp.subl(Y2, X2, 2))    # 16p^2 -> <2p
+    Bv = fp.mul(fp.addl(Y1, X1), fp.addl(Y2, X2))          # <2p
+    C = fp.mul(fp.mul(T1, _c(_D2_M, ndim)), T2)            # <2p
+    Dv = fp.smalll(fp.mul(Z1, Z2), 2)                      # <4p
+    E = fp.subl(Bv, A, 2)                                  # <4p
+    F = fp.subl(Dv, C, 2)                                  # <6p
+    G = fp.addl(Dv, C)                                     # <6p
+    H = fp.addl(Bv, A)                                     # <4p
     return fp.mul(E, F), fp.mul(G, H), fp.mul(F, G), fp.mul(E, H)
 
 
 def dbl(Pt) -> tuple:
-    """Doubling (dbl-2008-hwcd, a=-1); also complete."""
+    """Doubling (dbl-2008-hwcd, a=-1); also complete.  <2p out; 7 muls."""
     X1, Y1, Z1, _ = Pt
-    A = fp.sqr(X1)
-    Bv = fp.sqr(Y1)
-    C = fp.mul_small(fp.sqr(Z1), 2)
-    H = fp.add(A, Bv)
-    E = fp.sub(H, fp.sqr(fp.add(X1, Y1)))
-    G = fp.sub(A, Bv)
-    F = fp.add(C, G)
+    A = fp.sqr(X1)                                         # <2p
+    Bv = fp.sqr(Y1)                                        # <2p
+    C = fp.smalll(fp.sqr(Z1), 2)                           # <4p
+    H = fp.addl(A, Bv)                                     # <4p
+    E = fp.subl(H, fp.sqr(fp.addl(X1, Y1)), 2)             # <6p
+    G = fp.subl(A, Bv, 2)                                  # <4p
+    F = fp.addl(C, G)                                      # <8p
     return fp.mul(E, F), fp.mul(G, H), fp.mul(F, G), fp.mul(E, H)
 
 
-def shamir(u1_limbs, u2_limbs, Q, n_bits: int = 253) -> tuple:
-    """u1*B + u2*Q, interleaved double-and-add over the basepoint B and Q.
+def add_niels(Pt, e0, e1, e2) -> tuple:
+    """Mixed add of a niels-form table entry (y-x, y+x, 2dxy), each
+    canonical < p Montgomery.  The identity entry (1, 1, 0) flows
+    through the formulas natively — no digit-0 masking.  7 muls."""
+    X1, Y1, Z1, T1 = Pt
+    A = fp.mul(fp.subl(Y1, X1, 2), e0)                     # <2p
+    Bv = fp.mul(fp.addl(Y1, X1), e1)                       # <2p
+    C = fp.mul(T1, e2)                                     # <2p
+    Dv = fp.smalll(Z1, 2)                                  # <4p
+    E = fp.subl(Bv, A, 2)                                  # <4p
+    F = fp.subl(Dv, C, 2)                                  # <6p
+    G = fp.addl(Dv, C)                                     # <6p
+    H = fp.addl(Bv, A)                                     # <4p
+    return fp.mul(E, F), fp.mul(G, H), fp.mul(F, G), fp.mul(E, H)
 
-    Scalars as canonical little-endian limbs (L, Bsz); returns extended point.
-    """
-    bshape = jnp.asarray(u1_limbs).shape[1:]
-    Bpt = from_affine(
-        jnp.broadcast_to(jnp.asarray(B_AFF[0]), (bn.N_LIMBS,) + tuple(bshape)),
-        jnp.broadcast_to(jnp.asarray(B_AFF[1]), (bn.N_LIMBS,) + tuple(bshape)))
-    BQ = add(Bpt, Q)
-    u1b = bn.to_bits(u1_limbs, n_bits)[::-1]
-    u2b = bn.to_bits(u2_limbs, n_bits)[::-1]
 
-    def body(acc, bits):
-        b1, b2 = bits
-        acc = dbl(acc)
-        t = select(b1 != 0, Bpt, identity(bshape))
-        t = select((b1 == 0) & (b2 != 0), Q, t)
-        t = select((b1 != 0) & (b2 != 0), BQ, t)
-        acc = add(acc, t)
-        return acc, None
+# ---------------------------------------------------------------------------
+# Signed-digit comb
+# ---------------------------------------------------------------------------
 
-    # tie the init to the scalars so its shard_map variance matches
-    init = tuple(c + jnp.asarray(u1_limbs) * 0 for c in identity(bshape))
-    acc, _ = lax.scan(body, init, (u1b, u2b))
+def comb_digits_signed(u_can):
+    """(NL, B) canonical limbs (< 2^253) -> (37, B) int32 signed digits
+    d_j in [-64, 64], u = sum d_j * 2^(7j).  LSB-first."""
+    raw = []
+    for j in range(COMB_WINDOWS):
+        bitpos = COMB_W * j
+        limb = bitpos // LB
+        off = bitpos % LB
+        if limb >= NL:
+            raw.append(jnp.zeros_like(u_can[0]))
+            continue
+        v = u_can[limb] >> off
+        if off > LB - COMB_W and limb + 1 < NL:
+            v = v | (u_can[limb + 1] << (LB - off))
+        raw.append(v & ((1 << COMB_W) - 1))
+    out = []
+    carry = jnp.zeros_like(raw[0])
+    for j in range(COMB_WINDOWS):
+        v = raw[j] + carry
+        hi = v >= (1 << (COMB_W - 1))                      # v in [64, 128]
+        out.append(jnp.where(hi, v - (1 << COMB_W), v))
+        carry = hi.astype(v.dtype)
+    return jnp.stack(out)
+
+
+def comb_accumulate(tab_f32, u_can, bshape):
+    """u * T against a niels comb table (COMB_WINDOWS*COMB_ROWS, 3*NL):
+    row j*COMB_ROWS + k = niels(k * 2^(7j) * T), row j*COMB_ROWS + 0 =
+    identity.  Signed digits: negative selects swap (y-x)/(y+x) and
+    negate the 2dxy coordinate AFTER the one-hot lookup."""
+    eager = ff._is_concrete(u_can)
+    sd = comb_digits_signed(u_can)                         # (37, B)
+    mag = jnp.abs(sd)
+    neg_d = sd < 0
+    tab = jnp.asarray(tab_f32).reshape(COMB_WINDOWS, COMB_ROWS, 3 * NL)
+    iota = jnp.arange(COMB_ROWS, dtype=jnp.int32).reshape(1, COMB_ROWS, 1)
+
+    def entry(sel, negb):
+        e0, e1, e2 = sel[:NL], sel[NL:2 * NL], sel[2 * NL:]
+        z = fp.zero_bc(negb.shape)
+        e2n = fp.subl(z, e2, 1)                            # p - e2 < 2p
+        return (fp.select(negb, e1, e0), fp.select(negb, e0, e1),
+                fp.select(negb, e2n, e2))
+
+    if eager:
+        acc = identity(bshape)
+        for j in range(COMB_WINDOWS):
+            onehot = (iota[0] == mag[j][None]).astype(jnp.float32)
+            sel = jnp.tensordot(
+                tab[j].T, onehot, axes=1,
+                precision=lax.Precision.HIGHEST).astype(jnp.int32)
+            acc = add_niels(acc, *entry(sel, neg_d[j]))
+        return acc
+
+    onehot = (iota == mag[:, None, :]).astype(jnp.float32)  # (37, 65, B)
+    sel = lax.dot_general(
+        tab, onehot,
+        dimension_numbers=(((1,), (1,)), ((0,), (0,))),
+        precision=lax.Precision.HIGHEST).astype(jnp.int32)  # (37, 3NL, B)
+
+    def body(acc, xs):
+        s, nb = xs
+        return add_niels(acc, *entry(s, nb)), None
+
+    init = tuple(c + u_can[0] * 0 for c in identity(bshape))
+    acc, _ = lax.scan(body, init, (sel, neg_d))
     return acc
 
+
+def comb_accumulate_rows(bank_f32, row_key, u_can, bshape):
+    """Row-grouped multikey niels comb over a (R, C) grid (the ed25519
+    analogue of ecp256.comb_accumulate_rows; same packing contract)."""
+    eager = ff._is_concrete(u_can)
+    R, C = bshape
+    sd = comb_digits_signed(u_can)                         # (37, R, C)
+    mag = jnp.abs(sd)
+    neg_d = sd < 0
+    bank = jnp.asarray(bank_f32, jnp.float32)
+    rows = bank[row_key].reshape(R, COMB_WINDOWS, COMB_ROWS, 3 * NL)
+    rows = rows.transpose(1, 0, 3, 2)                      # (37, R, 3NL, 65)
+    iota = jnp.arange(COMB_ROWS, dtype=jnp.int32).reshape(
+        1, 1, COMB_ROWS, 1)
+
+    def entry(sel, negb):
+        e0, e1, e2 = sel[:NL], sel[NL:2 * NL], sel[2 * NL:]
+        z = fp.zero_bc(negb.shape)
+        e2n = fp.subl(z, e2, 1)
+        return (fp.select(negb, e1, e0), fp.select(negb, e0, e1),
+                fp.select(negb, e2n, e2))
+
+    if eager:
+        acc = identity(bshape)
+        for j in range(COMB_WINDOWS):
+            onehot = (iota[0] == mag[j][:, None, :]).astype(jnp.float32)
+            sel = lax.dot_general(
+                rows[j], onehot,
+                dimension_numbers=(((2,), (1,)), ((0,), (0,))),
+                precision=lax.Precision.HIGHEST).astype(jnp.int32)
+            sel = sel.transpose(1, 0, 2)                   # (3NL, R, C)
+            acc = add_niels(acc, *entry(sel, neg_d[j]))
+        return acc
+
+    onehot = (iota == mag[:, :, None, :]).astype(jnp.float32)
+    sel = lax.dot_general(
+        rows, onehot,
+        dimension_numbers=(((3,), (2,)), ((0, 1), (0, 1))),
+        precision=lax.Precision.HIGHEST)                   # (37, R, 3NL, C)
+    sel = sel.transpose(0, 2, 1, 3).astype(jnp.int32)      # (37, 3NL, R, C)
+
+    def body(acc, xs):
+        s, nb = xs
+        return add_niels(acc, *entry(s, nb)), None
+
+    init = tuple(c + u_can[0] * 0 for c in identity(bshape))
+    acc, _ = lax.scan(body, init, (sel, neg_d))
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Variable-point windowed ladder (uncached keys)
+# ---------------------------------------------------------------------------
+
+LADDER_W = 4
+LADDER_WINDOWS = 64          # scalars < L < 2^253
+
+
+def ladder_digits(u_can):
+    """(NL, B) canonical -> (64, B) 4-bit digits, MSB-first."""
+    digits = []
+    for w in range(LADDER_WINDOWS):
+        limb = w // 3
+        shift = (w % 3) * 4
+        digits.append((u_can[limb] >> shift) & 0xF)
+    return jnp.stack(digits[::-1])
+
+
+def windowed_mul(u_can, Q, bshape):
+    """u * Q for a variable point by a 4-bit windowed ladder: one scan
+    builds the 16-entry table (complete adds — safe for ANY input), one
+    scan runs 64 windows of 4 dbl + 1 unified add."""
+    eager = ff._is_concrete(u_can)
+    T0 = identity(bshape)
+    if not eager:
+        T0 = tuple(c + u_can[0] * 0 for c in T0)
+    T2 = dbl(Q)
+    if eager:
+        T = [T0, Q, T2]
+        for k in range(3, 16):
+            T.append(add(T[k - 1], Q))
+        TX, TY, TZ, TT = (jnp.stack([t[i] for t in T]) for i in range(4))
+    else:
+        def tab_body(acc, _):
+            nxt = add(acc, Q)
+            return nxt, nxt
+        _, rest = lax.scan(tab_body, T2, None, length=13)
+        TX, TY, TZ, TT = (
+            jnp.concatenate([jnp.stack([a, b, c]), r], axis=0)
+            for a, b, c, r in zip(T0, Q, T2, rest))
+
+    ld = ladder_digits(u_can)
+
+    def ladder_body(acc, d):
+        if eager:
+            for _ in range(LADDER_W):
+                acc = dbl(acc)
+        else:
+            acc = lax.fori_loop(0, LADDER_W, lambda _, a: dbl(a), acc)
+        ent = (TX[0], TY[0], TZ[0], TT[0])
+        for k in range(1, 16):
+            ent = select(d == k, (TX[k], TY[k], TZ[k], TT[k]), ent)
+        return add(acc, ent), None
+
+    if eager:
+        acc = T0
+        for i in range(LADDER_WINDOWS):
+            acc, _ = ladder_body(acc, ld[i])
+    else:
+        acc, _ = lax.scan(ladder_body, T0, ld)
+    return acc
+
+
+# ---------------------------------------------------------------------------
+# Decompression & recompression
+# ---------------------------------------------------------------------------
 
 def decompress(y_limbs, sign_bit) -> tuple:
     """RFC 8032 §5.1.3 point decompression, batched & branchless.
 
-    y_limbs: (L, B) canonical integer limbs of the y coordinate (< 2^255);
-    sign_bit: (B,) int32 0/1 (the x parity bit from the encoding MSB).
-    Returns ((x_m, y_m), ok): affine Montgomery coords and validity mask.
-    Callers must reject when y >= p (checked here) or when no sqrt exists.
+    y_limbs: (NL, B) canonical integer limbs of y (< 2^255); sign_bit:
+    (B,) int32 0/1.  Returns ((x_m, y_m), ok) with x_m, y_m < 2p
+    Montgomery.  ok=False for y >= p, non-residues, or x=0 with sign=1.
     """
-    y_ok = bn.limbs_lt_const(y_limbs, P)
+    ndim = jnp.asarray(y_limbs).ndim
+    y_ok = ff.lt_const(y_limbs, P)
     y_m = fp.to_mont(y_limbs)
     y2 = fp.sqr(y_m)
-    one = jnp.asarray(fp.one_np.reshape(bn.N_LIMBS, 1))
-    u = fp.sub(y2, one)                      # y^2 - 1
-    v = fp.add(fp.mul(y2, jnp.asarray(D_M)), one)  # d*y^2 + 1
-    # candidate root: x = u * v^3 * (u * v^7)^((p-5)/8)
-    v3 = fp.mul(fp.sqr(v), v)
+    one = fp.one_bc(jnp.asarray(y_limbs).shape[1:])
+    u = fp.subl(y2, one, 2)                                # y^2 - 1, <4p
+    v = fp.addl(fp.mul(y2, _c(_D_M, ndim)), one)           # d y^2 + 1, <4p
+    # candidate root: x = u * v^3 * (u*v^7)^((p-5)/8)
+    v2 = fp.sqr(v)
+    v3 = fp.mul(v2, v)
     v7 = fp.mul(fp.sqr(v3), v)
-    x = fp.mul(fp.mul(u, v3), fp.pow_const(fp.mul(u, v7), (P - 5) // 8))
-    vx2 = fp.mul(v, fp.sqr(x))
-    root_ok = fp.eq(vx2, u)
-    root_neg = fp.eq(vx2, fp.neg(u))
-    x = fp.select(root_neg, fp.mul(x, jnp.asarray(SQRT_M1_M)), x)
+    pw = fp.pow_const_scan(fp.mul(u, v7), (P - 5) // 8)
+    x = fp.mul(fp.mul(u, v3), pw)                          # <2p
+    vx2 = fp.mul(v, fp.sqr(x))                             # <2p
+    root_ok = fp.eq_k(vx2, u, 4, 6)
+    neg_u = fp.subl(fp.zero_bc(u.shape[1:]), u, 4)         # <4p
+    root_neg = fp.eq_k(vx2, neg_u, 4, 6)
+    x = fp.select(root_neg, fp.mul(x, _c(_SQRT_M1_M, ndim)), x)
     ok = y_ok & (root_ok | root_neg)
-    # sign handling: if x == 0 and sign==1 -> invalid; else negate to match
-    x_can = fp.from_mont(x)  # already canonical in [0, p)
-    x_is_zero = bn.limbs_is_zero(x_can)
-    x_parity = bn.bit(x_can, 0)
+    x_can = fp.from_mont(x)
+    x_is_zero = ff.is_zero_limbs(x_can)
+    x_parity = (x_can[0] & 1)
     ok = ok & ~(x_is_zero & (sign_bit == 1))
-    x = fp.select((x_parity != sign_bit) & ~x_is_zero, fp.neg(x), x)
+    flip = (x_parity != sign_bit) & ~x_is_zero
+    x = fp.select(flip, fp.subl(fp.zero_bc(x.shape[1:]), x, 2), x)
     return (x, y_m), ok
 
 
-def eq_points(Pt, Qt) -> jnp.ndarray:
-    """Projective equality: X1*Z2 == X2*Z1 and Y1*Z2 == Y2*Z1."""
-    X1, Y1, Z1, _ = Pt
-    X2, Y2, Z2, _ = Qt
-    return (fp.eq(fp.mul(X1, Z2), fp.mul(X2, Z1)) &
-            fp.eq(fp.mul(Y1, Z2), fp.mul(Y2, Z1)))
+def batch_zinv(Z, gate):
+    """Batch inverse of the Z coordinates via the product tree.
+
+    gate: (B,) bool — elements already known invalid (their Z may be
+    garbage/zero and must not poison the tree; their inverse is never
+    consumed).  Falls back to the Fermat chain for odd shapes."""
+    bshape = jnp.asarray(Z).shape[1:]
+    z_zero = fp.is_zero_k(Z, 2) | ~gate
+    z_safe = fp.select(z_zero, fp.one_bc(bshape), Z)
+    if (not ff._is_concrete(Z) and len(bshape) == 1
+            and bshape[0] >= 128 and bshape[0] % 2 == 0):
+        return fp.inv_tree(z_safe)
+    return fp.inv(z_safe)
+
+
+def compressed_equals(Pt, y_limbs, sign_bit, zinv):
+    """Does the extended point equal the ENCODED point (y, sign)?
+
+    Recompression check: replaces per-signature decompression of R (a
+    ~250-squaring sqrt chain) with one batch-amortized inversion —
+    y(P) == y and parity(x(P)) == sign.  `zinv` comes from batch_zinv.
+    Non-canonical encodings (y >= p) are rejected, and a sign bit of 1
+    with x == 0 cannot match (parity(0) == 0), per RFC 8032.
+    """
+    X, Y, Z, _ = Pt
+    y_ok = ff.lt_const(y_limbs, P)
+    # coords are Montgomery forms: (X*R)(Z^-1*R)*R^-1 = (X/Z)*R stays
+    # Montgomery; from_mont strips the factor and canonicalizes.
+    x_aff = fp.from_mont(fp.mul(X, zinv))
+    y_aff = fp.from_mont(fp.mul(Y, zinv))
+    y_match = jnp.all(y_aff == jnp.asarray(y_limbs), axis=0)
+    x_parity = (x_aff[0] & 1)
+    return y_ok & y_match & (x_parity == sign_bit)

@@ -91,20 +91,37 @@ def verify_words_fixed(key_tab_f32, r, s, e, require_low_s: bool = True):
                              require_low_s=require_low_s)
 
 
-def verify_words_multikey(tabs_f32, key_idx, r, s, e,
-                          require_low_s: bool = True):
-    """Multi-key batched verify: ONE dispatch for signatures under up to
-    NK cached public keys.
+def verify_words_rows(bank_f32, row_key, r, s, e,
+                      require_low_s: bool = True):
+    """Row-grouped multikey batched verify: ONE dispatch for signatures
+    under ANY number of cached public keys.
 
-    tabs_f32: (NK, COMB_WINDOWS*COMB_ENTRIES, 2L) f32 stacked comb
-    tables; key_idx: (B,) int32 selecting each signature's key.  The u2
-    half one-hot-selects rows over the joint (key, digit) index
-    (ec.comb_accumulate_multikey).  Dispatch-merging matters because
-    relayed TPU transports charge a full round trip per dispatch.
+    The host packs signatures key-major into a (R, C) grid (every
+    element of row r shares the key row_key[r]); per-sig cost matches
+    the single-key comb regardless of the number of distinct keys —
+    the redesign that removed the round-3 NK<=4 fast-lane cap
+    (ec.comb_accumulate_rows).
+
+    bank_f32: (K, COMB_WINDOWS*COMB_ENTRIES, 2L) stacked tables;
+    row_key: (R,) int32; r/s/e: (8, R, C) uint32 words.
+    Returns (R, C) bool.
     """
     r_l, s_l, e_l = (bn.words_be_to_limbs(v) for v in (r, s, e))
-    return _verify_core(
-        r_l, s_l, e_l,
-        lambda u2, bshape: ec.comb_accumulate_multikey(
-            tabs_f32, key_idx, u2, bshape),
-        require_low_s)
+    R, C = r_l.shape[1], r_l.shape[2]
+    L = ec.L
+
+    def flat(x):
+        return x.reshape(x.shape[0], R * C)
+
+    def q_comb(u2_flat, bshape):
+        # the shared verify tail runs on the flat (R*C,) batch (1-D is
+        # what the G comb and the inversion tree are shaped for); only
+        # the key-side lookup needs the row structure back
+        u2_rc = u2_flat.reshape(u2_flat.shape[0], R, C)
+        X, Y, Z, inf = ec.comb_accumulate_rows(
+            bank_f32, row_key, u2_rc, (R, C))
+        return flat(X), flat(Y), flat(Z), inf.reshape(R * C)
+
+    out = _verify_core(flat(r_l), flat(s_l), flat(e_l), q_comb,
+                       require_low_s)
+    return out.reshape(R, C)

@@ -70,28 +70,53 @@ def sharded_p256_verify(mesh: Mesh, require_low_s: bool = True):
     return jax.jit(fn)
 
 
-def sharded_p256_multikey_verify(mesh: Mesh, require_low_s: bool = True):
-    """Sharded multi-key fixed-base P-256 verifier.
+def sharded_p256_rows_verify(mesh: Mesh, require_low_s: bool = True):
+    """Sharded row-grouped multikey P-256 verifier (the production fast
+    lane, ops/p256_fixed.verify_words_rows).
 
-    fn(tabs, key_idx, r, s, e) -> (verdicts (B,), valid_count ()): the
-    stacked per-key tables replicate to every device; key indices and
-    signature words shard over the batch axis.
+    fn(bank, row_key, r, s, e) -> (verdicts (R, C), valid_count ()): the
+    stacked per-key table bank replicates to every device; rows shard
+    over the batch axis (R divisible by mesh size — the provider pads).
     """
     from fabric_tpu.ops import p256_fixed
 
-    word_spec = PSpec(None, BATCH_AXIS)
-    idx_spec = PSpec(BATCH_AXIS)
-    tab_spec = PSpec(None, None, None)
+    word_spec = PSpec(None, BATCH_AXIS, None)
+    row_spec = PSpec(BATCH_AXIS)
+    bank_spec = PSpec(None, None, None)
 
-    def local(tabs, key_idx, r, s, e):
-        v = p256_fixed.verify_words_multikey(
-            tabs, key_idx, r, s, e, require_low_s=require_low_s)
+    def local(bank, row_key, r, s, e):
+        v = p256_fixed.verify_words_rows(
+            bank, row_key, r, s, e, require_low_s=require_low_s)
         count = jax.lax.psum(jnp.sum(v.astype(jnp.int32)), BATCH_AXIS)
         return v, count
 
     fn = jax.shard_map(
         local, mesh=mesh,
-        in_specs=(tab_spec, idx_spec, word_spec, word_spec, word_spec),
+        in_specs=(bank_spec, row_spec, word_spec, word_spec, word_spec),
+        out_specs=(PSpec(BATCH_AXIS), PSpec()))
+    return jax.jit(fn)
+
+
+def sharded_ed25519_rows_verify(mesh: Mesh):
+    """Sharded row-grouped multikey ed25519 verifier (the fast lane,
+    ops/ed25519.verify_words_rows): the niels table bank replicates;
+    rows shard over the batch axis."""
+    from fabric_tpu.ops import ed25519
+
+    word_spec = PSpec(None, BATCH_AXIS, None)
+    sign_spec = PSpec(BATCH_AXIS, None)
+    row_spec = PSpec(BATCH_AXIS)
+    bank_spec = PSpec(None, None, None)
+
+    def local(bank, row_key, ry, r_sign, s, k):
+        v = ed25519.verify_words_rows(bank, row_key, ry, r_sign, s, k)
+        count = jax.lax.psum(jnp.sum(v.astype(jnp.int32)), BATCH_AXIS)
+        return v, count
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(bank_spec, row_spec, word_spec, sign_spec, word_spec,
+                  word_spec),
         out_specs=(PSpec(BATCH_AXIS), PSpec()))
     return jax.jit(fn)
 

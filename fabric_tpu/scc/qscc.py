@@ -20,15 +20,26 @@ class Qscc:
     """Bound to one channel's block store (+ optional ACL hooks)."""
 
     def __init__(self, channel_id: str, blockstore,
-                 authorize=None):
+                 authorize=None, acl=None):
         self.channel_id = channel_id
         self.blockstore = blockstore
         # authorize: callable(SignedData|None) raising on deny — usually
-        # ChainSupport.authorize_read (the Readers policy)
+        # ChainSupport.authorize_read (the Readers policy).  When an
+        # aclmgmt provider is given instead, each method checks its OWN
+        # named resource (core/scc/qscc/query.go per-function ACLs via
+        # core/aclmgmt resources), so a config-tx ACL change retargets
+        # individual queries.
+        self.acl = acl
         self.authorize = authorize or (lambda sd: None)
 
+    def _check(self, resource: str, signed) -> None:
+        if self.acl is not None:
+            self.acl.check(resource, signed)
+        else:
+            self.authorize(signed)
+
     def get_chain_info(self, signed: Optional[SignedData] = None) -> Dict:
-        self.authorize(signed)
+        self._check("qscc/GetChainInfo", signed)
         info = self.blockstore.chain_info()
         return {"height": info.height,
                 "current_hash": info.current_hash,
@@ -36,7 +47,7 @@ class Qscc:
 
     def get_block_by_number(self, number: int,
                             signed: Optional[SignedData] = None):
-        self.authorize(signed)
+        self._check("qscc/GetBlockByNumber", signed)
         try:
             return self.blockstore.get_by_number(number)
         except Exception as exc:
@@ -44,7 +55,7 @@ class Qscc:
 
     def get_block_by_hash(self, block_hash: bytes,
                           signed: Optional[SignedData] = None):
-        self.authorize(signed)
+        self._check("qscc/GetBlockByHash", signed)
         try:
             return self.blockstore.get_by_hash(block_hash)
         except Exception as exc:
@@ -52,7 +63,7 @@ class Qscc:
 
     def get_transaction_by_id(self, txid: str,
                               signed: Optional[SignedData] = None):
-        self.authorize(signed)
+        self._check("qscc/GetTransactionByID", signed)
         try:
             block = self.blockstore.get_by_txid(txid)
         except Exception as exc:

@@ -94,3 +94,42 @@ def test_miller_prefix_matches_host():
             got_b.append(((v0 % hb.P) * rinv % hb.P,
                           (v1 % hb.P) * rinv % hb.P))
         assert tuple(got_b) == f, f"element {b} diverged"
+
+
+def test_miller_dual_prefix_matches_host():
+    """Dual-loop prefix (shared squarings, two line sets) vs the host
+    product of the two single-loop replicas."""
+    rng = random.Random(21)
+    # two distinct fixed Qs: g2 and a multiple of it (an issuer w shape)
+    w = hb.g2_mul(rng.randrange(2, hb.R), hb.G2_GEN)
+    steps_w = hb.ate_precompute(w)[:6]
+    steps_g2 = hb.ate_precompute(hb.G2_GEN)[:6]
+    packed_w = dev.pack_steps(steps_w)
+    packed_g2 = dev.pack_steps(steps_g2)
+
+    p1s = [hb.g1_mul(rng.randrange(2, hb.R), hb.G1_GEN) for _ in range(2)]
+    p2s = [hb.g1_mul(rng.randrange(2, hb.R), hb.G1_GEN) for _ in range(2)]
+    x1 = np.asarray(bn.ints_to_limbs([p[0] for p in p1s]), np.int32)
+    y1 = np.asarray(bn.ints_to_limbs([p[1] for p in p1s]), np.int32)
+    x2 = np.asarray(bn.ints_to_limbs([p[0] for p in p2s]), np.int32)
+    y2 = np.asarray(bn.ints_to_limbs([p[1] for p in p2s]), np.int32)
+    got = dev.miller_loop_dual(packed_w, packed_g2, x1, y1, x2, y2,
+                               eager=True)
+
+    rinv = pow(dev.fpb.R, -1, hb.P)
+    for b in range(2):
+        f = hb.F12_ONE
+        for (fl, A1, B1), (_, A2, B2) in zip(steps_w, steps_g2):
+            if fl:
+                f = hb.f12_sqr(f)
+            f = hb.f12_mul(f, hb._sparse013(p1s[b][1], A1, p1s[b][0], B1))
+            f = hb.f12_mul(f, hb._sparse013(p2s[b][1], A2, p2s[b][0], B2))
+        got_b = []
+        for c0, c1 in got:
+            v0 = bn.limbs_to_int(np.asarray(
+                dev.fpb.canon(dev.fpb.reduce_to_kp(c0, 64, 2)))[:, b])
+            v1 = bn.limbs_to_int(np.asarray(
+                dev.fpb.canon(dev.fpb.reduce_to_kp(c1, 64, 2)))[:, b])
+            got_b.append(((v0 % hb.P) * rinv % hb.P,
+                          (v1 % hb.P) * rinv % hb.P))
+        assert tuple(got_b) == f, f"element {b} diverged"

@@ -256,3 +256,96 @@ def test_config_block_replay_keeps_valid_flags(sw_provider, tmp_path):
     res2 = committer2.store_block(replay)
     assert res2.final_flags.is_valid(0)          # flags match the tip peer
     assert src2.current().sequence == 1          # nothing re-applied
+
+
+def test_fast_collect_differential(world):
+    """C pass-1 (native/fastcollect.c) vs pure-Python pass-1: identical
+    flags and identical deduplicated item sets over a block mixing valid
+    txs, structural rejects, duplicates, meta writes, and foreign-org
+    endorsements."""
+    from fabric_tpu.committer.txvalidator import _fastcollect
+    if _fastcollect is None:
+        pytest.skip("native fastcollect unavailable")
+    org1, org2, committer = world
+    v = committer.validator
+    v.policies.set_policy("cc", parse_policy(
+        "OR('Org1.member', 'Org2.member')"))
+    envs = []
+    for i in range(40):
+        rwset = TxRwSet((
+            NsRwSet("cc", reads=(KVRead("r", Version(0, 1)),),
+                    writes=(KVWrite(f"k{i}", b"v"),)),
+            NsRwSet("cc#meta",
+                    writes=(KVWrite(f"k{i}", b"POL", i % 3 == 0),))))
+        env = make_tx(org1, org2, rwset)
+        raw = env.serialize()
+        kind = i % 8
+        if kind == 1:
+            raw = raw[:-3]
+        elif kind == 2:
+            raw = b""
+        elif kind == 3:
+            raw = make_tx(org1, org2, rwset,
+                          creator=org2.new_identity("c2")).serialize()
+        elif kind == 5 and i > 8:
+            raw = envs[i - 8]
+        envs.append(raw)
+    from fabric_tpu.protocol.types import Block, BlockHeader, BlockMetadata
+
+    def run(force_py):
+        v.force_python_collect = force_py
+        blk = Block(BlockHeader(9, b"p", b"d"), list(envs), BlockMetadata())
+        vr = v.validate(blk)
+        return vr.flags.codes(), vr.n_unique_items
+
+    try:
+        fast = run(False)
+        slow = run(True)
+    finally:
+        v.force_python_collect = False
+    assert fast == slow
+
+
+def test_fast_collect_late_error_parity_and_deep_nesting(world):
+    """Post-registration failures (unknown type, nil action, late
+    malformed body) must register their txid BEFORE flagging on BOTH
+    collect paths — otherwise C-path and fallback peers produce
+    divergent DUPLICATE_TXID bitmaps.  Also: a deeply nested envelope
+    (C-stack attack) degrades to BAD_PAYLOAD, never a crash."""
+    from fabric_tpu.committer.txvalidator import _fastcollect
+    if _fastcollect is None:
+        pytest.skip("native fastcollect unavailable")
+    from fabric_tpu.protocol.types import Block, BlockHeader, BlockMetadata
+    from fabric_tpu.utils import serde
+
+    org1, org2, committer = world
+    v = committer.validator
+    creator = org1.new_identity("late")
+    nonce = b"fixed-nonce-late"
+    env_unknown = build.signed_envelope("weird_type", "ch", {"x": b"y"},
+                                        creator, nonce=nonce)
+    env_dup = make_tx(org1, org2, rw(writes=[KVWrite("lk", b"v")]),
+                      creator=creator)
+    # same (nonce, creator) => same txid as env_unknown
+    env_dup2 = build.signed_envelope(
+        "endorser_transaction", "ch",
+        env_dup.payload_dict()["data"], creator, nonce=nonce)
+    deep = (b"L" + (1).to_bytes(4, "big")) * 60000 + b"N"
+    evil = serde.encode({"payload": deep, "signature": b"s"})
+    envs = [env_unknown.serialize(), env_dup2.serialize(), evil]
+
+    def run(force_py):
+        v.force_python_collect = force_py
+        blk = Block(BlockHeader(7, b"p", b"d"), list(envs),
+                    BlockMetadata())
+        return v.validate(blk).flags.codes()
+
+    try:
+        fast = run(False)
+        slow = run(True)
+    finally:
+        v.force_python_collect = False
+    assert fast == slow
+    assert fast[0] == int(ValidationCode.UNKNOWN_TX_TYPE)
+    assert fast[1] == int(ValidationCode.DUPLICATE_TXID)
+    assert fast[2] == int(ValidationCode.BAD_PAYLOAD)

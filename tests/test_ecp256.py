@@ -223,21 +223,40 @@ def test_key_table_cache():
     assert cache.stats["rejects"] == 1
 
 
-def test_multikey_path_matches_generic(cases):
-    """The merged multi-key gather kernel must agree with the generic
-    path for mixed-key batches (provider dispatch shape)."""
+def test_rows_path_matches_generic(cases):
+    """The row-grouped multi-key kernel must agree with the generic
+    path for mixed-key batches (provider dispatch shape): pack the
+    adversarial case set key-major into a (R, C) grid with repeated-
+    element padding and compare verdict-for-verdict."""
     from fabric_tpu.ops import p256_fixed, p256_tables
     on_curve_cases = [c for c in cases
                       if p256_tables.on_curve(c[0], c[1])]
     keys = {}
-    for c in on_curve_cases:
+    groups = {}
+    for i, c in enumerate(on_curve_cases):
         keys.setdefault((c[0], c[1]), len(keys))
-    tabs = np.stack([p256_tables.comb_table_for_point(qx, qy)
-                     for (qx, qy) in keys]).astype(np.int32)
-    key_idx = np.asarray([keys[(c[0], c[1])] for c in on_curve_cases],
-                         dtype=np.int32)
-    _, _, r, s, e = [np.asarray(p256.ints_to_words(list(v)))
-                     for v in zip(*[c[:5] for c in on_curve_cases])]
-    out = np.asarray(p256_fixed.verify_words_multikey(tabs, key_idx, r, s, e))
-    want = [bool(c[5]) for c in on_curve_cases]
-    assert list(out) == want
+        groups.setdefault((c[0], c[1]), []).append(i)
+    bank = np.stack([p256_tables.comb_table_for_point(qx, qy)
+                     for (qx, qy) in keys]).astype(np.float32)
+    C = 4
+    row_key, flat_idx, slots = [], [], []
+    for kpt, g in groups.items():
+        n_rows = -(-len(g) // C)
+        padded = g + [g[0]] * (n_rows * C - len(g))
+        flat_idx.extend(padded)
+        row_key.extend([keys[kpt]] * n_rows)
+        slots.extend(g + [-1] * (n_rows * C - len(g)))
+    R = len(row_key)
+    _, _, r, s, e = [np.asarray(p256.ints_to_words(
+        [on_curve_cases[i][j] for i in flat_idx])) for j in range(5)]
+    out = np.asarray(p256_fixed.verify_words_rows(
+        bank, np.asarray(row_key, np.int32),
+        r.reshape(8, R, C), s.reshape(8, R, C), e.reshape(8, R, C)))
+    flat = out.reshape(-1)
+    slots_np = np.asarray(slots)
+    got = {}
+    for pos, orig in enumerate(slots_np):
+        if orig >= 0:
+            got[int(orig)] = bool(flat[pos])
+    for i, c in enumerate(on_curve_cases):
+        assert got[i] == bool(c[5]), i

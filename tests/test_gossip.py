@@ -170,3 +170,187 @@ def test_secure_transport_gossip_and_rogue_rejection(provider):
     finally:
         s1.stop()
         s2.stop()
+
+
+# ---------------------------------------------------------------------------
+# N-instance churn / partition / convergence (gossip_test.go idiom:
+# many real gossip instances in one process, deterministic pumping)
+# ---------------------------------------------------------------------------
+
+class _FakeCommitter:
+    """store_block/height surface + a blockstore for anti-entropy serves."""
+
+    class _Store:
+        def __init__(self, blocks):
+            self._blocks = blocks
+
+        @property
+        def height(self):
+            return len(self._blocks)
+
+        def get_by_number(self, n):
+            return self._blocks[n]
+
+    def __init__(self):
+        self.blocks = {}
+        self.ledger = type("L", (), {})()
+        self.ledger.blockstore = self._Store(self.blocks)
+
+    @property
+    def height(self):
+        return len(self.blocks)
+
+    def store_block(self, block):
+        assert block.header.number == self.height, "out-of-order commit"
+        self.blocks[block.header.number] = block
+
+
+def _mk_blocks(n):
+    from fabric_tpu.protocol import build
+    blocks = []
+    prev = b"\x00" * 32
+    for i in range(n):
+        blk = build.new_block(i, prev, [])
+        blocks.append(blk)
+        prev = blk.hash()
+    return blocks
+
+
+def _fleet(n, net=None):
+    """n GossipNodes on an InProcNetwork; returns (net, nodes by id)."""
+    from fabric_tpu.gossip.node import GossipNode
+
+    net = net or InProcNetwork()
+    nodes = {}
+    ids = [f"p{i}" for i in range(n)]
+    for i, pid in enumerate(ids):
+        boot = [p for p in ids if p != pid][:2]
+        nodes[pid] = GossipNode(net.register, pid, _FakeCommitter(),
+                                bootstrap=boot)
+    return net, nodes
+
+
+def _pump(net, nodes, rounds=8):
+    for _ in range(rounds):
+        for nd in nodes.values():
+            nd.tick()
+        net.deliver_all()
+
+
+def test_gossip_n_membership_convergence():
+    net, nodes = _fleet(6)
+    _pump(net, nodes)
+    for pid, nd in nodes.items():
+        alive = set(nd.discovery.alive_ids())
+        assert alive == {p for p in nodes if p != pid}, (pid, alive)
+
+
+def test_gossip_death_expires_membership():
+    net, nodes = _fleet(5)
+    _pump(net, nodes)
+    # kill p4: unreachable, no more alive msgs
+    net.dropped.add("p4")
+    dead = nodes.pop("p4")
+    # force expiry: age out p4's last-alive on every survivor
+    for nd in nodes.values():
+        nd.discovery.expiration = 1
+    _pump(net, nodes, rounds=6)
+    for pid, nd in nodes.items():
+        assert "p4" not in nd.discovery.alive_ids(), pid
+
+
+def test_gossip_partition_and_heal():
+    net, nodes = _fleet(6)
+    _pump(net, nodes)
+    left = {"p0", "p1", "p2"}
+    right = {"p3", "p4", "p5"}
+    net.partitions = [left, right]
+    for nd in nodes.values():
+        nd.discovery.expiration = 1
+    _pump(net, nodes, rounds=6)
+    for pid, nd in nodes.items():
+        side = left if pid in left else right
+        assert set(nd.discovery.alive_ids()) == side - {pid}, pid
+    # heal: full membership returns
+    net.partitions = []
+    for nd in nodes.values():
+        nd.discovery.expiration = 50
+    _pump(net, nodes, rounds=8)
+    for pid, nd in nodes.items():
+        assert set(nd.discovery.alive_ids()) == set(nodes) - {pid}, pid
+
+
+def test_gossip_block_convergence_and_catchup():
+    """Blocks enter at ONE node and commit in order everywhere; a node
+    cut off during dissemination catches up via anti-entropy."""
+    net, nodes = _fleet(5)
+    _pump(net, nodes)
+    blocks = _mk_blocks(8)
+
+    # p4 is cut off while blocks 0..3 spread
+    net.dropped.add("p4")
+    for blk in blocks[:4]:
+        nodes["p0"].state.add_block(blk)
+        _pump(net, nodes, rounds=3)
+    for pid in ("p0", "p1", "p2", "p3"):
+        assert nodes[pid].state.committer.height == 4, pid
+    assert nodes["p4"].state.committer.height == 0
+
+    # p4 rejoins; anti-entropy pulls the missing range
+    net.dropped.discard("p4")
+    for blk in blocks[4:]:
+        nodes["p0"].state.add_block(blk)
+        _pump(net, nodes, rounds=3)
+    _pump(net, nodes, rounds=10)
+    for pid, nd in nodes.items():
+        assert nd.state.committer.height == 8, (pid, nd.state.committer.height)
+
+
+def test_gossip_certstore_convergence_under_churn(provider):
+    """Identities replicate to every node, including one that joins the
+    channel after the identities were first distributed."""
+    from fabric_tpu.gossip.node import GossipNode
+
+    org = DevOrg("Org1")
+    msps = {"Org1": CachedMSP(org.msp())}
+    net = InProcNetwork()
+    ids = [f"p{i}" for i in range(4)]
+    nodes = {}
+    for pid in ids[:3]:
+        nodes[pid] = GossipNode(net.register, pid, _FakeCommitter(),
+                                bootstrap=[p for p in ids[:3] if p != pid],
+                                msps=msps,
+                                signer=org.new_identity(f"peer-{pid}"))
+    _pump(net, nodes)
+    for _ in range(6):
+        _pump(net, nodes, rounds=4)
+        if all(len(nd.certstore.digests()) >= 3 for nd in nodes.values()):
+            break
+    assert all(len(nd.certstore.digests()) >= 3 for nd in nodes.values())
+
+    # late joiner learns every identity via pull anti-entropy
+    nodes["p3"] = GossipNode(net.register, "p3", _FakeCommitter(),
+                             bootstrap=["p0", "p1"], msps=msps,
+                             signer=org.new_identity("peer-p3"))
+    for _ in range(10):
+        _pump(net, nodes, rounds=4)
+        if len(nodes["p3"].certstore.digests()) >= 4:
+            break
+    assert len(nodes["p3"].certstore.digests()) >= 4
+
+
+def test_gossip_leader_election_failover():
+    net, nodes = _fleet(4)
+    _pump(net, nodes, rounds=10)
+    leaders = {pid for pid, nd in nodes.items() if nd.election.is_leader}
+    assert len(leaders) == 1, leaders
+    (leader,) = leaders
+    # leader dies: someone else takes over
+    net.dropped.add(leader)
+    dead = nodes.pop(leader)
+    for nd in nodes.values():
+        nd.discovery.expiration = 1
+    _pump(net, nodes, rounds=12)
+    new_leaders = {pid for pid, nd in nodes.items()
+                   if nd.election.is_leader}
+    assert len(new_leaders) == 1 and leader not in new_leaders, new_leaders

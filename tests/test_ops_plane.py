@@ -96,3 +96,55 @@ def test_commit_pipeline_metrics(tmp_path):
     assert 'ledger_height{channel="met"} 1' in text
     assert 'validation_duration_seconds_count{channel="met"} 1' in text
     assert 'commit_phase_seconds' in text
+
+
+def test_profiling_routes():
+    """/debug/pprof returns pstats; /debug/profile captures a (CPU)
+    jax.profiler trace directory — the pprof slot of
+    internal/peer/node/start.go:813-825."""
+    import json
+    import urllib.request
+
+    from fabric_tpu.ops_plane import OperationsServer
+    from fabric_tpu.ops_plane.profiling import register_routes
+
+    ops = OperationsServer("127.0.0.1", 0)
+    register_routes(ops, enabled=True)
+    ops.start()
+    try:
+        url = "http://%s:%d" % ops.addr
+        req = urllib.request.Request(f"{url}/debug/pprof?seconds=0.2",
+                                     method="POST")
+        body = json.loads(urllib.request.urlopen(req, timeout=120).read())
+        assert "pstats" in body and "cumulative" in body["pstats"]
+
+        req = urllib.request.Request(f"{url}/debug/profile?seconds=0.2",
+                                     method="POST")
+        body = json.loads(urllib.request.urlopen(req, timeout=180).read())
+        assert body.get("trace_dir"), body
+        import os
+        assert os.path.isdir(body["trace_dir"])
+    finally:
+        ops.stop()
+
+
+def test_profiling_disabled_by_default():
+    import urllib.error
+    import urllib.request
+
+    from fabric_tpu.ops_plane import OperationsServer
+    from fabric_tpu.ops_plane.profiling import register_routes
+
+    ops = OperationsServer("127.0.0.1", 0)
+    register_routes(ops, enabled=False)
+    ops.start()
+    try:
+        req = urllib.request.Request(
+            "http://%s:%d/debug/pprof" % ops.addr, method="POST")
+        try:
+            urllib.request.urlopen(req, timeout=10)
+            assert False, "profiling route should not exist"
+        except urllib.error.HTTPError as e:
+            assert e.code == 404
+    finally:
+        ops.stop()
