@@ -122,14 +122,78 @@ def f12_conj(a):
     return tuple(x if k % 2 == 0 else f2_neg(x) for k, x in enumerate(a))
 
 
+def f2_conj(a: Fp2) -> Fp2:
+    """p-Frobenius on Fp2 (i^2 = -1): complex conjugation."""
+    return (a[0], (-a[1]) % P)
+
+
+def f2_pow(a: Fp2, e: int) -> Fp2:
+    result = F2_ONE
+    base = a
+    while e:
+        if e & 1:
+            result = f2_mul(result, base)
+        base = f2_sqr(base)
+        e >>= 1
+    return result
+
+
+# -- Fp6 = Fp2[v]/(v^3 - XI) and Fp12 = Fp6[w]/(w^2 - v) views ---------------
+# The degree-6-over-Fp2 coefficients (c0..c5 over w, w^6 = XI) regroup as
+# a = (c0, c2, c4) + w * (c1, c3, c5): even coefficients are the Fp6
+# element over v = w^2, odd ones the w-part.  Tower inversion then costs
+# one Fp2 inversion instead of a ~3000-squaring Fermat chain.
+
+def f6_mul(a, b):
+    a0, a1, a2 = a
+    b0, b1, b2 = b
+    t0 = f2_mul(a0, b0)
+    t1 = f2_mul(a1, b1)
+    t2 = f2_mul(a2, b2)
+    c0 = f2_add(t0, f2_mul(XI, f2_sub(
+        f2_mul(f2_add(a1, a2), f2_add(b1, b2)), f2_add(t1, t2))))
+    c1 = f2_add(f2_sub(f2_mul(f2_add(a0, a1), f2_add(b0, b1)),
+                       f2_add(t0, t1)), f2_mul(XI, t2))
+    c2 = f2_add(f2_sub(f2_mul(f2_add(a0, a2), f2_add(b0, b2)),
+                       f2_add(t0, t2)), t1)
+    return (c0, c1, c2)
+
+
+def f6_mul_by_v(a):
+    """v * (a0 + a1 v + a2 v^2) = XI*a2 + a0 v + a1 v^2."""
+    return (f2_mul(XI, a[2]), a[0], a[1])
+
+
+def f6_neg(a):
+    return tuple(f2_neg(x) for x in a)
+
+
+def f6_sub(a, b):
+    return tuple(f2_sub(x, y) for x, y in zip(a, b))
+
+
+def f6_inv(a):
+    """Inverse in Fp2[v]/(v^3 - XI) (one Fp2 inversion)."""
+    a0, a1, a2 = a
+    t0 = f2_sub(f2_sqr(a0), f2_mul(XI, f2_mul(a1, a2)))
+    t1 = f2_sub(f2_mul(XI, f2_sqr(a2)), f2_mul(a0, a1))
+    t2 = f2_sub(f2_sqr(a1), f2_mul(a0, a2))
+    norm = f2_add(f2_mul(a0, t0),
+                  f2_mul(XI, f2_add(f2_mul(a2, t1), f2_mul(a1, t2))))
+    ninv = f2_inv(norm)
+    return (f2_mul(t0, ninv), f2_mul(t1, ninv), f2_mul(t2, ninv))
+
+
 def f12_inv(a):
-    """Inverse via the norm to Fp2 chain: solve with linear algebra-free
-    approach — use a^(p^12 - 2)?  Too slow; instead use the resultant
-    trick: inv = adj/norm computed via extended Euclid over polynomials.
-    Simpler: Cramer via conjugates is heavy; use Fermat on the (small
-    number of) inversions we need: a^(p^12-2) costs ~3000 squarings —
-    acceptable for the handful of per-verify uses."""
-    return f12_pow_fermat(a)
+    """Tower inversion: a = g + h*w with g, h in Fp6 and w^2 = v;
+    (g + h w)^-1 = (g - h w) / (g^2 - h^2 v)."""
+    g = (a[0], a[2], a[4])
+    h = (a[1], a[3], a[5])
+    d = f6_sub(f6_mul(g, g), f6_mul_by_v(f6_mul(h, h)))
+    dinv = f6_inv(d)
+    gi = f6_mul(g, dinv)
+    hi = f6_neg(f6_mul(h, dinv))
+    return (gi[0], hi[0], gi[1], hi[1], gi[2], hi[2])
 
 
 _P12M2 = P**12 - 2
@@ -459,13 +523,72 @@ def _line(Tx, Ty, Qx12, Qy12, Rx=None, Ry=None):
 _HARD = (P**4 - P**2 + 1) // R
 
 
+# -- Frobenius via coefficient constants -------------------------------------
+# f^(p^i) for f = sum c_k w^k: c_k -> conj^i(c_k) * GAMMA[i][k] where
+# GAMMA[i][k] = XI^(k*(p^i-1)/6) (standard tower Frobenius; w^p =
+# XI^((p-1)/6) * w).  Replaces the ~500-squaring f^(p^2) chains.
+
+GAMMA = {
+    i: tuple(f2_pow(XI, k * (P**i - 1) // 6) for k in range(6))
+    for i in (1, 2, 3)
+}
+
+
+def f12_frobenius(a: Fp12, power: int) -> Fp12:
+    g = GAMMA[power]
+    if power % 2 == 0:
+        return tuple(f2_mul(c, g[k]) for k, c in enumerate(a))
+    return tuple(f2_mul(f2_conj(c), g[k]) for k, c in enumerate(a))
+
+
+def _pow_abs_u(m: Fp12) -> Fp12:
+    return f12_pow_raw(m, -X_BN)         # |u| (X_BN < 0)
+
+
+def _pow_u(m: Fp12) -> Fp12:
+    """m^u for the BN parameter u (negative): conj = inversion in the
+    cyclotomic subgroup (valid only AFTER the easy part)."""
+    return f12_conj(_pow_abs_u(m))
+
+
+def final_exp_hard(m: Fp12) -> Fp12:
+    """m^((p^4 - p^2 + 1)/r) for m in the cyclotomic subgroup — the
+    Devegili-Scott-Dominguez vectorial addition chain (the BN-specific
+    hard part; ~3 |u|-exponentiations + 13 mult/sqr instead of a
+    ~2500-bit generic ladder)."""
+    f1 = _pow_u(m)                       # m^u
+    f2_ = _pow_u(f1)                     # m^(u^2)
+    f3 = _pow_u(f2_)                     # m^(u^3)
+    y0 = f12_mul(f12_mul(f12_frobenius(m, 1), f12_frobenius(m, 2)),
+                 f12_frobenius(m, 3))
+    y1 = f12_conj(m)
+    y2 = f12_frobenius(f2_, 2)
+    y3 = f12_conj(f12_frobenius(f1, 1))
+    y4 = f12_conj(f12_mul(f1, f12_frobenius(f2_, 1)))
+    y5 = f12_conj(f2_)
+    y6 = f12_conj(f12_mul(f3, f12_frobenius(f3, 1)))
+    t0 = f12_sqr(y6)
+    t0 = f12_mul(t0, y4)
+    t0 = f12_mul(t0, y5)
+    t1 = f12_mul(y3, y5)
+    t1 = f12_mul(t1, t0)
+    t0 = f12_mul(t0, y2)
+    t1 = f12_sqr(t1)
+    t1 = f12_mul(t1, t0)
+    t1 = f12_sqr(t1)
+    t0 = f12_mul(t1, y1)
+    t1 = f12_mul(t1, y0)
+    t0 = f12_sqr(t0)
+    return f12_mul(t0, t1)
+
+
 def _final_exp(f: Fp12) -> Fp12:
-    # easy part: f^(p^6-1) = conj(f) * f^-1 ; then ^(p^2+1)
+    # easy part: f^(p^6-1) = conj(f) * f^-1 (tower inversion); then
+    # ^(p^2+1) via the coefficient Frobenius
     f = f12_mul(f12_conj(f), f12_inv(f))
-    f = f12_mul(f12_pow_raw(f, P * P), f)
-    # hard part (generic exponentiation; BN-specific chains are a TPU-
-    # kernel-era optimization)
-    return f12_pow_raw(f, _HARD)
+    f = f12_mul(f12_frobenius(f, 2), f)
+    # hard part: BN-specific chain
+    return final_exp_hard(f)
 
 
 # -- ate pairing with precomputed lines (the TPU-batch structure) ------------
