@@ -331,6 +331,46 @@ def main():
 
     # -- Idemix (BASELINE config 4) ------------------------------------------
     if os.environ.get("BENCH_SKIP_IDEMIX") != "1":
+        # DEVICE pairing rate: a batch of BBS+ pairing-equation checks
+        # e(P1,Q1)*e(P2,Q2)==1 through the production TPU lane
+        # (bccsp/jaxtpu 'idemix-pair' -> ops/bn254_batch.pairing_check_
+        # batch: dual Miller loop + final exponentiation).  Valid
+        # instance: e(G1,g2)*e(-G1,g2)==1; a corrupted instance must go
+        # red on device.  Replaces /root/reference/idemix/signature.go:230
+        # Ver's amcl host loops (~1.3 s/presentation on this host).
+        try:
+            import jax as _jax
+            from fabric_tpu.idemix import bn254 as hbn
+            from fabric_tpu.ops import bignum as bnmod
+            fnp = provider._get_fn("idemix-pair")
+            packed_g2 = provider._idemix_g2_packed()
+            bidm = int(os.environ.get("BENCH_IDEMIX_BATCH", "128"))
+            g1 = hbn.G1_GEN
+            x1 = np.stack([bnmod.int_to_limbs(g1[0])] * bidm, 1)
+            y1 = np.stack([bnmod.int_to_limbs(g1[1])] * bidm, 1)
+            y2 = np.stack(
+                [bnmod.int_to_limbs((hbn.P - g1[1]) % hbn.P)] * bidm, 1)
+            pargs = (packed_g2["flags"], packed_g2["A"], packed_g2["B"],
+                     packed_g2["A"], packed_g2["B"], x1, y1, x1, y2)
+            t0 = time.perf_counter()
+            outp = np.asarray(fnp(*pargs))
+            detail["idemix_device_compile_s"] = round(
+                time.perf_counter() - t0, 1)
+            assert bool(outp.all()), "valid pairing batch must pass"
+            # red: P2 = +G1 (on-curve) -> e(G1,g2)^2 != 1
+            outb = np.asarray(fnp(*pargs[:8], y1))
+            assert not outb.any(), "corrupted pairing batch must fail"
+            times = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                np.asarray(fnp(*pargs))
+                times.append(time.perf_counter() - t0)
+            dt = statistics.median(times)
+            detail["idemix_device_checks_per_sec"] = round(bidm / dt, 1)
+            detail["idemix_device_pairings_per_sec"] = round(
+                2 * bidm / dt, 1)
+        except Exception as exc:
+            detail["idemix_device_error"] = str(exc)[:200]
         try:
             from fabric_tpu.idemix import bn254 as bnc
             t0 = time.perf_counter()

@@ -38,6 +38,21 @@ logger = logging.getLogger("fabric_tpu.bccsp.jaxtpu")
 MIN_BUCKET = 128
 MAX_BUCKET = 1 << 17
 
+_ZERO32 = b"\x00" * 32
+
+_DER_PARSE = []
+
+
+def _parse_der_sigs():
+    """The C batch DER parser, or None without the extension."""
+    if not _DER_PARSE:
+        try:
+            from fabric_tpu.native import load as _load
+            _DER_PARSE.append(_load("_fastcollect").parse_der_sigs)
+        except Exception:       # pragma: no cover - broken toolchain
+            _DER_PARSE.append(None)
+    return _DER_PARSE[0]
+
 
 def _bucket(n: int) -> int:
     b = MIN_BUCKET
@@ -57,20 +72,44 @@ class JaxTpuProvider(prov.Provider):
         self.fallback = fallback or SoftwareProvider(require_low_s=require_low_s)
         self._fns = {}
         self.stats = {"dispatches": 0, "device_sigs": 0, "host_rejects": 0,
-                      "fallbacks": 0, "fast_key_sigs": 0}
+                      "fallbacks": 0, "fast_key_sigs": 0, "h2d_bytes": 0}
         # per-key fixed-base fast path (ops/p256_fixed.py): keys whose comb
-        # table is cached skip the variable-point ladder entirely.  A table
-        # build costs ~15 ms host-side, so uncached keys only earn one when
-        # a single batch brings at least `fast_key_threshold` signatures —
-        # repeat identities (org endorsers, enrolled clients: the same
-        # assumption behind the reference's msp/cache) amortize the build
-        # across blocks; true one-off keys ride the generic ladder.
-        from fabric_tpu.ops.p256_tables import KeyTableCache
-        self.key_tables = KeyTableCache(
-            max_keys=int(os.environ.get("FABRIC_TPU_KEY_CACHE", "128")))
-        from fabric_tpu.ops.ed25519_tables import Ed25519KeyTableCache
-        self.ed_key_tables = Ed25519KeyTableCache(
-            max_keys=int(os.environ.get("FABRIC_TPU_KEY_CACHE", "128")))
+        # table is DEVICE-RESIDENT (ops/device_bank.py) skip the variable-
+        # point ladder entirely; dispatches carry only slot indices, never
+        # tables.  A table build costs ~50 ms host + one 0.5 MB upload, so
+        # uncached keys only earn a slot when a single batch brings at
+        # least `fast_key_threshold` signatures — repeat identities (org
+        # endorsers, enrolled clients: the same assumption behind the
+        # reference's msp/cache) amortize the build across blocks; true
+        # one-off keys ride the generic ladder.
+        from fabric_tpu.ops.device_bank import DeviceBank
+        from fabric_tpu.ops import p256_tables as _pt
+        from fabric_tpu.ops import ed25519_tables as _et
+        max_keys = int(os.environ.get("FABRIC_TPU_KEY_CACHE", "256"))
+
+        def _build_p256(pk: bytes):
+            if len(pk) != 65 or pk[0] != 0x04:
+                return None
+            qx = int.from_bytes(pk[1:33], "big")
+            qy = int.from_bytes(pk[33:65], "big")
+            try:
+                return _pt.comb_table_for_point(qx, qy)
+            except ValueError:
+                return None
+
+        def _build_ed(pk: bytes):
+            aff = _et.decompress_int(bytes(pk))
+            if aff is None:
+                return None
+            ax, ay = aff
+            return _et.comb_table_for_point((-ax) % _et.P, ay)  # -A
+
+        self.key_tables = DeviceBank(
+            max_keys, (_pt.COMB_WINDOWS * _pt.COMB_ENTRIES, 2 * _pt.L),
+            _build_p256, mesh=mesh)
+        self.ed_key_tables = DeviceBank(
+            max_keys, (_et.COMB_WINDOWS * _et.COMB_ROWS, 3 * _et.L),
+            _build_ed, mesh=mesh)
         self.fast_key_threshold = int(
             os.environ.get("FABRIC_TPU_FAST_KEY_THRESHOLD", "64"))
 
@@ -173,7 +212,25 @@ class JaxTpuProvider(prov.Provider):
 
     def _parse_p256(self, items, idxs):
         """Host-side parse: -> list of (idx, pubkey, r32, s32, e32) with
-        malformed items dropped (verdict stays False)."""
+        malformed items dropped (verdict stays False).  The DER walk
+        rides one C call over the whole batch when the extension is
+        available (native/fastcollect.parse_der_sigs — strict DER +
+        range gate, semantics mirrored by the fallback below and tested
+        differentially)."""
+        parse = _parse_der_sigs()
+        if parse is not None:
+            ok, rs = parse([items[i].signature for i in idxs])
+            out = []
+            for j, i in enumerate(idxs):
+                it = items[i]
+                pk = it.pubkey
+                if (not ok[j] or len(pk) != 65 or pk[0] != 0x04
+                        or len(it.payload) != 32):
+                    self.stats["host_rejects"] += 1
+                    continue
+                out.append((i, pk, rs[64 * j:64 * j + 32],
+                            rs[64 * j + 32:64 * j + 64], it.payload))
+            return out
         out = []
         for i in idxs:
             it = items[i]
@@ -238,82 +295,253 @@ class JaxTpuProvider(prov.Provider):
             out = fn(*extra_args, *padded)
             self.stats["dispatches"] += 1
             self.stats["device_sigs"] += hi - lo
+            self.stats["h2d_bytes"] += sum(
+                np.asarray(a).nbytes for a in padded)
             pending.append((keep[lo:hi], out))
 
     # Row-grid geometry for the fast lane (ops/p256_fixed.verify_words_
     # rows): signatures pack key-major into rows of FAST_ROW_C lanes, so
     # ANY number of cached keys rides the comb path at constant per-sig
     # cost (the round-3 joint-one-hot kernel capped NK at 4 and spilled
-    # the rest to the generic ladder).  Row counts bucket in ~1.5x steps
-    # and the table bank in powers of two, bounding the compiled-program
-    # set; padding rows repeat real signatures and their slots are
+    # the rest to the generic ladder).  Row counts bucket in ~1.5x steps,
+    # bounding the compiled-program set; the table bank is device-
+    # resident with a FIXED shape (ops/device_bank.py), so it never
+    # enters the program signature and row_key values are bank slot
+    # indices.  Padding rows repeat real signatures and their slots are
     # dropped at resolve time.
     FAST_ROW_C = int(__import__("os").environ.get(
         "FABRIC_TPU_FAST_ROW_C", "128"))
     ROW_BUCKETS = (4, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256,
                    384, 512, 768, 1024)
-    BANK_BUCKETS = (4, 16, 64, 256)
+    # Soft per-dispatch row cap.  Default = the top bucket (one merged
+    # dispatch): on relayed/tunneled transports each dispatch costs a
+    # round trip, and A/B on the axon tunnel measured splitting at
+    # 128/192 rows LOSING ~40% vs one 384-row dispatch (0.60 s vs
+    # 0.37 s steady on the 40k-sig block).  Co-located deployments can
+    # lower it to overlap host packing with device compute.
+    ROWS_CHUNK = int(__import__("os").environ.get(
+        "FABRIC_TPU_ROWS_CHUNK", "1024"))
 
     def _verify_p256(self, items, idxs, pending):
-        """Two-lane P-256 dispatch: signatures under cached (or
-        cache-worthy) public keys take the row-grouped fixed-base comb
-        kernel in ONE merged dispatch — the key-repetitive endorsement
-        workload of SURVEY.md §3.2 — and the rest take the generic
-        windowed-ladder kernel.  Dispatches are merged because relayed
-        TPU transports charge a full round trip per dispatch."""
+        """Two-lane P-256 dispatch: signatures under device-resident (or
+        residency-worthy) public keys take the row-grouped fixed-base
+        comb kernel in ONE merged dispatch — the key-repetitive
+        endorsement workload of SURVEY.md §3.2 — and the rest take the
+        generic windowed-ladder kernel.  Dispatches are merged because
+        relayed TPU transports charge a full round trip per dispatch.
+
+        Lane cost model: a resident key's signatures always ride the
+        comb lane (zero marginal transfer — the bank lives in HBM and
+        dispatches carry slot indices only); a non-resident key earns a
+        slot only when this batch brings >= fast_key_threshold
+        signatures, amortizing the ~50 ms host table build + 0.5 MB
+        one-time upload.
+
+        Packing is numpy-vectorized end to end (the C DER batch parse +
+        array gathers): per-signature Python work was ~60% of the
+        steady-state host time at 40k sigs/block.  The rec-based path
+        below remains as the no-compiler fallback and differential
+        oracle."""
+        parse = _parse_der_sigs()
+        if parse is None:
+            return self._verify_p256_recs(items, idxs, pending)
+        n = len(idxs)
+        sigs = [None] * n
+        pays = [None] * n
+        key_ids = np.empty(n, np.int64)
+        pay_ok = np.empty(n, bool)
+        pk_map = {}
+        pks = []
+        for j, i in enumerate(idxs):
+            it = items[i]
+            sigs[j] = it.signature
+            p = it.payload
+            if len(p) == 32:
+                pays[j] = p
+                pay_ok[j] = True
+            else:
+                pays[j] = _ZERO32
+                pay_ok[j] = False
+            gid = pk_map.get(it.pubkey)
+            if gid is None:
+                gid = pk_map[it.pubkey] = len(pks)
+                pks.append(it.pubkey)
+            key_ids[j] = gid
+        ok, rs = parse(sigs)
+        G = len(pks)
+        pk_ok = np.empty(G, bool)
+        for g, pk in enumerate(pks):
+            pk_ok[g] = len(pk) == 65 and pk[0] == 0x04
+        valid = (np.frombuffer(ok, np.uint8).astype(bool)
+                 & pay_ok & pk_ok[key_ids])
+        self.stats["host_rejects"] += n - int(valid.sum())
+        if not valid.any():
+            return
+        rsw = np.frombuffer(rs, ">u4").reshape(n, 16).astype(np.uint32)
+        ew = np.frombuffer(b"".join(pays), ">u4").reshape(n, 8).astype(
+            np.uint32)
+        idxs_np = np.asarray(idxs, np.int64)
+        counts = np.bincount(key_ids[valid], minlength=G)
+        slots = np.full(G, -1, np.int64)
+        # biggest groups claim slots first; each claimed slot is PINNED
+        # in the bank until the rows dispatch has captured the bank
+        # array — a later build (this batch or a concurrent one on
+        # another thread) must not evict it, or its rows would verify
+        # against the wrong table
+        pinned = set()
+        try:
+            for g in np.argsort(-counts, kind="stable"):
+                g = int(g)
+                if not pk_ok[g] or not counts[g]:
+                    continue
+                pk = pks[g]
+                slot = self.key_tables.lookup(pk, pin=True)
+                if slot is None and counts[g] >= self.fast_key_threshold:
+                    slot = self.key_tables.get_or_build(pk, pin=True)
+                if slot is not None:
+                    pinned.add(slot)
+                    slots[g] = slot
+            fsel = np.nonzero(valid & (slots[key_ids] >= 0))[0]
+            if fsel.size:
+                self._dispatch_rows_vec(fsel, key_ids, slots, rsw, ew,
+                                        idxs_np, pending)
+        finally:
+            self.key_tables.unpin(pinned)
+        gsel = np.nonzero(valid & (slots[key_ids] < 0))[0]
+        if gsel.size:
+            gids = np.unique(key_ids[gsel])
+            remap = np.full(G, -1, np.int64)
+            remap[gids] = np.arange(gids.size)
+            pkb = np.frombuffer(
+                b"".join(pks[g] for g in gids), np.uint8).reshape(-1, 65)
+            qxw = np.ascontiguousarray(pkb[:, 1:33]).reshape(-1).view(
+                ">u4").astype(np.uint32).reshape(-1, 8)
+            qyw = np.ascontiguousarray(pkb[:, 33:65]).reshape(-1).view(
+                ">u4").astype(np.uint32).reshape(-1, 8)
+            rows = remap[key_ids[gsel]]
+            arrays = [np.ascontiguousarray(qxw[rows].T),
+                      np.ascontiguousarray(qyw[rows].T),
+                      np.ascontiguousarray(rsw[gsel, :8].T),
+                      np.ascontiguousarray(rsw[gsel, 8:].T),
+                      np.ascontiguousarray(ew[gsel].T)]
+            self._dispatch(self._get_fn(SCHEME_P256), idxs_np[gsel],
+                           arrays, pending)
+
+    def _dispatch_rows_vec(self, sel, key_ids, slots, rsw, ew, idxs_np,
+                           pending):
+        """Vectorized rows-lane packing: key-major (R, C) grid built by
+        numpy gathers over the batch word arrays; chunked by
+        ROWS_CHUNK/ROW_BUCKETS like the rec path."""
+        C = self.FAST_ROW_C
+        order = sel[np.argsort(key_ids[sel], kind="stable")]
+        gids, starts, ngs = np.unique(key_ids[order], return_index=True,
+                                      return_counts=True)
+        sel_rows, slot_rows, row_key = [], [], []
+        # largest groups first: keeps per-dispatch row chunks dense
+        for t in np.argsort(-ngs, kind="stable"):
+            g = int(gids[t])
+            s0 = int(starts[t])
+            ng = int(ngs[t])
+            grp = order[s0:s0 + ng]
+            n_rows = -(-ng // C)
+            pad = n_rows * C - ng
+            so = idxs_np[grp]
+            if pad:
+                grp = np.concatenate([grp, np.full(pad, grp[0], np.int64)])
+                so = np.concatenate([so, np.full(pad, -1, np.int64)])
+            sel_rows.append(grp.reshape(n_rows, C))
+            slot_rows.append(so.reshape(n_rows, C))
+            row_key.extend([int(slots[g])] * n_rows)
+        sel_grid = np.concatenate(sel_rows)
+        slot_grid = np.concatenate(slot_rows)
+        row_key = np.asarray(row_key, np.int32)
+        R = sel_grid.shape[0]
+        fn = self._get_fn("p256-rows")
+        bank = self.key_tables.array()
+        max_rows = min(self.ROW_BUCKETS[-1], max(self.ROWS_CHUNK, 1))
+        for lo in range(0, R, max_rows):
+            hi = min(lo + max_rows, R)
+            sg, rk, og = sel_grid[lo:hi], row_key[lo:hi], slot_grid[lo:hi]
+            Rb = next(b for b in self.ROW_BUCKETS if b >= hi - lo)
+            if self.mesh is not None:
+                size = self.mesh.devices.size
+                while Rb % size:
+                    Rb += 1
+            if Rb > hi - lo:
+                padrows = Rb - (hi - lo)
+                sg = np.concatenate([sg, np.repeat(sg[:1], padrows, 0)])
+                rk = np.concatenate([rk, np.repeat(rk[:1], padrows)])
+                og = np.concatenate(
+                    [og, np.full((padrows, C), -1, np.int64)])
+            flat = sg.reshape(-1)
+            words = [
+                np.ascontiguousarray(rsw[flat, :8].T).reshape(8, Rb, C),
+                np.ascontiguousarray(rsw[flat, 8:].T).reshape(8, Rb, C),
+                np.ascontiguousarray(ew[flat].T).reshape(8, Rb, C)]
+            out = fn(bank, rk, *words)
+            self.stats["h2d_bytes"] += (
+                sum(w.nbytes for w in words) + rk.nbytes)
+            self._enqueue_rows_out(out, og.reshape(-1), pending)
+
+    def _verify_p256_recs(self, items, idxs, pending):
+        """Rec-based fallback lane split (no C extension)."""
         recs = self._parse_p256(items, idxs)
         groups = {}
         for rec in recs:
             groups.setdefault(rec[1], []).append(rec)
         generic, fast = [], []
-        for pk, g in groups.items():
-            tab = None
-            if pk in self.key_tables or len(g) >= self.fast_key_threshold:
-                tab = self.key_tables.get_or_build(pk)
-            if tab is None:
-                generic.extend(g)
-            else:
-                fast.append((tab, g))
-        # largest groups first: keeps per-dispatch row chunks dense
-        fast.sort(key=lambda t: -len(t[1]))
-        if fast:
-            self._dispatch_rows(fast, pending)
+        pinned = set()
+        try:
+            for pk, g in sorted(groups.items(),
+                                key=lambda kv: -len(kv[1])):
+                slot = self.key_tables.lookup(pk, pin=True)
+                if slot is None and len(g) >= self.fast_key_threshold:
+                    slot = self.key_tables.get_or_build(pk, pin=True)
+                if slot is None:
+                    generic.extend(g)
+                else:
+                    pinned.add(slot)
+                    fast.append((slot, g))
+            # largest groups first: keeps per-dispatch row chunks dense
+            fast.sort(key=lambda t: -len(t[1]))
+            if fast:
+                self._dispatch_rows(fast, pending)
+        finally:
+            self.key_tables.unpin(pinned)
         generic.sort(key=lambda rec: rec[0])
         keep, arrays = self._pack_p256_recs(generic)
         if keep:
             self._dispatch(self._get_fn(SCHEME_P256), keep, arrays, pending)
 
     def _row_chunks(self, fast):
-        """Pack (table, group) pairs into row-grid chunks:
-        [(tabs, row_key, flat_recs, slots, Rb)], each at most the top
-        row bucket, row counts padded to a bucket (and to the mesh
-        size), padding slots marked -1 (dropped at resolve)."""
+        """Pack (bank_slot, group) pairs into row-grid chunks:
+        [(row_key, flat_recs, slots, Rb)], each at most the top row
+        bucket, row counts padded to a bucket (and to the mesh size),
+        padding slots marked -1 (dropped at resolve).  row_key entries
+        are device-bank slot indices — no per-chunk table list."""
         C = self.FAST_ROW_C
-        max_rows = self.ROW_BUCKETS[-1]
+        max_rows = min(self.ROW_BUCKETS[-1], max(self.ROWS_CHUNK, 1))
         chunks = []
-        cur = {"tabs": [], "row_key": [], "recs": [], "slots": []}
+        cur = {"row_key": [], "recs": [], "slots": []}
 
         def close():
             if cur["row_key"]:
-                chunks.append((cur["tabs"], cur["row_key"], cur["recs"],
-                               cur["slots"]))
-                cur.update(tabs=[], row_key=[], recs=[], slots=[])
+                chunks.append((cur["row_key"], cur["recs"], cur["slots"]))
+                cur.update(row_key=[], recs=[], slots=[])
 
-        for tab, g in fast:
+        for bank_slot, g in fast:
             gi = 0
             while gi < len(g):
                 room = max_rows - len(cur["row_key"])
-                if room == 0 or len(cur["tabs"]) >= self.BANK_BUCKETS[-1]:
+                if room == 0:
                     close()
                     room = max_rows
                 take = min(len(g) - gi, room * C)
                 part = g[gi:gi + take]
                 gi += take
-                ki = len(cur["tabs"])
-                cur["tabs"].append(tab)
                 n_rows = -(-len(part) // C)
                 pad = n_rows * C - len(part)
-                cur["row_key"].extend([ki] * n_rows)
+                cur["row_key"].extend([bank_slot] * n_rows)
                 cur["recs"].extend(part)
                 cur["recs"].extend([part[0]] * pad)   # repeat; dropped
                 cur["slots"].extend([rec[0] for rec in part])
@@ -321,7 +549,7 @@ class JaxTpuProvider(prov.Provider):
         close()
 
         out = []
-        for tabs, row_key, frecs, slots in chunks:
+        for row_key, frecs, slots in chunks:
             R = len(row_key)
             Rb = next(b for b in self.ROW_BUCKETS if b >= R)
             if self.mesh is not None:
@@ -331,8 +559,8 @@ class JaxTpuProvider(prov.Provider):
             if Rb > R:
                 frecs = frecs + [frecs[0]] * ((Rb - R) * C)
                 slots = slots + [-1] * ((Rb - R) * C)
-                row_key = row_key + [0] * (Rb - R)
-            out.append((tabs, row_key, frecs, slots, Rb))
+                row_key = row_key + [row_key[0]] * (Rb - R)
+            out.append((row_key, frecs, slots, Rb))
         return out
 
     def _enqueue_rows_out(self, out, slots, pending):
@@ -348,36 +576,41 @@ class JaxTpuProvider(prov.Provider):
                  np.asarray(out).reshape(-1)[valid]))
 
     def _dispatch_rows(self, fast, pending):
-        """P-256 row-grid dispatches (recs: (idx, pk, r32, s32, e32))."""
+        """P-256 row-grid dispatches (fast: [(bank_slot, recs)], recs:
+        (idx, pk, r32, s32, e32)).  The table bank is already in HBM —
+        only r/s/e words and the slot vector cross host->device."""
         from fabric_tpu.ops import p256 as p256mod
         C = self.FAST_ROW_C
         fn = self._get_fn("p256-rows")
-        for tabs, row_key, frecs, slots, Rb in self._row_chunks(fast):
-            K = len(tabs)
-            Kb = next(b for b in self.BANK_BUCKETS if b >= K)
-            bank = np.stack(tabs + [tabs[0]] * (Kb - K)).astype(np.float32)
+        bank = self.key_tables.array()
+        for row_key, frecs, slots, Rb in self._row_chunks(fast):
             words = [p256mod.bytes32_to_words(
                 [rec[j] for rec in frecs]).reshape(8, Rb, C)
                 for j in (2, 3, 4)]
-            out = fn(bank, np.asarray(row_key, dtype=np.int32), *words)
+            rk = np.asarray(row_key, dtype=np.int32)
+            out = fn(bank, rk, *words)
+            self.stats["h2d_bytes"] += (
+                sum(w.nbytes for w in words) + rk.nbytes)
             self._enqueue_rows_out(out, slots, pending)
 
     def _dispatch_ed_rows(self, fast, pending):
-        """ed25519 row-grid dispatches (recs: (idx, pk, sig, msg))."""
+        """ed25519 row-grid dispatches (fast: [(bank_slot, recs)], recs:
+        (idx, pk, sig, msg))."""
         from fabric_tpu.ops import ed25519 as edmod
         C = self.FAST_ROW_C
         fn = self._get_fn("ed25519-rows")
-        for tabs, row_key, frecs, slots, Rb in self._row_chunks(fast):
-            K = len(tabs)
-            Kb = next(b for b in self.BANK_BUCKETS if b >= K)
-            bank = np.stack(tabs + [tabs[0]] * (Kb - K)).astype(np.float32)
+        bank = self.ed_key_tables.array()
+        for row_key, frecs, slots, Rb in self._row_chunks(fast):
             ay, a_sign, ry, r_sign, s, k = edmod.pack_verify_inputs(
                 [rec[1] for rec in frecs], [rec[2] for rec in frecs],
                 [rec[3] for rec in frecs])
-            out = fn(bank, np.asarray(row_key, dtype=np.int32),
-                     ry.reshape(8, Rb, C),
-                     r_sign.reshape(Rb, C).astype(np.int32),
-                     s.reshape(8, Rb, C), k.reshape(8, Rb, C))
+            rk = np.asarray(row_key, dtype=np.int32)
+            args = (ry.reshape(8, Rb, C),
+                    r_sign.reshape(Rb, C).astype(np.int32),
+                    s.reshape(8, Rb, C), k.reshape(8, Rb, C))
+            out = fn(bank, rk, *args)
+            self.stats["h2d_bytes"] += (
+                sum(np.asarray(a).nbytes for a in args) + rk.nbytes)
             self._enqueue_rows_out(out, slots, pending)
 
     def _verify_ed25519(self, items, idxs, pending):
@@ -395,18 +628,23 @@ class JaxTpuProvider(prov.Provider):
         for rec in recs:
             groups.setdefault(rec[1], []).append(rec)
         fast, generic = [], []
-        for pk, g in groups.items():
-            tab = None
-            if (pk in self.ed_key_tables
-                    or len(g) >= self.fast_key_threshold):
-                tab = self.ed_key_tables.get_or_build(pk)
-            if tab is None:
-                generic.extend(g)
-            else:
-                fast.append((tab, g))
-        fast.sort(key=lambda t: -len(t[1]))
-        if fast:
-            self._dispatch_ed_rows(fast, pending)
+        pinned = set()
+        try:
+            for pk, g in sorted(groups.items(),
+                                key=lambda kv: -len(kv[1])):
+                slot = self.ed_key_tables.lookup(pk, pin=True)
+                if slot is None and len(g) >= self.fast_key_threshold:
+                    slot = self.ed_key_tables.get_or_build(pk, pin=True)
+                if slot is None:
+                    generic.extend(g)
+                else:
+                    pinned.add(slot)
+                    fast.append((slot, g))
+            fast.sort(key=lambda t: -len(t[1]))
+            if fast:
+                self._dispatch_ed_rows(fast, pending)
+        finally:
+            self.ed_key_tables.unpin(pinned)
         generic.sort(key=lambda rec: rec[0])
         if generic:
             from fabric_tpu.ops import ed25519 as edmod

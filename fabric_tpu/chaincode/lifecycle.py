@@ -33,13 +33,25 @@ class LifecycleContract(Contract):
     """The `_lifecycle` contract: approve_for_org / commit / query.
 
     approve: records the calling org's approval of (name, sequence, ...).
-    commit : requires approvals recorded for the majority of `msp_ids`
-             (lifecycle's default LifecycleEndorsement majority policy),
-             then writes the definition.
+    commit : requires approvals recorded for the majority of the
+             channel's org set (lifecycle's default LifecycleEndorsement
+             majority policy), then writes the definition.
+
+    `msp_ids` is either a static org list (single-channel/test use) or
+    a callable(channel_id) -> org list, so a node-global contract
+    instance evaluates each channel's commit against THAT channel's
+    live org set — a fixed bootstrap-channel list would let an
+    under-approved definition commit on a wider channel.
     """
 
-    def __init__(self, msp_ids: List[str]):
-        self.msp_ids = sorted(msp_ids)
+    def __init__(self, msp_ids):
+        self._msp_ids = msp_ids
+
+    def _orgs(self, stub: ChaincodeStub) -> List[str]:
+        if callable(self._msp_ids):
+            return sorted(self._msp_ids(
+                getattr(stub, "channel_id", None)))
+        return sorted(self._msp_ids)
 
     def invoke(self, stub: ChaincodeStub, fn: str, args: List[bytes]) -> bytes:
         if fn == "approve_for_org":
@@ -65,15 +77,16 @@ class LifecycleContract(Contract):
                 sequence: bytes, policy: bytes = b"") -> bytes:
         name_s, seq = name.decode(), int(sequence)
         want = serde.encode({"version": version.decode(), "policy": policy})
+        orgs = self._orgs(stub)
         approvals = 0
-        for mspid in self.msp_ids:
+        for mspid in orgs:
             got = stub.get_state(_approval_key(name_s, seq, mspid))
             if got == want:
                 approvals += 1
-        if approvals <= len(self.msp_ids) // 2:
+        if not orgs or approvals <= len(orgs) // 2:
             raise SimulationError(
                 f"insufficient approvals for {name_s} seq {seq}: "
-                f"{approvals}/{len(self.msp_ids)}")
+                f"{approvals}/{len(orgs)}")
         prev = stub.get_state(_def_key(name_s))
         if prev is not None and serde.decode(prev)["sequence"] >= seq:
             raise SimulationError(f"sequence {seq} already committed")

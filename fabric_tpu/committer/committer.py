@@ -144,8 +144,8 @@ class Committer:
         if new_cfg is not None and final.is_valid(0):
             try:
                 from fabric_tpu.config import Bundle
-                self.bundle_source.update(Bundle(new_cfg))
-                self.bundle_source.config_height = block.header.number
+                self.bundle_source.update(Bundle(new_cfg),
+                                          config_height=block.header.number)
                 if self.confighistory is not None:
                     self.confighistory.record(block.header.number,
                                               new_cfg.serialize())

@@ -38,15 +38,9 @@ import numpy as np
 from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
 from fabric_tpu.msp import Identity
 from fabric_tpu.policy import PolicyEvaluator, SignaturePolicy, SignedData
-from fabric_tpu.protocol import (
-    Block,
-    Envelope,
-    Header,
-    Transaction,
-)
-from fabric_tpu.protocol.build import compute_txid
+from fabric_tpu.protocol import Block
 from fabric_tpu.protocol.txflags import TxFlags, ValidationCode
-from fabric_tpu.protocol.types import META_TXFLAGS, TX_CONFIG, TX_ENDORSER
+from fabric_tpu.protocol.types import META_TXFLAGS
 
 logger = logging.getLogger("fabric_tpu.committer")
 
@@ -141,6 +135,16 @@ class TxValidator:
         self.sbe_lookup = sbe_lookup
         # blkstorage-backed duplicate-txid oracle (validator.go dedup vs ledger)
         self.ledger_has_txid = ledger_has_txid or (lambda txid: False)
+        # (block_number, txid-map) of blocks begun whose txids the
+        # ledger oracle cannot see yet: a pipelined driver
+        # (validate_begin N+1 before block N commits) must still flag a
+        # txid duplicated across the in-flight window.  Entries are
+        # pruned at the NEXT begin, once the ledger can see them — not
+        # at validate_finish, which returns before the commit and would
+        # reopen the window.  Maps for block numbers >= the incoming
+        # block are also pruned: a replay of the same or an earlier
+        # block (catch-up, crash recovery) is not a duplicate of itself.
+        self._inflight_txids: List[Tuple[int, Dict[str, int]]] = []
 
     @property
     def msps(self):
@@ -169,143 +173,17 @@ class TxValidator:
         from fabric_tpu.msp import deserialize_from_msps
         return deserialize_from_msps(self.msps, ident_bytes)
 
-    def _collect_tx(self, tx_num: int, env_bytes: bytes, flags: TxFlags,
-                    seen_txids: Dict[str, int],
-                    items: Dict[Tuple, VerifyItem],
-                    n_txs: int = 1) -> Optional[_TxWork]:
-        """ValidateTransaction's structural half + workload collection.
-        Returns None when the tx is already terminally flagged."""
-        if not env_bytes:
-            flags.set(tx_num, ValidationCode.NIL_ENVELOPE)
-            return None
-        try:
-            env = Envelope.deserialize(env_bytes)
-            payload = env.payload_dict()  # decode ONCE; header comes from it
-            header = Header.from_dict(payload["header"])
-        except Exception:
-            flags.set(tx_num, ValidationCode.BAD_PAYLOAD)
-            return None
-        ch = header.channel_header
-        if ch.channel_id != self.channel_id:
-            flags.set(tx_num, ValidationCode.TARGET_CHAIN_NOT_FOUND)
-            return None
-        sh = header.signature_header
-        # txid must be derivable from (nonce, creator) — msgvalidation.go
-        if ch.txid != compute_txid(sh.nonce, sh.creator):
-            flags.set(tx_num, ValidationCode.BAD_PROPOSAL_TXID)
-            return None
-        # duplicate txid: against the ledger and earlier txs in this block
-        if ch.txid in seen_txids or self.ledger_has_txid(ch.txid):
-            flags.set(tx_num, ValidationCode.DUPLICATE_TXID)
-            return None
-        seen_txids[ch.txid] = tx_num
-
-        if ch.type == TX_CONFIG:
-            # config txs must ride alone in their block (the chain's
-            # configure() isolates them); one smuggled into a multi-tx
-            # block by a byzantine orderer must be flagged invalid, never
-            # deferred to a commit-time check that only looks at 1-tx blocks
-            if n_txs != 1:
-                flags.set(tx_num, ValidationCode.INVALID_CONFIG_TRANSACTION)
-                return None
-            # content validation happens commit-side against the current
-            # bundle; the creator sig still gets checked like any other
-            work = _TxWork(tx_num)
-        elif ch.type == TX_ENDORSER:
-            work = _TxWork(tx_num)
-        else:
-            flags.set(tx_num, ValidationCode.UNKNOWN_TX_TYPE)
-            return None
-
-        # creator signature item (checkSignatureFromCreator)
-        creator = self._deserialize(sh.creator)
-        if creator is None or not _msp_validates(self.msps, creator):
-            flags.set(tx_num, ValidationCode.BAD_CREATOR_SIGNATURE)
-            return None
-        item = creator.verify_item(env.payload, env.signature)
-        key = self._item_key(item)
-        items.setdefault(key, item)
-        work.creator_key = key
-        work.creator_identity = creator
-
-        if ch.type == TX_CONFIG:
-            return work
-
-        # endorser tx: unpack actions, collect endorsement sets
-        try:
-            tx = Transaction.from_dict(payload["data"])
-            if not tx.actions:
-                flags.set(tx_num, ValidationCode.NIL_TXACTION)
-                return None
-        except Exception:
-            flags.set(tx_num, ValidationCode.BAD_PAYLOAD)
-            return None
-
-        from fabric_tpu.committer import sbe as sbemod
-        for action in tx.actions:
-            endorsed = action.endorsed_bytes()
-            # policy scope: the invoked chaincode plus every namespace the tx
-            # WRITES (dispatcher.go:189-191) — read-only namespaces are not
-            # endorsement-checked in the reference
-            namespaces = set()
-            for ns_set in action.action.rwset.ns_rwsets:
-                if not ns_set.writes:
-                    continue
-                # metadata namespaces route to their BASE namespace's
-                # policy surface; the keys are gated individually below
-                from fabric_tpu.committer import sbe as _sbe
-                namespaces.add(_sbe.base_namespace(ns_set.namespace)
-                               if _sbe.is_meta_namespace(ns_set.namespace)
-                               else ns_set.namespace)
-            namespaces.add(action.action.chaincode_id)
-            # SBE bookkeeping: written keys per base namespace + this tx's
-            # validation-parameter updates (statebased/validator_keylevel.go)
-            for ns_set in action.action.rwset.ns_rwsets:
-                if not ns_set.writes:
-                    continue
-                if sbemod.is_meta_namespace(ns_set.namespace):
-                    base = sbemod.base_namespace(ns_set.namespace)
-                    for w in ns_set.writes:
-                        work.meta_writes.append(
-                            (base, w.key,
-                             None if w.is_delete else w.value))
-                else:
-                    # accumulate across actions — assignment would let a
-                    # later action's writes clobber an earlier action's
-                    # keys out of SBE gating (multi-action same-namespace)
-                    prev = work.written_keys.get(ns_set.namespace, ())
-                    work.written_keys[ns_set.namespace] = prev + tuple(
-                        w.key for w in ns_set.writes)
-            # one signature set per action; evaluated against every
-            # written namespace's policy (dispatcher.go:189-191)
-            sigset: List[Tuple[Tuple, Identity]] = []
-            seen_idents = set()
-            for e in action.endorsements:
-                if e.endorser in seen_idents:  # policy.go:385-387 dedup
-                    continue
-                seen_idents.add(e.endorser)
-                ident = self._deserialize(e.endorser)
-                if ident is None:
-                    continue
-                it = ident.verify_item(endorsed + e.endorser, e.signature)
-                k = self._item_key(it)
-                items.setdefault(k, it)
-                sigset.append((k, ident))
-            for ns in sorted(namespaces):
-                pol = self.policies.policy_for(ns)
-                if pol is None:
-                    flags.set(tx_num, ValidationCode.INVALID_CHAINCODE)
-                    return None
-                work.namespaces.append((ns, pol, sigset))
-        return work
-
     def _collect_tx_fast(self, tx_num: int, rec, flags: TxFlags,
                          seen_txids: Dict[str, int],
                          items: Dict[Tuple, VerifyItem],
-                         memo: dict, n_txs: int = 1) -> Optional[_TxWork]:
-        """Pass-1 tail for one tx whose structural walk already ran in C
-        (fastcollect.collect).  Must reproduce _collect_tx's decisions
-        exactly — tests run both paths differentially."""
+                         memo: dict, n_txs: int = 1,
+                         has_txid=None) -> Optional[_TxWork]:
+        """Pass-1 tail for one tx whose structural walk ran in either
+        front walker — C (native/fastcollect.c) or the Python mirror
+        (committer/collect_py.py).  One consumer tail for both walkers
+        is the invariant that keeps C-enabled and no-compiler peers on
+        identical validity bitmaps; the walkers themselves are tested
+        differentially."""
         if isinstance(rec, int):
             # pre-registration structural failure: the txid never
             # entered seen_txids on the Python path either
@@ -318,14 +196,14 @@ class TxValidator:
             # still read DUPLICATE_TXID — bitmaps must not diverge
             # between the C and no-compiler paths
             code, txid = rec
-            if txid in seen_txids or self.ledger_has_txid(txid):
+            if txid in seen_txids or (has_txid or self.ledger_has_txid)(txid):
                 flags.set(tx_num, ValidationCode.DUPLICATE_TXID)
                 return None
             seen_txids[txid] = tx_num
             flags.set(tx_num, _FC_CODES[code])
             return None
         txtype, txid, creator_bytes, payload, pdigest, signature, actions = rec
-        if txid in seen_txids or self.ledger_has_txid(txid):
+        if txid in seen_txids or (has_txid or self.ledger_has_txid)(txid):
             flags.set(tx_num, ValidationCode.DUPLICATE_TXID)
             return None
         seen_txids[txid] = tx_num
@@ -513,27 +391,37 @@ class TxValidator:
         use_fast = (_fastcollect is not None
                     and not getattr(self, "force_python_collect", False))
         if use_fast:
-            memo: dict = {}
             recs = _fastcollect.collect(block.data, self.channel_id)
-            for tx_num, rec in enumerate(recs):
-                work = self._collect_tx_fast(tx_num, rec, flags, seen_txids,
-                                             items, memo, n_txs=n)
-                if work is not None:
-                    works.append(work)
-                if (tx_num + 1) % chunk == 0:
-                    flush()
         else:
-            for tx_num, env_bytes in enumerate(block.data):
-                work = self._collect_tx(tx_num, env_bytes, flags, seen_txids,
-                                        items, n_txs=n)
-                if work is not None:
-                    works.append(work)
-                if (tx_num + 1) % chunk == 0:
-                    flush()
+            from fabric_tpu.committer import collect_py
+            recs = collect_py.collect(block.data, self.channel_id)
+        # duplicate-txid oracle widened by the in-flight window: a txid
+        # in an earlier block the ledger cannot see yet is a duplicate
+        # here.  Prune entries the ledger now covers (committed) and
+        # entries at/above this block's number (replay of the window).
+        num = block.header.number
+        self._inflight_txids = [
+            (n, m) for n, m in self._inflight_txids
+            if m and n < num
+            and not self.ledger_has_txid(next(iter(m)))]
+        carry = [m for _, m in self._inflight_txids]
+        has_txid = (self.ledger_has_txid if not carry else (
+            lambda t: any(t in s for s in carry)
+            or self.ledger_has_txid(t)))
+        memo: dict = {}
+        for tx_num, rec in enumerate(recs):
+            work = self._collect_tx_fast(tx_num, rec, flags, seen_txids,
+                                         items, memo, n_txs=n,
+                                         has_txid=has_txid)
+            if work is not None:
+                works.append(work)
+            if (tx_num + 1) % chunk == 0:
+                flush()
         flush()
+        self._inflight_txids.append((num, seen_txids))
         return {"block": block, "flags": flags, "items": items,
                 "works": works, "resolvers": resolvers,
-                "msps": self._msps_snapshot,
+                "msps": self._msps_snapshot, "seen_txids": seen_txids,
                 "collect_s": time.perf_counter() - t0}
 
     def _finish_inner(self, state: dict) -> ValidationResult:

@@ -231,7 +231,7 @@ class BundleSource:
         with self._lock:
             return self._bundle
 
-    def update(self, bundle: Bundle) -> None:
+    def update(self, bundle: Bundle, config_height: int = None) -> None:
         with self._lock:
             # check-and-swap under one lock: concurrent appliers must not
             # be able to install an older bundle over a newer one
@@ -240,6 +240,12 @@ class BundleSource:
                     f"config sequence regression: {bundle.sequence} <= "
                     f"{self._bundle.sequence}")
             self._bundle = bundle
+            if config_height is not None:
+                # advanced atomically with the bundle so on_update
+                # listeners (e.g. the peer's config persistence) observe
+                # a consistent (bundle, height) pair
+                self.config_height = max(self.config_height,
+                                         int(config_height))
             listeners = list(self._listeners)
         for cb in listeners:
             cb(bundle)

@@ -40,15 +40,15 @@ def _build(name: str):
 
 
 def load(name: str):
-    """Import a native extension, building it if needed.  Returns the
-    module or None (unavailable / disabled)."""
+    """Import a native extension, (re)building it if the source is newer
+    than the cached .so.  Returns the module or None (unavailable /
+    disabled)."""
     if os.environ.get("FABRIC_TPU_NO_NATIVE") == "1":
         return None
     try:
-        return importlib.import_module(f"fabric_tpu.native.{name}")
-    except ImportError:
-        pass
-    try:
+        # always go through _build: it checks source-vs-.so mtimes, so a
+        # source edit invalidates the cache (importing first would pin a
+        # stale build for every new process)
         return _build(name)
     except Exception as exc:
         logger.warning("native extension %s unavailable (%s); using "

@@ -23,6 +23,11 @@
 #include <Python.h>
 #include <string.h>
 
+/* uniform nesting cap — MUST match fabric_tpu.utils.serde.MAX_DEPTH and
+ * native/fastcollect.c: a value one codec accepts and another rejects
+ * is a validation fork between peers */
+#define FTLV_MAX_DEPTH 64
+
 /* ------------------------------------------------------------------ */
 /* growable output buffer                                              */
 
@@ -86,7 +91,7 @@ static int buf_put_len(buf_t *b, Py_ssize_t n) {
 /* ------------------------------------------------------------------ */
 /* encode                                                              */
 
-static int enc(PyObject *v, buf_t *b);
+static int enc(PyObject *v, buf_t *b, int depth);
 
 static int enc_int(PyObject *v, buf_t *b) {
     int overflow = 0;
@@ -141,16 +146,16 @@ static int enc_str(PyObject *v, buf_t *b) {
     return buf_put(b, s, n);
 }
 
-static int enc_seq(PyObject *v, buf_t *b) {
+static int enc_seq(PyObject *v, buf_t *b, int depth) {
     Py_ssize_t n = PySequence_Fast_GET_SIZE(v);
     if (buf_putc(b, 'L') < 0 || buf_put_len(b, n) < 0) return -1;
     PyObject **items = PySequence_Fast_ITEMS(v);
     for (Py_ssize_t i = 0; i < n; i++)
-        if (enc(items[i], b) < 0) return -1;
+        if (enc(items[i], b, depth + 1) < 0) return -1;
     return 0;
 }
 
-static int enc_dict(PyObject *v, buf_t *b) {
+static int enc_dict(PyObject *v, buf_t *b, int depth) {
     PyObject *keys = PyDict_Keys(v);
     if (!keys) return -1;
     if (PyList_Sort(keys) < 0) { Py_DECREF(keys); return -1; }
@@ -175,7 +180,7 @@ static int enc_dict(PyObject *v, buf_t *b) {
                 PyErr_SetString(PyExc_KeyError, "key vanished during encode");
             goto done;
         }
-        if (enc(val, b) < 0) goto done;
+        if (enc(val, b, depth + 1) < 0) goto done;
     }
     rc = 0;
 done:
@@ -183,7 +188,11 @@ done:
     return rc;
 }
 
-static int enc(PyObject *v, buf_t *b) {
+static int enc(PyObject *v, buf_t *b, int depth) {
+    if (depth > FTLV_MAX_DEPTH) {
+        PyErr_SetString(PyExc_ValueError, "nesting too deep");
+        return -1;
+    }
     if (Py_EnterRecursiveCall(" in ftlv encode")) return -1;
     int rc = -1;
     if (v == Py_None) {
@@ -199,9 +208,9 @@ static int enc(PyObject *v, buf_t *b) {
     } else if (PyUnicode_Check(v)) {
         rc = enc_str(v, b);
     } else if (PyList_Check(v) || PyTuple_Check(v)) {
-        rc = enc_seq(v, b);
+        rc = enc_seq(v, b, depth);
     } else if (PyDict_Check(v)) {
-        rc = enc_dict(v, b);
+        rc = enc_dict(v, b, depth);
     } else {
         PyErr_Format(PyExc_TypeError, "unsupported type %R", Py_TYPE(v));
     }
@@ -212,7 +221,7 @@ static int enc(PyObject *v, buf_t *b) {
 static PyObject *py_encode(PyObject *self, PyObject *arg) {
     buf_t b;
     if (buf_init(&b) < 0) return PyErr_NoMemory();
-    if (enc(arg, &b) < 0) {
+    if (enc(arg, &b, 0) < 0) {
         buf_free(&b);
         return NULL;
     }
@@ -249,7 +258,11 @@ static int rd_u32(rd_t *r, uint32_t *out) {
     return 0;
 }
 
-static PyObject *dec(rd_t *r) {
+static PyObject *dec(rd_t *r, int depth) {
+    if (depth > FTLV_MAX_DEPTH) {
+        PyErr_SetString(PyExc_ValueError, "nesting too deep");
+        return NULL;
+    }
     if (rd_need(r, 1) < 0) return NULL;
     unsigned char tag = r->p[r->off++];
     PyObject *out = NULL;
@@ -270,6 +283,13 @@ static PyObject *dec(rd_t *r) {
     case 'V': {
         uint32_t n;
         if (rd_u32(r, &n) < 0 || rd_need(r, n) < 0) break;
+        /* canonical: minimal magnitude, >= 2^63 (encoder emits 'I'
+         * below that) — matches serde.py strict decode */
+        if (n < 8 || r->p[r->off] == 0
+            || (n == 8 && r->p[r->off] < 0x80)) {
+            PyErr_SetString(PyExc_ValueError, "non-canonical V int");
+            break;
+        }
         out = _PyLong_FromByteArray(r->p + r->off, n, /*little=*/0,
                                     /*signed=*/0);
         r->off += n;
@@ -295,7 +315,7 @@ static PyObject *dec(rd_t *r) {
         out = PyList_New(0);
         if (!out) break;
         for (uint32_t i = 0; i < n; i++) {
-            PyObject *item = dec(r);
+            PyObject *item = dec(r, depth + 1);
             if (!item || PyList_Append(out, item) < 0) {
                 Py_XDECREF(item);
                 Py_CLEAR(out);
@@ -310,16 +330,33 @@ static PyObject *dec(rd_t *r) {
         if (rd_u32(r, &n) < 0) break;
         out = PyDict_New();
         if (!out) break;
+        const unsigned char *prev_k = NULL;
+        uint32_t prev_kn = 0;
         for (uint32_t i = 0; i < n; i++) {
             uint32_t kn;
             if (rd_u32(r, &kn) < 0 || rd_need(r, kn) < 0) {
                 Py_CLEAR(out);
                 break;
             }
+            const unsigned char *kraw = r->p + r->off;
+            /* canonical: strictly increasing keys, bytewise (UTF-8
+             * order == code-point order) — also bans duplicates */
+            if (prev_k) {
+                uint32_t m = prev_kn < kn ? prev_kn : kn;
+                int cmp = memcmp(prev_k, kraw, m);
+                if (cmp > 0 || (cmp == 0 && prev_kn >= kn)) {
+                    PyErr_SetString(PyExc_ValueError,
+                                    "non-canonical dict key order");
+                    Py_CLEAR(out);
+                    break;
+                }
+            }
+            prev_k = kraw;
+            prev_kn = kn;
             PyObject *k = PyUnicode_DecodeUTF8(
                 (const char *)r->p + r->off, kn, NULL);
             r->off += kn;
-            PyObject *v = k ? dec(r) : NULL;
+            PyObject *v = k ? dec(r, depth + 1) : NULL;
             if (!k || !v || PyDict_SetItem(out, k, v) < 0) {
                 Py_XDECREF(k);
                 Py_XDECREF(v);
@@ -343,7 +380,7 @@ static PyObject *py_decode(PyObject *self, PyObject *arg) {
     Py_buffer view;
     if (PyObject_GetBuffer(arg, &view, PyBUF_CONTIG_RO) < 0) return NULL;
     rd_t r = { (const unsigned char *)view.buf, view.len, 0 };
-    PyObject *out = dec(&r);
+    PyObject *out = dec(&r, 0);
     if (out && r.off != r.len) {
         Py_DECREF(out);
         out = NULL;

@@ -432,8 +432,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     logging.basicConfig(level=logging.INFO)
-    with open(argv[0]) as f:
-        cfg = json.load(f)
+    from fabric_tpu.config.localconfig import load_node_config
+    cfg = load_node_config(argv[0], "orderer")
     node = OrdererNode(cfg, data_dir=cfg["data_dir"]).start()
     threading.Event().wait()   # serve until killed
     return 0

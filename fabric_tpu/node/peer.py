@@ -129,6 +129,63 @@ class RemoteDeliver:
             raise last
 
 
+def _app_org_ids(channel_cfg) -> List[str]:
+    """The channel's APPLICATION org mspids: every config org that is
+    not a consenter org (the reference scopes lifecycle endorsement /
+    approvals to Application orgs, channelconfig/application.go)."""
+    cons = {c.get("mspid")
+            for c in (getattr(channel_cfg, "consenters", ()) or ())
+            if isinstance(c, dict)}     # bare raft-id consenters: no org
+    orgs = sorted(o.mspid for o in channel_cfg.orgs)
+    app = [o for o in orgs if o not in cons]
+    return app or orgs
+
+
+class _LiveHandshakeMsps:
+    """Mapping view of the peer's handshake MSPs, resolved through the
+    live channel bundles on every access (union across joined channels,
+    bootstrap bundle as the floor).  The transport layer authenticates
+    against this instead of a one-time snapshot — see PeerNode wiring.
+    """
+
+    def __init__(self, node: "PeerNode", boot: dict):
+        self._node = node
+        self._boot = dict(boot)
+
+    def _snap(self) -> dict:
+        out = dict(self._boot)
+        for ch in list(getattr(self._node, "channels", {}).values()):
+            try:
+                out.update(ch.bundle_source.current().msps)
+            except Exception:       # a torn channel must not kill auth
+                pass
+        return out
+
+    def get(self, key, default=None):
+        return self._snap().get(key, default)
+
+    def __getitem__(self, key):
+        return self._snap()[key]
+
+    def __contains__(self, key):
+        return key in self._snap()
+
+    def __iter__(self):
+        return iter(self._snap())
+
+    def __len__(self):
+        return len(self._snap())
+
+    def items(self):
+        return self._snap().items()
+
+    def values(self):
+        return self._snap().values()
+
+    def keys(self):
+        return self._snap().keys()
+
+
 class PeerChannel:
     """One channel's kernel inside a peer process: ledger + validator +
     committer + endorser + query/privdata/gossip planes + deliver loop.
@@ -142,6 +199,31 @@ class PeerChannel:
                  ch_dir: str, config_height: int = 0):
         self.node = node
         self.channel_id = channel_cfg.channel_id
+        # Config persistence (core/ledger/confighistory/mgr.go role):
+        # every applied config records (block_num, config) here, so a
+        # restart resumes from the LATEST applied config — not the
+        # join/bootstrap-time one — and config_height survives.  Without
+        # this, runtime config updates were silently lost on restart and
+        # catch-up replay of historical config blocks got flagged
+        # INVALID, diverging from tip peers.
+        from fabric_tpu.ledger.confighistory import ConfigHistory
+        self.confighistory = ConfigHistory(root=ch_dir)
+        entries = self.confighistory.entries()
+        if entries:
+            h, cfg_bytes = entries[-1]
+            try:
+                restored = ChannelConfig.deserialize(cfg_bytes)
+                if restored.sequence > channel_cfg.sequence:
+                    channel_cfg = restored
+                config_height = max(config_height, h)
+            except Exception:
+                logger.exception("[%s] could not restore latest config",
+                                 self.channel_id)
+        elif config_height > 0 or channel_cfg.sequence > 0:
+            # seed the history with the join/bootstrap config so the
+            # committer's replay-covered check works after restart
+            self.confighistory.record(config_height,
+                                      channel_cfg.serialize())
         self.bundle_source = BundleSource(Bundle(channel_cfg),
                                           config_height=config_height)
         self.msps = self.bundle_source.current().msps
@@ -150,6 +232,16 @@ class PeerChannel:
 
         cfg = node.cfg
         self.policies = LifecyclePolicyProvider(self.ledger.statedb)
+        # the `_lifecycle` namespace endorsement policy: majority of the
+        # channel's orgs (the reference's default Application/
+        # LifecycleEndorsement MAJORITY Endorsement rule)
+        from fabric_tpu.chaincode import LIFECYCLE_NS
+        _orgs = _app_org_ids(self.bundle_source.current().config)
+        if _orgs:
+            _maj = len(_orgs) // 2 + 1
+            self.policies.set_policy(LIFECYCLE_NS, parse_policy(
+                "OutOf(%d, %s)" % (_maj, ", ".join(
+                    f"'{o}.member'" for o in _orgs))))
         self._cc_policies: Dict[str, object] = {}
         for cc in cfg.get("chaincodes", []):
             if cc.get("policy"):
@@ -168,7 +260,8 @@ class PeerChannel:
             sbe_lookup=statedb_lookup(self.ledger.statedb))
         self.committer = Committer(self.ledger, self.validator,
                                    bundle_source=self.bundle_source,
-                                   provider=node.provider)
+                                   provider=node.provider,
+                                   confighistory=self.confighistory)
 
         # private data plane
         self.collections = CollectionRegistry()
@@ -357,14 +450,36 @@ class PeerNode:
             self.cc_registry.install(
                 ChaincodeDefinition(cc["name"], cc.get("version", "1.0")),
                 contract)
+        # `_lifecycle` system contract + hash-addressed package store:
+        # the admin CLI's install/approve/commit verbs ride these
+        # (core/chaincode/lifecycle + persistence/chaincode_package.go)
+        from fabric_tpu.chaincode import LIFECYCLE_NS, LifecycleContract
+        from fabric_tpu.chaincode.lifecycle import ChaincodeInstaller
+        self.installer = ChaincodeInstaller(
+            os.path.join(data_dir, "chaincodes"))
+        def _lifecycle_orgs(cid, _boot=channel_cfg):
+            ch = self.channels.get(cid) if hasattr(self, "channels") \
+                else None
+            cfg_now = (ch.bundle_source.current().config
+                       if ch is not None else _boot)
+            return _app_org_ids(cfg_now)
 
-        # RPC + shared gossip transport (ONE bundle: the server and the
-        # transport share the same CachedMSP instances)
+        self.cc_registry.install(
+            ChaincodeDefinition(LIFECYCLE_NS, "1.0"),
+            LifecycleContract(_lifecycle_orgs))
+
+        # RPC + shared gossip transport.  Handshake MSPs resolve through
+        # the LIVE channel bundles (union across joined channels) at
+        # every use, not a construction-time snapshot: orgs present only
+        # on a runtime-joined channel can authenticate at the transport
+        # layer, and MSP rotations committed via config tx reach the
+        # handshake path immediately.
         boot_msps = Bundle(channel_cfg).msps
+        live_msps = _LiveHandshakeMsps(self, boot_msps)
         self.rpc = RpcServer(cfg.get("host", "127.0.0.1"), int(cfg["port"]),
-                             self.signer, boot_msps)
+                             self.signer, live_msps)
         from fabric_tpu.gossip.comm import ChannelMux, SecureGossipTransport
-        transport = SecureGossipTransport(self.rpc, self.signer, boot_msps)
+        transport = SecureGossipTransport(self.rpc, self.signer, live_msps)
         self.gossip_mux = ChannelMux(transport, channel_cfg.channel_id)
 
         self._stop = threading.Event()
@@ -409,6 +524,10 @@ class PeerNode:
                        {"channels": self.cscc.get_channels()})
         self.rpc.serve("cscc.join", self._rpc_cscc_join)
         self.rpc.serve("discovery.endorsers", self._rpc_discovery)
+        self.rpc.serve("discovery.peers", self._rpc_discovery_peers)
+        self.rpc.serve("discovery.config", self._rpc_discovery_config)
+        self.rpc.serve("lifecycle.install", self._rpc_cc_install)
+        self.rpc.serve("lifecycle.installed", self._rpc_cc_installed)
         self.rpc.serve("privdata.fetch", self._rpc_privdata_fetch)
         self.rpc.serve_cast("privdata.push", self._rpc_privdata_push)
 
@@ -456,21 +575,27 @@ class PeerNode:
             ch.start()
         return ch
 
-    def _cscc_create(self, channel_id: str, channel_config):
+    def _cscc_create(self, channel_id: str, channel_config,
+                     config_height: int = 0):
         if isinstance(channel_config, (bytes, bytearray)):
             channel_config = ChannelConfig.deserialize(bytes(channel_config))
         if channel_config.channel_id != channel_id:
             raise ValueError("channel id mismatch")
-        return self._create_channel(channel_config)
+        return self._create_channel(channel_config,
+                                    config_height=config_height)
 
-    def join_channel(self, channel_cfg: ChannelConfig) -> PeerChannel:
+    def join_channel(self, channel_cfg: ChannelConfig,
+                     config_height: int = 0) -> PeerChannel:
         """Runtime channel join (cscc JoinChain,
         core/scc/cscc/configure.go) — a new per-channel kernel in this
-        process."""
+        process.  config_height: the block number the join config was
+        taken at (from a fetched config block), so catch-up replay of
+        older config blocks is recognized as historical."""
         if channel_cfg.channel_id in self.channels:
             raise ValueError(
                 f"already joined {channel_cfg.channel_id!r}")
-        return self.cscc.join_chain(channel_cfg.channel_id, channel_cfg)
+        return self.cscc.join_chain(channel_cfg.channel_id, channel_cfg,
+                                    config_height=config_height)
 
     def _chan(self, body: dict) -> PeerChannel:
         cid = body.get("channel") or self.channel_id
@@ -631,7 +756,9 @@ class PeerNode:
         reference checks JoinChain against the local MSP policy)."""
         self._bootstrap.acl.check("cscc/JoinChain", peer_identity)
         channel_cfg = ChannelConfig.deserialize(body["config"])
-        ch = self.join_channel(channel_cfg)
+        ch = self.join_channel(channel_cfg,
+                               config_height=int(body.get(
+                                   "config_height", 0)))
         return {"channel": ch.channel_id, "status": "joined"}
 
     def _rpc_discovery(self, body: dict, peer_identity) -> dict:
@@ -640,6 +767,57 @@ class PeerNode:
         out = ch.discovery.endorsers(body["namespace"])
         out["layouts"] = [l.as_dict() for l in out["layouts"]]
         return out
+
+    def _rpc_discovery_peers(self, body: dict, peer_identity) -> dict:
+        """Live-membership peer query (the discover CLI's `peers` verb;
+        discovery/client PeersOfChannel)."""
+        ch = self._chan(body)
+        ch.acl.check("discovery/Discover", peer_identity)
+        return {"peers": self._membership()}
+
+    def _rpc_discovery_config(self, body: dict, peer_identity) -> dict:
+        """Channel-config summary (the discover CLI's `config` verb;
+        discovery/client Config: msps + orderer endpoints)."""
+        ch = self._chan(body)
+        ch.acl.check("discovery/Discover", peer_identity)
+        bundle = ch.bundle_source.current()
+        return {"channel": ch.channel_id,
+                "sequence": bundle.sequence,
+                "msps": sorted(bundle.msps),
+                "orderers": [f"{h}:{p}" for h, p in self.orderers]}
+
+    def _check_local_admin(self, resource: str, peer_identity) -> None:
+        """Peer-LOCAL admin gate: peer-scoped operations (chaincode
+        install / query-installed) are authorized by an admin of the
+        peer's OWN org — the reference evaluates these against the
+        local MSP's admin policy, not a channel-wide majority
+        (core/aclmgmt defaults for _lifecycle install)."""
+        from fabric_tpu.msp import Principal, deserialize_from_msps
+        from fabric_tpu.policy import ACLError, PolicyEvaluator, signed_by
+        if peer_identity is None or not hasattr(peer_identity, "serialize"):
+            raise ACLError(f"{resource}: unauthenticated caller")
+        bundle = self._bootstrap.bundle_source.current()
+        ident = deserialize_from_msps(bundle.msps,
+                                      peer_identity.serialize(),
+                                      validate=True)
+        if ident is None or ident.mspid != self.mspid:
+            raise ACLError(f"{resource}: caller is not a local-org "
+                           "identity")
+        evaluator = PolicyEvaluator(bundle.msps, self.provider)
+        if not evaluator.evaluate(signed_by(Principal.admin(self.mspid)),
+                                  [ident]):
+            raise ACLError(f"{resource}: caller is not a local-org admin")
+
+    def _rpc_cc_install(self, body: dict, peer_identity) -> dict:
+        """Hash-addressed chaincode package install (lifecycle.go
+        InstallChaincode), local-org-admin-gated."""
+        self._check_local_admin("lifecycle/Install", peer_identity)
+        pid = self.installer.install(body["package"])
+        return {"package_id": pid}
+
+    def _rpc_cc_installed(self, body: dict, peer_identity) -> dict:
+        self._check_local_admin("lifecycle/QueryInstalled", peer_identity)
+        return {"package_ids": self.installer.installed()}
 
     def _rpc_privdata_fetch(self, body: dict, peer_identity) -> dict:
         """Collection pull: ONLY collection-member orgs may read cleartext
@@ -710,8 +888,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     logging.basicConfig(level=logging.INFO)
-    with open(argv[0]) as f:
-        cfg = json.load(f)
+    from fabric_tpu.config.localconfig import load_node_config
+    cfg = load_node_config(argv[0], "peer")
     PeerNode(cfg, data_dir=cfg["data_dir"]).start()
     threading.Event().wait()   # serve until killed
     return 0

@@ -254,5 +254,19 @@ def provision_network(base_dir: str, n_orderers: int = 3,
                 "peers": [["127.0.0.1", p, o] for (o, k, p) in peer_list],
             }, f)
         clients[org_name] = path
+    # per-org ADMIN identities (channel-config admin certs): the admin
+    # CLI's install/join verbs are Admins-gated
+    admins = {}
+    for org_name, org in p_orgs.items():
+        path = os.path.join(base_dir, f"admin_{org_name}.json")
+        with open(path, "w") as f:
+            json.dump({
+                "mspid": org_name,
+                "cert_pem": _cert_pem(org.admin.cert).decode(),
+                "key_pem": _key_pem(org.admin._key.key).decode(),
+                "channel_config_hex": cfg_hex,
+                "channel_id": channel_id,
+            }, f)
+        admins[org_name] = path
     return {"orderers": orderer_paths, "peers": peer_paths,
-            "clients": clients}
+            "clients": clients, "admins": admins}

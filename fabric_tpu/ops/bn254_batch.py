@@ -170,16 +170,6 @@ _EXP_BITS = np.asarray([int(b) for b in bin(_EXP)[2:]], dtype=np.int32)
 _ABS_U_BITS = np.asarray([int(b) for b in bin(-hb.X_BN)[3:]],
                          dtype=np.int32)
 
-# Frobenius coefficient constants, Montgomery limbs: GAMMA[i][k] as
-# ((L,) c0, (L,) c1) numpy pairs (host oracle: hb.GAMMA)
-_GAMMA_M = {
-    i: tuple((_mont_limbs(g[0]) if True else None,)
-             and (_mont_limbs(g[0]), _mont_limbs(g[1]))
-             for g in hb.GAMMA[i])
-    for i in (1, 2, 3)
-}
-
-
 # ---------------------------------------------------------------------------
 # the batched pairing
 # ---------------------------------------------------------------------------

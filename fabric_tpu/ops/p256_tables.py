@@ -136,11 +136,12 @@ def comb_table_for_point(qx: int, qy: int) -> np.ndarray:
 
 
 class KeyTableCache:
-    """LRU cache of per-key comb tables, keyed by SEC1 pubkey bytes.
+    """LRU cache of HOST-side per-key comb tables, keyed by SEC1 pubkey.
 
-    Thread-safe.  ~2.8 MB per key; the default cap of 64 keys bounds the
-    cache at ~180 MB — far more distinct *hot* keys than any real channel
-    has endorsing orgs.
+    Thread-safe.  A table is (2752, 44) f32 = 484 KB; 64 keys ~ 31 MB.
+    The production provider keeps tables DEVICE-resident instead
+    (ops/device_bank.DeviceBank); this host cache serves tests and
+    host-only tooling.
     """
 
     def __init__(self, max_keys: int = 64):

@@ -37,6 +37,9 @@ DEFAULT_ACLS: Dict[str, str] = {
     "participation/Join": "Admins",
     "participation/Remove": "Admins",
     "participation/List": "Admins",
+    # NOTE: lifecycle/Install and lifecycle/QueryInstalled are PEER-
+    # LOCAL operations gated against the local org's admin principal
+    # (PeerNode._check_local_admin), not channel-config ACL mappings.
 }
 
 

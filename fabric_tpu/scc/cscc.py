@@ -26,12 +26,12 @@ class Cscc:
         self._channels: Dict[str, object] = {}
 
     def join_chain(self, channel_id: str, channel_config,
-                   signed: Optional[SignedData] = None):
+                   signed: Optional[SignedData] = None, **kw):
         if channel_id in self._channels:
             raise CsccError(f"already joined {channel_id!r}")
         if self._create is None:
             raise CsccError("no channel factory wired")
-        ch = self._create(channel_id, channel_config)
+        ch = self._create(channel_id, channel_config, **kw)
         self._channels[channel_id] = ch
         return ch
 

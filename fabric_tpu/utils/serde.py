@@ -16,6 +16,17 @@ Format ("FTLV"): a tiny canonical TLV scheme —
 Deterministic by construction (sorted dict keys, fixed-width lengths), so
 equal values always produce equal bytes — the property Fabric gets from
 deterministic proto marshaling of header bytes.
+
+Decoding is STRICT: exactly the canonical form is accepted — dict keys
+must be strictly increasing (which also rejects duplicates), 'V' ints
+must be minimal and >= 2^63 (below that the encoder emits 'I'), nesting
+is capped at MAX_DEPTH, and trailing bytes are an error.  Strictness
+makes decode/encode a bijection on the wire, which the validator's C
+pass-1 walker (native/fastcollect.c) depends on: it splices signed byte
+spans straight out of the original encoding, and span-splicing equals
+re-encoding ONLY when every accepted encoding is canonical.  A lenient
+decoder here would let an attacker craft envelopes that validate
+differently on C-enabled and pure-Python peers — a state fork.
 """
 
 from __future__ import annotations
@@ -25,6 +36,12 @@ from typing import Any
 
 _U32 = struct.Struct(">I")
 _I64 = struct.Struct(">q")
+
+# Uniform nesting cap across every codec implementation (this module's
+# Python encode/decode, native/ftlv.c, and native/fastcollect.c's
+# canonical walk).  All four MUST agree: a value one implementation
+# accepts and another rejects is a validation fork between peers.
+MAX_DEPTH = 64
 
 
 def encode(v: Any) -> bytes:
@@ -38,7 +55,9 @@ def encode(v: Any) -> bytes:
 encode_py = encode
 
 
-def _enc(v: Any, out: bytearray) -> None:
+def _enc(v: Any, out: bytearray, depth: int = 0) -> None:
+    if depth > MAX_DEPTH:
+        raise ValueError("nesting too deep")
     if v is None:
         out += b"N"
     elif v is True:
@@ -70,7 +89,7 @@ def _enc(v: Any, out: bytearray) -> None:
         out += b"L"
         out += _U32.pack(len(v))
         for item in v:
-            _enc(item, out)
+            _enc(item, out, depth + 1)
     elif isinstance(v, dict):
         out += b"D"
         keys = sorted(v.keys())
@@ -81,7 +100,7 @@ def _enc(v: Any, out: bytearray) -> None:
             kb = k.encode("utf-8")
             out += _U32.pack(len(kb))
             out += kb
-            _enc(v[k], out)
+            _enc(v[k], out, depth + 1)
     else:
         raise TypeError(f"unsupported type {type(v)!r}")
 
@@ -102,7 +121,9 @@ def _take(mv: memoryview, off: int, n: int) -> bytes:
     return mv[off:off + n].tobytes()
 
 
-def _dec(mv: memoryview, off: int):
+def _dec(mv: memoryview, off: int, depth: int = 0):
+    if depth > MAX_DEPTH:
+        raise ValueError("nesting too deep")
     tag = _take(mv, off, 1)
     off += 1
     if tag == b"N":
@@ -116,7 +137,13 @@ def _dec(mv: memoryview, off: int):
     if tag == b"V":
         n = _U32.unpack_from(mv, off)[0]
         off += 4
-        return int.from_bytes(_take(mv, off, n), "big"), off + n
+        mag = _take(mv, off, n)
+        # canonical: minimal magnitude, and >= 2^63 (the encoder emits
+        # 'I' below that) — a lenient 'V' would give one value two
+        # encodings and break splice-equals-reencode (module docstring)
+        if n < 8 or mag[0] == 0 or (n == 8 and mag[0] < 0x80):
+            raise ValueError("non-canonical V int")
+        return int.from_bytes(mag, "big"), off + n
     if tag == b"B":
         n = _U32.unpack_from(mv, off)[0]
         off += 4
@@ -130,19 +157,25 @@ def _dec(mv: memoryview, off: int):
         off += 4
         items = []
         for _ in range(n):
-            v, off = _dec(mv, off)
+            v, off = _dec(mv, off, depth + 1)
             items.append(v)
         return items, off
     if tag == b"D":
         n = _U32.unpack_from(mv, off)[0]
         off += 4
         d = {}
+        prev = None
         for _ in range(n):
             kn = _U32.unpack_from(mv, off)[0]
             off += 4
             k = _take(mv, off, kn).decode("utf-8")
             off += kn
-            v, off = _dec(mv, off)
+            # canonical: strictly increasing keys (also bans duplicates,
+            # whose last-wins decode would diverge from span splicing)
+            if prev is not None and not (k > prev):
+                raise ValueError("non-canonical dict key order")
+            prev = k
+            v, off = _dec(mv, off, depth + 1)
             d[k] = v
         return d, off
     raise ValueError(f"bad tag {tag!r} at {off - 1}")

@@ -1,0 +1,121 @@
+"""End-to-end admin + discover CLI tests: a chaincode driven to
+COMMITTED via CLI verbs only (package -> install -> approve -> commit ->
+querycommitted), plus the discover client's three queries.
+
+Reference parity: internal/peer/lifecycle + cmd/discover/main.go.
+"""
+
+import json
+import time
+
+import pytest
+
+from fabric_tpu.node import admin as admin_cli
+from fabric_tpu.node.orderer import OrdererNode
+from fabric_tpu.node.peer import PeerNode
+from fabric_tpu.node.provision import provision_network
+from fabric_tpu.scc import discover as discover_cli
+
+
+@pytest.fixture()
+def net(tmp_path):
+    net = provision_network(str(tmp_path), n_orderers=1,
+                            peer_orgs=["Org1"], peers_per_org=1,
+                            channel_id="chL")
+    with open(net["orderers"][0]) as f:
+        ocfg = json.load(f)
+    with open(net["peers"][0]) as f:
+        pcfg = json.load(f)
+    orderer = OrdererNode(ocfg, data_dir=ocfg["data_dir"]).start()
+    peer = PeerNode(pcfg, data_dir=pcfg["data_dir"]).start()
+    # wait for the single-node raft to elect itself
+    deadline = time.time() + 20
+    while time.time() < deadline:
+        if orderer.support.chain.node.role == "leader":
+            break
+        time.sleep(0.1)
+    try:
+        yield net, ocfg, pcfg
+    finally:
+        peer.stop()
+        orderer.stop()
+
+
+def _run(capsys, argv):
+    rc = admin_cli.main(argv)
+    assert rc == 0
+    return json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+
+
+def test_chaincode_to_committed_via_cli_only(net, tmp_path, capsys):
+    net, ocfg, pcfg = net
+    peer_addr = f"127.0.0.1:{pcfg['port']}"
+    ord_addr = f"127.0.0.1:{ocfg['port']}"
+    common = ["--client", net["admins"]["Org1"],
+              "--msp-config", net["peers"][0]]
+
+    code = tmp_path / "asset_cc.py"
+    code.write_text("# demo contract source\n")
+    pkg = tmp_path / "asset.pkg"
+    out = _run(capsys, common + ["chaincode", "package",
+                                 "--label", "asset",
+                                 "--code-file", str(code),
+                                 "--out", str(pkg)])
+    pid = out["package_id"]
+    assert pid.startswith("asset:")
+
+    out = _run(capsys, common + ["chaincode", "install",
+                                 "--peer", peer_addr,
+                                 "--package", str(pkg)])
+    assert out["package_id"] == pid
+    out = _run(capsys, common + ["chaincode", "installed",
+                                 "--peer", peer_addr])
+    assert pid in out["package_ids"]
+
+    # a NON-admin client must be denied install (Admins ACL)
+    from fabric_tpu.comm import RpcError
+    with pytest.raises((SystemExit, RpcError)):
+        admin_cli.main(["--client", net["clients"]["Org1"],
+                        "--msp-config", net["peers"][0],
+                        "chaincode", "install", "--peer", peer_addr,
+                        "--package", str(pkg)])
+    capsys.readouterr()
+
+    tx_flags = ["--peer", peer_addr, "--orderer", ord_addr,
+                "--channel", "chL", "--name", "asset",
+                "--version", "1.0", "--sequence", "1"]
+    out = _run(capsys, common + ["chaincode", "approve"] + tx_flags)
+    assert out["status"] == "approved"
+    out = _run(capsys, common + ["chaincode", "commit"] + tx_flags)
+    assert out["status"] == "committed"
+
+    out = _run(capsys, common + ["chaincode", "querycommitted",
+                                 "--peer", peer_addr,
+                                 "--channel", "chL",
+                                 "--name", "asset"])
+    assert out["definition"]["sequence"] == 1
+    assert out["definition"]["version"] == "1.0"
+
+
+def test_discover_cli_queries(net, capsys):
+    net, ocfg, pcfg = net
+    peer_addr = f"127.0.0.1:{pcfg['port']}"
+    common = ["--client", net["clients"]["Org1"],
+              "--msp-config", net["peers"][0],
+              "--peer", peer_addr, "--channel", "chL"]
+
+    assert discover_cli.main(common + ["peers"]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert any(p["mspid"] == "Org1" for p in out["peers"])
+
+    assert discover_cli.main(common + ["config"]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["channel"] == "chL"
+    assert "Org1" in out["msps"]
+    assert out["orderers"] == [f"127.0.0.1:{ocfg['port']}"]
+
+    assert discover_cli.main(common + ["endorsers",
+                                       "--chaincode", "assets"]) == 0
+    out = json.loads(capsys.readouterr().out.strip())
+    assert out["chaincode"] == "assets"
+    assert out["layouts"]

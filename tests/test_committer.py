@@ -349,3 +349,38 @@ def test_fast_collect_late_error_parity_and_deep_nesting(world):
     assert fast[0] == int(ValidationCode.UNKNOWN_TX_TYPE)
     assert fast[1] == int(ValidationCode.DUPLICATE_TXID)
     assert fast[2] == int(ValidationCode.BAD_PAYLOAD)
+
+
+def test_pipelined_inflight_duplicate_txid(world):
+    """A txid duplicated across two PIPELINED blocks (begin N+1 before
+    block N commits) is flagged in the later block: the in-flight carry
+    covers the window the ledger oracle cannot see yet."""
+    org1, org2, committer = world
+    validator = committer.validator
+    env = make_tx(org1, org2, rw(writes=[KVWrite("p", b"1")]))
+    other = make_tx(org1, org2, rw(writes=[KVWrite("q", b"2")]))
+
+    h = committer.ledger.height
+    prev = (committer.ledger.blockstore.chain_info().current_hash
+            if h else b"\x00" * 32)
+    b1 = build.new_block(h, prev, [env])
+    b2 = build.new_block(h + 1, b"\x00" * 32, [env, other])
+
+    s1 = validator.validate_begin(b1)
+    s2 = validator.validate_begin(b2)          # b1 not yet finished
+    r1 = validator.validate_finish(s1)
+    r2 = validator.validate_finish(s2)
+    assert r1.flags.codes() == [int(ValidationCode.VALID)]
+    assert r2.flags.codes() == [int(ValidationCode.DUPLICATE_TXID),
+                                int(ValidationCode.VALID)]
+
+    # the carry survives validate_finish (commit hasn't happened): a
+    # third begin still sees b1's txid...
+    b3 = build.new_block(h + 2, b"\x00" * 32, [env])
+    r3 = validator.validate(b3)
+    assert r3.flags.codes() == [int(ValidationCode.DUPLICATE_TXID)]
+
+    # ...but a REPLAY of the same block number is not its own duplicate
+    # (catch-up/crash-recovery semantics prune entries >= the number)
+    r1b = validator.validate(build.new_block(h, prev, [env]))
+    assert r1b.flags.codes() == [int(ValidationCode.VALID)]

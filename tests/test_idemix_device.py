@@ -1,0 +1,110 @@
+"""End-to-end red/green tests of the idemix DEVICE pairing lane.
+
+The production TPU path (bccsp/jaxtpu._verify_idemix) batches the BBS+
+presentation pairing equation e(A', w) * e(-Abar, g2) == 1 through
+ops/bn254_batch.pairing_check_batch — the full dual Miller loop plus
+final exponentiation.  On the CPU test backend the provider normally
+routes idemix to the host oracle; FABRIC_TPU_IDEMIX_DEVICE=1 forces the
+device lane so the suite exercises the exact kernel production TPUs run
+(round-4 verdict weak #5: a broken final exp would otherwise ship
+green).  Reference being replaced: /root/reference/idemix/signature.go:230
+Ver's pairing check in amcl host loops.
+"""
+
+import numpy as np
+import pytest
+
+from fabric_tpu.bccsp import VerifyItem
+
+
+@pytest.fixture(scope="module")
+def world(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("idemix_device")
+    from fabric_tpu.idemix import gen as idemixgen
+    idemixgen.generate(str(tmp), "IdemixOrg",
+                       ["alice:engineering:member", "bob:ops:member"])
+    alice = idemixgen.load_signer(str(tmp / "alice.signer"),
+                                  str(tmp / "msp_config.bin"))
+    bob = idemixgen.load_signer(str(tmp / "bob.signer"),
+                                str(tmp / "msp_config.bin"))
+    return alice, bob
+
+
+def test_idemix_device_path_red_green(world, monkeypatch):
+    monkeypatch.setenv("FABRIC_TPU_IDEMIX_DEVICE", "1")
+    from fabric_tpu.bccsp.jaxtpu import JaxTpuProvider
+    from fabric_tpu.idemix import bn254 as bn
+    from fabric_tpu.idemix.msp import (IdemixSigningIdentity,
+                                       collect_item_parts,
+                                       verify_item_host)
+    alice, bob = world
+
+    items, expect = [], []
+    for i in range(4):
+        p = b"payload-%d" % i
+        signer = alice if i % 2 else bob
+        items.append(signer.verify_item(p, signer.sign(p)))
+        expect.append(True)
+
+    # corrupted PAIR: a forged credential (random A) produces a
+    # presentation whose host-side ZK checks all pass — the pairing
+    # equation on the DEVICE is the only thing that can catch it
+    forged_cred = type(alice._cred)(
+        bn.g1_mul(12345, bn.G1_GEN), alice._cred.e, alice._cred.s,
+        list(alice._cred.attrs))
+    forger = IdemixSigningIdentity(
+        "IdemixOrg", alice._config, forged_cred, alice.ou, alice.role,
+        handle_sig=alice._handle_sig)
+    forged_item = forger.verify_item(b"forged", forger.sign(b"forged"))
+    ok, _, _pair = collect_item_parts(forged_item)
+    assert ok, "forged pair must REACH the device (host checks pass)"
+    items.append(forged_item)
+    expect.append(False)
+
+    # nonce-binding corruption: signature over a different payload
+    items.append(alice.verify_item(b"other", alice.sign(b"x")))
+    expect.append(False)
+
+    # structural garbage must short-circuit False, never crash the batch
+    it0 = items[0]
+    items.append(VerifyItem(it0.scheme, it0.pubkey, b"\x01\x02",
+                            it0.payload))
+    expect.append(False)
+
+    prov = JaxTpuProvider()
+    out = np.asarray(prov.batch_verify(items))
+    assert out.tolist() == expect
+    # the pairing verdicts really came from the device lane
+    assert prov.stats["device_sigs"] >= 5
+    assert prov.stats["fallbacks"] == 0
+
+    # differential: host oracle agrees item-for-item
+    assert [verify_item_host(it) for it in items] == expect
+
+
+def test_idemix_device_matches_host_on_mixed_issuers(world, monkeypatch):
+    """Items group per issuer key for dispatch; a second issuer's items
+    must not leak into the first's precomputed w-lines."""
+    monkeypatch.setenv("FABRIC_TPU_IDEMIX_DEVICE", "1")
+    from fabric_tpu.bccsp.jaxtpu import JaxTpuProvider
+    from fabric_tpu.idemix import gen as idemixgen
+    from fabric_tpu.idemix.msp import verify_item_host
+    import tempfile
+    alice, bob = world
+    with tempfile.TemporaryDirectory() as tmp2:
+        idemixgen.generate(tmp2, "OtherOrg", ["carol:eng:member"])
+        carol = idemixgen.load_signer(tmp2 + "/carol.signer",
+                                      tmp2 + "/msp_config.bin")
+        items = []
+        for i in range(3):
+            p = b"m%d" % i
+            items.append(alice.verify_item(p, alice.sign(p)))
+            items.append(carol.verify_item(p, carol.sign(p)))
+        # cross-issuer swap: alice's presentation under carol's config
+        swapped = VerifyItem(items[1].scheme, items[1].pubkey,
+                             items[0].signature, items[1].payload)
+        items.append(swapped)
+        prov = JaxTpuProvider()
+        out = np.asarray(prov.batch_verify(items))
+        host = [verify_item_host(it) for it in items]
+        assert out.tolist() == host == [True] * 6 + [False]

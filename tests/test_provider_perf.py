@@ -1,0 +1,196 @@
+"""Provider dispatch-economics regression tests.
+
+Round 4 shipped a fast lane that re-uploaded ~124 MB of key tables per
+dispatch; the driver bench caught it, CI did not.  These tests pin the
+economics the bank redesign (ops/device_bank.py) guarantees:
+
+  * tables cross host->device ONCE per key (h2d_bytes accounting);
+  * steady-state dispatches ship only signature words + slot indices;
+  * lane choice at 3 / 8 / 64 / 100 distinct keys;
+  * the key-cache capacity cliff (eviction) stays correct and bounded.
+
+All on the CPU backend (conftest), same code paths as TPU minus jit.
+"""
+
+import hashlib
+import random
+
+import numpy as np
+import pytest
+
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec as cec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    decode_dss_signature, encode_dss_signature)
+from cryptography.hazmat.primitives.serialization import (
+    Encoding, PublicFormat)
+
+from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
+from fabric_tpu.bccsp.jaxtpu import JaxTpuProvider
+from fabric_tpu.ops import p256
+
+# one P-256 comb table in bytes (f32 (2752, 44))
+TABLE_BYTES = 2752 * 44 * 4
+
+
+def _sigs(keys, per_key, seed=7):
+    rng = random.Random(seed)
+    pubs = [k.public_key().public_bytes(
+        Encoding.X962, PublicFormat.UncompressedPoint) for k in keys]
+    items = []
+    for ki, k in enumerate(keys):
+        for _ in range(per_key):
+            msg = rng.randbytes(24)
+            d = hashlib.sha256(msg).digest()
+            r, s = decode_dss_signature(k.sign(msg, cec.ECDSA(hashes.SHA256())))
+            if s > p256.HALF_N:
+                s = p256.N - s
+            items.append(VerifyItem(SCHEME_P256, pubs[ki],
+                                    encode_dss_signature(r, s), d))
+    rng.shuffle(items)
+    return items
+
+
+@pytest.fixture(scope="module")
+def keypool():
+    return [cec.generate_private_key(cec.SECP256R1()) for _ in range(100)]
+
+
+def _fresh(monkeypatch, **env):
+    for k, v in env.items():
+        monkeypatch.setenv(k, str(v))
+    prov = JaxTpuProvider()
+    prov.fast_key_threshold = 4
+    return prov
+
+
+def test_steady_state_ships_no_tables(monkeypatch, keypool):
+    """After the first batch builds tables, later batches must ship only
+    signature words: h2d per call stays ~100 B/sig, nowhere near the
+    ~0.5 MB/key a table re-upload would cost (the round-4 regression)."""
+    prov = _fresh(monkeypatch)
+    items = _sigs(keypool[:3], 40)
+    prov.batch_verify(items)
+    assert prov.key_tables.stats["builds"] == 3
+    base = prov.stats["h2d_bytes"]
+    for _ in range(3):
+        out = prov.batch_verify(items)
+    per_call = (prov.stats["h2d_bytes"] - base) / 3
+    # 120 sigs pad to 1 row-bucket of work: words are 8*4*3 B/sig + pad;
+    # one table re-upload alone would be > TABLE_BYTES
+    assert per_call < TABLE_BYTES / 4, per_call
+    assert prov.key_tables.stats["builds"] == 3          # no rebuilds
+    assert bool(np.asarray(out).all())
+
+
+def test_table_upload_once_per_key(monkeypatch, keypool):
+    prov = _fresh(monkeypatch)
+    items = _sigs(keypool[:8], 10)
+    prov.batch_verify(items)
+    b0 = prov.key_tables.stats["h2d_bytes"]
+    assert b0 == 8 * TABLE_BYTES
+    prov.batch_verify(items)
+    assert prov.key_tables.stats["h2d_bytes"] == b0      # resident
+
+
+@pytest.mark.parametrize("n_keys", [3, 8, 64])
+def test_lane_choice_hot_keys_ride_rows(monkeypatch, keypool, n_keys):
+    """>= threshold sigs per key in one batch -> every sig on the comb
+    lane regardless of how many distinct keys there are (the round-3
+    NK<=4 cap must never come back)."""
+    prov = _fresh(monkeypatch)
+    items = _sigs(keypool[:n_keys], 5)
+    out = prov.batch_verify(items)
+    assert bool(np.asarray(out).all())
+    assert prov.stats["fast_key_sigs"] == len(items)
+    assert prov.key_tables.stats["builds"] == n_keys
+
+
+def test_lane_choice_cold_keys_ride_generic(monkeypatch, keypool):
+    """Below-threshold groups must NOT earn a table build (one-off
+    creators ride the generic ladder)."""
+    prov = _fresh(monkeypatch)
+    items = _sigs(keypool[:100], 2)          # 2 < threshold 4
+    out = prov.batch_verify(items)
+    assert bool(np.asarray(out).all())
+    assert prov.stats["fast_key_sigs"] == 0
+    assert prov.key_tables.stats["builds"] == 0
+    # a resident key rides the fast lane even for a single signature
+    warm = _sigs(keypool[:1], 4, seed=9)
+    prov.batch_verify(warm)
+    one = _sigs(keypool[:1], 1, seed=11)
+    prov.batch_verify(one)
+    assert prov.stats["fast_key_sigs"] == len(warm) + len(one)
+
+
+def test_capacity_cliff_overflow_spills_to_generic(monkeypatch, keypool):
+    """More hot keys than slots in ONE batch: the first max_keys groups
+    win slots (pinned for the batch), the overflow rides the generic
+    ladder, and verdicts stay correct — a mid-batch eviction of a
+    claimed slot would verify rows against the WRONG table."""
+    monkeypatch.setenv("FABRIC_TPU_KEY_CACHE", "4")
+    prov = JaxTpuProvider()
+    prov.fast_key_threshold = 4
+    assert prov.key_tables.max_keys == 4
+    for rep in range(2):
+        items = _sigs(keypool[:6], 5, seed=20 + rep)     # 6 keys, 4 slots
+        out = prov.batch_verify(items)
+        assert bool(np.asarray(out).all())
+    st = prov.key_tables.stats
+    # exactly 4 winners per batch (one per slot); the 2 losers spill to
+    # the generic lane or evict an unclaimed slot — churn stays bounded
+    # by capacity per batch
+    assert st["builds"] <= 2 * 4
+    assert st["pinned_spills"] + st["evictions"] >= 2
+    assert prov.stats["fast_key_sigs"] == 2 * 4 * 5
+
+
+def test_capacity_cliff_rotation_evicts_correctly(monkeypatch, keypool):
+    """Alternating hot-key populations churn the LRU across batches;
+    verdicts stay correct and rebuild cost is bounded by the rotation."""
+    monkeypatch.setenv("FABRIC_TPU_KEY_CACHE", "4")
+    prov = JaxTpuProvider()
+    prov.fast_key_threshold = 4
+    for rep in range(3):
+        a = _sigs(keypool[:4], 5, seed=50 + rep)
+        b = _sigs(keypool[4:8], 5, seed=60 + rep)
+        assert bool(np.asarray(prov.batch_verify(a)).all())
+        assert bool(np.asarray(prov.batch_verify(b)).all())
+    st = prov.key_tables.stats
+    assert st["evictions"] > 0
+    assert st["builds"] <= 4 * 6              # bounded by full rotation
+    # capacity >= population -> warm after one pass, zero further builds
+    monkeypatch.setenv("FABRIC_TPU_KEY_CACHE", "8")
+    prov2 = JaxTpuProvider()
+    prov2.fast_key_threshold = 4
+    prov2.batch_verify(_sigs(keypool[:6], 5, seed=33))
+    builds = prov2.key_tables.stats["builds"]
+    for rep in range(2):
+        prov2.batch_verify(_sigs(keypool[:6], 5, seed=40 + rep))
+    assert prov2.key_tables.stats["builds"] == builds == 6
+
+
+def test_dispatch_count_single_rows_dispatch(monkeypatch, keypool):
+    """A mixed hot-key batch that fits one row chunk = exactly one
+    device dispatch (merged rows lane), no generic-lane dispatch."""
+    prov = _fresh(monkeypatch)
+    items = _sigs(keypool[:4], 8)
+    prov.batch_verify(items)
+    d0 = prov.stats["dispatches"]
+    prov.batch_verify(items)
+    assert prov.stats["dispatches"] - d0 == 1
+
+
+def test_rows_chunk_splits_large_grids(monkeypatch, keypool):
+    """Grids beyond ROWS_CHUNK rows split into several dispatches (the
+    pack/compute overlap), with verdicts identical."""
+    prov = _fresh(monkeypatch)
+    monkeypatch.setattr(JaxTpuProvider, "FAST_ROW_C", 4)
+    prov.ROWS_CHUNK = 2
+    items = _sigs(keypool[:3], 9)            # 3 rows/key of C=4
+    d0 = prov.stats["dispatches"]
+    out = prov.batch_verify(items)
+    assert bool(np.asarray(out).all())
+    assert prov.stats["dispatches"] - d0 >= 3
+    sw = prov.fallback.batch_verify(items)
+    assert (np.asarray(out) == np.asarray(sw)).all()
