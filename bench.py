@@ -6,16 +6,17 @@ reference's bccsp/sw path, /root/reference/bccsp/sw/ecdsa.go:41 —
 approximated by OpenSSL via `cryptography`, which is faster than Go's
 crypto/ecdsa, making the comparison conservative).
 
-Round-4 methodology:
+Round-5 methodology:
   - The HEADLINE number is the end-to-end PROVIDER rate (DER parsing,
     packing, dispatch, verdicts — the bccsp boundary of
     /root/reference/bccsp/sw/impl.go:247) on the reference workload: a
     10k-tx block's 40k signatures = 3 endorsements/tx from 3 org keys +
     1 creator sig/tx from a 64-client population, measured steady-state
-    as the MEDIAN OF 5 timed trials after warmup (key comb tables
-    cached — the row-grouped fast lane of ops/p256_fixed.py; repeat
-    identities are the same assumption behind the reference's
-    msp/cache, msp/cache/cache.go).
+    as the MEDIAN OF 7 timed trials after warmup (key comb tables
+    DEVICE-RESIDENT — ops/device_bank.py; repeat identities are the
+    same assumption behind the reference's msp/cache,
+    msp/cache/cache.go).  The shared axon tunnel swings per-call times
+    ~±40%; the median over 7 is the honest middle of that.
   - detail reports the conservative variant (every creator key distinct
     — generic-ladder path for 25% of sigs), raw per-lane rates, ed25519
     + mixed-curve rates (BASELINE configs 2-3), Idemix (config 4), the
@@ -253,8 +254,6 @@ def _kernel_name() -> str:
     import jax
     if jax.default_backend() == "cpu":
         return "xla-cpu-eager"
-    if os.environ.get("FABRIC_TPU_PALLAS") == "1":
-        return "pallas+fixedcomb-rows"
     return "xla-fixedcomb-rows+ladder"
 
 
@@ -287,7 +286,7 @@ def main():
         "device": str(__import__("jax").devices()[0]),
         "kernel": _kernel_name(),
         "block_txs": n_tx,
-        "trials": 5,
+        "trials": 7,
     }
 
     # -- headline: the reference block workload, end-to-end provider rate --
@@ -296,8 +295,8 @@ def main():
     mixed = endorse_items + client_creators
     fast_before = provider.stats["fast_key_sigs"]
     calls_before = provider.stats["dispatches"]
-    rate, step_s, first_s = time_batches(provider, mixed)
-    calls = 7                               # 2 warmup + 5 timed
+    rate, step_s, first_s = time_batches(provider, mixed, trials=7)
+    calls = 9                               # 2 warmup + 7 timed
     detail["mixed_steady_ms"] = round(step_s * 1e3, 2)
     detail["compile_plus_first_s"] = round(first_s, 2)
     detail["fast_key_sigs_per_block"] = (

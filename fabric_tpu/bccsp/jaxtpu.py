@@ -76,7 +76,7 @@ class JaxTpuProvider(prov.Provider):
         # per-key fixed-base fast path (ops/p256_fixed.py): keys whose comb
         # table is DEVICE-RESIDENT (ops/device_bank.py) skip the variable-
         # point ladder entirely; dispatches carry only slot indices, never
-        # tables.  A table build costs ~50 ms host + one 0.5 MB upload, so
+        # tables.  A table build costs ~150 ms host + one 1.4 MB upload, so
         # uncached keys only earn a slot when a single batch brings at
         # least `fast_key_threshold` signatures — repeat identities (org
         # endorsers, enrolled clients: the same assumption behind the
@@ -85,7 +85,14 @@ class JaxTpuProvider(prov.Provider):
         from fabric_tpu.ops.device_bank import DeviceBank
         from fabric_tpu.ops import p256_tables as _pt
         from fabric_tpu.ops import ed25519_tables as _et
-        max_keys = int(os.environ.get("FABRIC_TPU_KEY_CACHE", "256"))
+        import jax as _jax
+        # 256 slots ~ 370 MB HBM on TPU; the CPU test backend holds the
+        # bank in host RAM, so default smaller there (still above the
+        # realistic ~67-hot-key block workload: pinning makes the slot
+        # count a PER-BATCH fast-lane cap)
+        _default_keys = "256" if _jax.default_backend() != "cpu" else "96"
+        max_keys = int(os.environ.get("FABRIC_TPU_KEY_CACHE",
+                                      _default_keys))
 
         def _build_p256(pk: bytes):
             if len(pk) != 65 or pk[0] != 0x04:
@@ -133,11 +140,6 @@ class JaxTpuProvider(prov.Provider):
                     from fabric_tpu.parallel import mesh as meshmod
                     f = meshmod.sharded_p256_verify(self.mesh, self.require_low_s)
                     self._fns[key] = lambda *a: f(*a)[0]
-                elif os.environ.get("FABRIC_TPU_PALLAS") == "1":
-                    # experimental fused kernel (see ops/p256_pallas.py)
-                    from fabric_tpu.ops import p256_pallas
-                    self._fns[key] = lambda *a: p256_pallas.verify_words(
-                        *a, require_low_s=low_s)
                 else:
                     # round-2 windowed flat path (ops/ecp256).  On CPU the
                     # big scan bodies hit an XLA:CPU compile pathology, so
@@ -311,8 +313,10 @@ class JaxTpuProvider(prov.Provider):
     # dropped at resolve time.
     FAST_ROW_C = int(__import__("os").environ.get(
         "FABRIC_TPU_FAST_ROW_C", "128"))
-    ROW_BUCKETS = (4, 8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256,
-                   384, 512, 768, 1024)
+    # deliberately coarse (~8 programs): every bucket is a multi-minute
+    # cold XLA compile; padding waste at most ~2x on small dispatches
+    # where the device is idle anyway
+    ROW_BUCKETS = (4, 16, 64, 128, 256, 384, 512, 1024)
     # Soft per-dispatch row cap.  Default = the top bucket (one merged
     # dispatch): on relayed/tunneled transports each dispatch costs a
     # round trip, and A/B on the axon tunnel measured splitting at
@@ -334,7 +338,7 @@ class JaxTpuProvider(prov.Provider):
         comb lane (zero marginal transfer — the bank lives in HBM and
         dispatches carry slot indices only); a non-resident key earns a
         slot only when this batch brings >= fast_key_threshold
-        signatures, amortizing the ~50 ms host table build + 0.5 MB
+        signatures, amortizing the ~150 ms host table build + 1.4 MB
         one-time upload.
 
         Packing is numpy-vectorized end to end (the C DER batch parse +

@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import hashlib
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import NamedTuple, Optional, Sequence
 
 import numpy as np
 
@@ -24,8 +24,7 @@ HASH_SHA3_256 = "sha3_256"
 HASH_SHA3_384 = "sha3_384"
 
 
-@dataclass(frozen=True, slots=True)
-class VerifyItem:
+class VerifyItem(NamedTuple):
     """One signature-verification work item.
 
     scheme  : SCHEME_P256 | SCHEME_ED25519
@@ -35,6 +34,11 @@ class VerifyItem:
     payload : the 32-byte *digest* for p256 (hashing happened upstream,
               mirroring msp/identities.go:178); the full *message* for
               ed25519 (RFC 8032 signs the message itself)
+
+    A NamedTuple on purpose: items are created and hashed 4x per tx on
+    the validator's pass-1 hot loop (they ARE their own dedup keys —
+    Verify is a pure function of these four fields), and C-level tuple
+    construction/hash measurably beats the frozen-dataclass forms.
     """
     scheme: str
     pubkey: bytes

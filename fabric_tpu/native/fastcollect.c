@@ -1018,6 +1018,15 @@ static PyObject *py_collect(PyObject *self, PyObject *args)
         return NULL;
     }
     for (Py_ssize_t i = 0; i < n; i++) {
+        /* Yield the GIL periodically: this walk runs for hundreds of
+         * ms on a 10k-tx block, and device transports serviced by a
+         * Python-side pump thread (the axon relay) would otherwise
+         * starve — measured as the TPU sitting idle through pass-1
+         * instead of overlapping it. */
+        if ((i & 63) == 63) {
+            Py_BEGIN_ALLOW_THREADS
+            Py_END_ALLOW_THREADS
+        }
         PyObject *env = PySequence_Fast_GET_ITEM(seq, i);
         const uint8_t *p;
         Py_ssize_t en;

@@ -1,7 +1,7 @@
 """Device-resident per-key comb-table banks (HBM slot allocator).
 
 The round-4 fast lane rebuilt and re-shipped its key-table bank from
-host to device on EVERY dispatch — ~0.48 MB per P-256 key, padded to a
+host to device on EVERY dispatch — per-key tables padded to a
 power-of-two bucket, ~124 MB per dispatch on the realistic 67-key block
 workload — which made the lane slower than the generic ladder it was
 built to beat.  This module is the fix: each key's comb table is
@@ -15,12 +15,13 @@ The reference analogue is msp/cache (msp/cache/cache.go) — identities
 repeat, so per-identity work is cached; here the cached artifact lives
 in device memory because that is where it is consumed.
 
-Capacity economics: a P-256 comb table is (2752, 44) f32 = 484 KB; the
-default 256 slots hold ~124 MB of HBM — far more distinct *hot* keys
-than any real channel has endorsing orgs or enrolled clients, and ~0.8%
-of a v5e chip's 16 GB.  Eviction is LRU over whole slots; an evicted
-key's next qualifying batch simply rebuilds (host, ~50 ms) and
-re-uploads (0.5 MB) its table.
+Capacity economics: a P-256 comb table is (8192, 44) f32 = 1.44 MB;
+the default 256 slots hold ~370 MB of HBM — far more distinct *hot*
+keys than any real channel has endorsing orgs or enrolled clients, and
+~2% of a v5e chip's 16 GB.  (CPU test backends default to far fewer
+slots — the zeros bank is host RAM there.)  Eviction is LRU over whole
+slots; an evicted key's next qualifying batch simply rebuilds (host,
+~150 ms) and re-uploads (1.4 MB) its table.
 """
 
 from __future__ import annotations

@@ -6,7 +6,7 @@ carry scans inside the 256-iteration ladder scan).  Every op here is a flat
 composition of elementwise/broadcast int32 ops on (L, ...) limb arrays —
 no lax.scan, no while_loop, no gather/scatter — so the same code lowers
 both through XLA (CPU tests, fallback) and through Mosaic inside a Pallas
-kernel (fabric_tpu/ops/p256_pallas.py).
+kernel (historically a fused Pallas kernel; the XLA lane is production).
 
 Layout: limbs-first int32 arrays (L, B), 12-bit limbs, L=22 (264 bits),
 identical to bignum (results interchangeable; same R = 2^264, same n0inv).

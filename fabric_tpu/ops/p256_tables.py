@@ -5,13 +5,13 @@ key-repetitive: a 10k-tx block carries ~3 endorsement signatures per tx
 from a handful of stable org endorser certificates (the reference's own
 msp/cache exists because identities repeat, msp/cache/cache.go).  For a
 repeated public key Q the u2*Q half of the verification can use the same
-fixed-base comb the generator G already enjoys (ops/ecp256.py): 43
-windows of 6 bits over a precomputed table of k * 2^(6j) * Q — replacing
-the 256-doubling windowed ladder entirely and roughly tripling per-sig
-throughput (ops/p256_fixed.py).
+fixed-base comb the generator G already enjoys (ops/ecp256.py):
+COMB_WINDOWS windows of COMB_W bits over a precomputed table of
+k * 2^(COMB_W*j) * Q — replacing the 256-doubling windowed ladder
+entirely and roughly tripling per-sig throughput (ops/p256_fixed.py).
 
 This module builds those tables on the host with python-int Jacobian
-arithmetic + one Montgomery-trick batched inversion (~15 ms per key) and
+arithmetic + one Montgomery-trick batched inversion (~150 ms per key) and
 caches them by SEC1 pubkey, so the cost amortizes across blocks.  The
 on-curve check happens ONCE here at build time; the device kernel for
 cached keys never sees Q at all.
@@ -138,7 +138,7 @@ def comb_table_for_point(qx: int, qy: int) -> np.ndarray:
 class KeyTableCache:
     """LRU cache of HOST-side per-key comb tables, keyed by SEC1 pubkey.
 
-    Thread-safe.  A table is (2752, 44) f32 = 484 KB; 64 keys ~ 31 MB.
+    Thread-safe.  A table is (8192, 44) f32 = 1.44 MB; 64 keys ~ 92 MB.
     The production provider keeps tables DEVICE-resident instead
     (ops/device_bank.DeviceBank); this host cache serves tests and
     host-only tooling.

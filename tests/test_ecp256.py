@@ -161,21 +161,6 @@ def test_low_s_flag_parity(cases):
     assert bool(relaxed[0])
 
 
-@pytest.mark.skipif(
-    jax.default_backend() == "cpu"
-    or __import__("os").environ.get("FABRIC_TPU_PALLAS") != "1",
-    reason="Pallas kernel requires TPU and FABRIC_TPU_PALLAS=1 "
-           "(experimental: axon libtpu AOT crash)")
-def test_pallas_matches_xla(cases):
-    from fabric_tpu.ops import p256_pallas
-    args = _args(cases)
-    xla = list(np.asarray(ec.verify_words_xla(*args)))
-    pl_out = list(np.asarray(p256_pallas.verify_limbs_pallas(
-        *[__import__("fabric_tpu.ops.bignum", fromlist=["x"])
-          .words_be_to_limbs(a) for a in args])))
-    assert pl_out == xla
-
-
 # ---------------------------------------------------------------------------
 # per-key fixed-base fast path (round-3)
 # ---------------------------------------------------------------------------

@@ -29,8 +29,9 @@ from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
 from fabric_tpu.bccsp.jaxtpu import JaxTpuProvider
 from fabric_tpu.ops import p256
 
-# one P-256 comb table in bytes (f32 (2752, 44))
-TABLE_BYTES = 2752 * 44 * 4
+# one P-256 comb table in bytes (f32 (COMB_WINDOWS*COMB_ENTRIES, 2L))
+from fabric_tpu.ops import p256_tables as _pt
+TABLE_BYTES = _pt.COMB_WINDOWS * _pt.COMB_ENTRIES * 2 * _pt.L * 4
 
 
 def _sigs(keys, per_key, seed=7):
@@ -98,7 +99,7 @@ def test_lane_choice_hot_keys_ride_rows(monkeypatch, keypool, n_keys):
     """>= threshold sigs per key in one batch -> every sig on the comb
     lane regardless of how many distinct keys there are (the round-3
     NK<=4 cap must never come back)."""
-    prov = _fresh(monkeypatch)
+    prov = _fresh(monkeypatch, FABRIC_TPU_KEY_CACHE=100)
     items = _sigs(keypool[:n_keys], 5)
     out = prov.batch_verify(items)
     assert bool(np.asarray(out).all())
