@@ -55,6 +55,12 @@ class SbeOverlay:
         # lookup: (base_ns, key) -> policy bytes | None (committed state)
         self._lookup = lookup or (lambda ns, key: None)
         self._updates: Dict[Tuple[str, str], Optional[bytes]] = {}
+        # decoded-policy intern table, keyed by the policy BYTES: repeat
+        # lookups return the SAME object, so consumers may key caches on
+        # object identity for the overlay's lifetime (one block).  A
+        # fresh decode per call would free+reuse ids and let one
+        # policy's cached verdict answer for another's.
+        self._decoded: Dict[bytes, Optional[SignaturePolicy]] = {}
 
     def policy_for(self, namespace: str, key: str) -> Optional[SignaturePolicy]:
         k = (namespace, key)
@@ -64,10 +70,15 @@ class SbeOverlay:
             raw = self._lookup(namespace, key)
         if not raw:
             return None
+        raw = bytes(raw)
+        if raw in self._decoded:
+            return self._decoded[raw]
         try:
-            return decode_policy(raw)
+            pol = decode_policy(raw)
         except Exception:
-            return None
+            pol = None
+        self._decoded[raw] = pol
+        return pol
 
     def apply_valid_tx(self, meta_writes) -> None:
         """Record a VALID transaction's metadata writes:

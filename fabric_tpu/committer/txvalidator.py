@@ -292,12 +292,40 @@ class TxValidator:
 
     # -- pass 2: gate + evaluate --------------------------------------------
 
+    def _memoized_plugin(self, eval_cache: dict):
+        """Per-block memoizing wrapper around the validation plugin.
+
+        Policy evaluation is a pure function of (plugin, policy,
+        ordered valid-identity list).  Identities are memoized
+        per-block objects and every policy the gate sees is interned
+        for the block (PolicyRegistry entries live on the validator;
+        SbeOverlay interns decoded key-level policies per block —
+        id()-keying a FRESH decode would let a freed policy's reused
+        address answer for a different policy), so id() keys are stable
+        and the common case — every tx of a chaincode under the same
+        endorser set — evaluates ONCE per block instead of ~10k times.
+        """
+        raw_plugin = self.validation_plugin
+
+        def plugin(pol, idents, ev, _c=eval_cache):
+            key = (id(pol), tuple(map(id, idents)))
+            r = _c.get(key)
+            if r is None:
+                r = _c[key] = raw_plugin(pol, idents, ev)
+            return r
+
+        return plugin
+
     def _gate_tx(self, work: _TxWork, flags: TxFlags,
-                 verdict: Dict[Tuple, bool], sbe_overlay=None) -> None:
+                 verdict: Dict[Tuple, bool], sbe_overlay=None,
+                 plugin=None) -> None:
         if not verdict.get(work.creator_key, False):
             flags.set(work.tx_num, ValidationCode.BAD_CREATOR_SIGNATURE)
             return
         evaluator = self.evaluator
+        if plugin is None:
+            plugin = self.validation_plugin
+
         for ns, pol, sigset in work.namespaces:
             valid_idents = [ident for key, ident in sigset
                             if verdict.get(key, False)]
@@ -317,20 +345,17 @@ class TxValidator:
                     if kpol is None:
                         need_ns_policy = True
                         continue
-                    if not self.validation_plugin(kpol, valid_idents,
-                                                  evaluator):
+                    if not plugin(kpol, valid_idents, evaluator):
                         flags.set(work.tx_num,
                                   ValidationCode.ENDORSEMENT_POLICY_FAILURE)
                         return
                 for key in meta_keys:
                     kpol = sbe_overlay.policy_for(ns, key) or pol
-                    if not self.validation_plugin(kpol, valid_idents,
-                                                  evaluator):
+                    if not plugin(kpol, valid_idents, evaluator):
                         flags.set(work.tx_num,
                                   ValidationCode.ENDORSEMENT_POLICY_FAILURE)
                         return
-            if need_ns_policy and not self.validation_plugin(
-                    pol, valid_idents, evaluator):
+            if need_ns_policy and not plugin(pol, valid_idents, evaluator):
                 flags.set(work.tx_num, ValidationCode.ENDORSEMENT_POLICY_FAILURE)
                 return
         flags.set(work.tx_num, ValidationCode.VALID)
@@ -491,8 +516,9 @@ class TxValidator:
             use_sbe = self.bundle_source.current().has_capability(
                 CAP_KEY_LEVEL_ENDORSEMENT)
         overlay = SbeOverlay(self.sbe_lookup) if use_sbe else None
+        plugin = self._memoized_plugin({})
         for work in works:
-            self._gate_tx(work, flags, verdict, overlay)
+            self._gate_tx(work, flags, verdict, overlay, plugin=plugin)
         gate_s = time.perf_counter() - t0
 
         n_refs = sum(1 + sum(len(s) for _, _, s in w.namespaces) for w in works)

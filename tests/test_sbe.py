@@ -175,3 +175,33 @@ def test_sbe_gated_by_channel_capability(provider):
     assert codes(r) == [ValidationCode.VALID]
     r = commit(com, [tx(o1, e1, writes=[("k", b"v1")])])
     assert codes(r) == [ValidationCode.VALID]
+
+
+def test_two_key_policies_one_tx_no_eval_cross_talk(world):
+    """One tx writes TWO keys whose key-level policies differ (OR vs
+    AND) under the SAME endorser set: each key must be judged by ITS
+    policy.  Regression for the gate's per-block evaluation memo: a
+    fresh-decoded policy object freed between checks could have its
+    id() reused by the next policy, letting the first verdict answer
+    for the second — SbeOverlay now interns decoded policies per block
+    so identity keys are stable."""
+    o1, o2, committer, ledger = world
+    e1 = [o1.new_identity("e1")]
+    loose = parse_policy("OR('Org1.member')")
+    strict = parse_policy("AND('Org1.member','Org2.member')")
+
+    r = commit(committer, [
+        tx(o1, e1, writes=[("ka", b"v"), ("kb", b"v")],
+           sbe_set=[("ka", loose), ("kb", strict)]),
+    ])
+    assert codes(r) == [ValidationCode.VALID]
+
+    # Org1-only endorsement: ka's OR policy passes, kb's AND policy
+    # must FAIL the tx — if the loose verdict leaked into kb's check
+    # the tx would wrongly be VALID (key-level endorsement bypass)
+    r = commit(committer, [
+        tx(o1, e1, writes=[("ka", b"v1"), ("kb", b"v1")]),
+        tx(o1, e1, writes=[("ka", b"v2")]),            # loose key alone: ok
+    ])
+    assert codes(r)[0] == ValidationCode.ENDORSEMENT_POLICY_FAILURE
+    assert codes(r)[1] != ValidationCode.ENDORSEMENT_POLICY_FAILURE
