@@ -313,10 +313,11 @@ class JaxTpuProvider(prov.Provider):
     # dropped at resolve time.
     FAST_ROW_C = int(__import__("os").environ.get(
         "FABRIC_TPU_FAST_ROW_C", "128"))
-    # deliberately coarse (~8 programs): every bucket is a multi-minute
+    # deliberately coarse (~9 programs): every bucket is a multi-minute
     # cold XLA compile; padding waste at most ~2x on small dispatches
-    # where the device is idle anyway
-    ROW_BUCKETS = (4, 16, 64, 128, 256, 384, 512, 1024)
+    # where the device is idle anyway.  96 exists because ~10k-sig
+    # single-key-family batches land at ~80 rows (128 would pad +60%).
+    ROW_BUCKETS = (4, 16, 64, 96, 128, 256, 384, 512, 1024)
     # Soft per-dispatch row cap.  Default = the top bucket (one merged
     # dispatch): on relayed/tunneled transports each dispatch costs a
     # round trip, and A/B on the axon tunnel measured splitting at
@@ -738,6 +739,24 @@ class JaxTpuProvider(prov.Provider):
             self.stats["dispatches"] += 1
             self.stats["device_sigs"] += len(g)
             pending.append(([p[0] for p in g], out))
+
+    def idemix_pair_probe(self, batch: int = None):
+        """(fn, green_args, red_args) for the BN254 dual-pairing lane:
+        green checks e(G1,g2)*e(-G1,g2)==1, red e(G1,g2)^2==1 (both
+        on-curve).  One shared probe for warmup and bench — the callers
+        must not each reach into the kernel privates."""
+        from fabric_tpu.idemix import bn254 as hbn
+        from fabric_tpu.ops import bignum as bnmod
+        b = batch or self.IDEMIX_MIN_BUCKET
+        fn = self._get_fn("idemix-pair")
+        packed = self._idemix_g2_packed()
+        g1 = hbn.G1_GEN
+        x1 = np.stack([bnmod.int_to_limbs(g1[0])] * b, 1)
+        y1 = np.stack([bnmod.int_to_limbs(g1[1])] * b, 1)
+        y2 = np.stack([bnmod.int_to_limbs((hbn.P - g1[1]) % hbn.P)] * b, 1)
+        base = (packed["flags"], packed["A"], packed["B"],
+                packed["A"], packed["B"], x1, y1, x1)
+        return fn, base + (y2,), base + (y1,)
 
     # -- the batch verbs ----------------------------------------------------
 

@@ -7,7 +7,7 @@ compilation cache (bccsp/factory.enable_compile_cache) is hot before a
 node starts serving — run it at provisioning time or from the node's
 init:
 
-    python -m fabric_tpu.node.warmup --buckets 16384,32768
+    python -m fabric_tpu.node.warmup
 
 Subsequent processes on the host then pay ~seconds, not minutes, for
 their first dispatch.
@@ -74,12 +74,27 @@ def gen_ed25519_sigs(n: int, n_keys: int = 4, seed: int = 7):
     return items
 
 
-def warmup(buckets, schemes=("p256", "p256-rows", "ed25519"),
+def warmup(buckets, schemes=("p256", "p256-rows", "ed25519", "idemix"),
            verbose: bool = True) -> dict:
     from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
 
     provider = init_factories(FactoryOpts(default="JAXTPU"))
     timings = {}
+    if "idemix" in schemes:
+        # the BN254 dual-pairing lane: the batch dimension buckets in
+        # powers of two from IDEMIX_MIN_BUCKET, one program each —
+        # warm the first few (covers <=64 presentations per issuer
+        # per block; larger blocks pay one further compile each)
+        import numpy as np
+        b0 = provider.IDEMIX_MIN_BUCKET
+        for b in (b0, b0 * 2, b0 * 4):
+            fn, green, _red = provider.idemix_pair_probe(b)
+            t0 = time.perf_counter()
+            assert bool(np.asarray(fn(*green)).all())
+            timings[f"idemix-pair@{b}"] = round(time.perf_counter() - t0, 1)
+        if verbose:
+            print("idemix-pair:", {k: v for k, v in timings.items()
+                                   if k.startswith("idemix")}, flush=True)
     for bucket in buckets:
         if "p256" in schemes:
             items = gen_p256_sigs(min(bucket, 64), n_keys=8)
@@ -112,9 +127,11 @@ def warmup(buckets, schemes=("p256", "p256-rows", "ed25519"),
 
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="fabric-tpu-warmup")
-    ap.add_argument("--buckets", default="16384,32768",
-                    help="comma-separated batch bucket sizes")
-    ap.add_argument("--schemes", default="p256,p256-rows,ed25519")
+    ap.add_argument("--buckets", default="12288,16384,32768",
+                    help="comma-separated batch sizes (12288 lands the "
+                         "96-row grid bucket; 16384/32768 the 128/256)")
+    ap.add_argument("--schemes",
+                    default="p256,p256-rows,ed25519,idemix")
     args = ap.parse_args(argv)
     timings = warmup([int(b) for b in args.buckets.split(",")],
                      tuple(args.schemes.split(",")))
