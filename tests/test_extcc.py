@@ -112,7 +112,9 @@ def test_contract_error_and_crash_restart(support):
     with pytest.raises(SimulationError):
         contract.invoke(_stub(db), "die", [])
     # ...and the NEXT invoke relaunches the chaincode transparently
-    deadline = time.time() + 10
+    # (generous deadline: a saturated 1-core CI host can stall process
+    # spawn + registration for tens of seconds)
+    deadline = time.time() + 45
     while True:
         try:
             out = contract.invoke(_stub(db), "get", [b"x"])
