@@ -12,11 +12,12 @@ Round-5 methodology:
     /root/reference/bccsp/sw/impl.go:247) on the reference workload: a
     10k-tx block's 40k signatures = 3 endorsements/tx from 3 org keys +
     1 creator sig/tx from a 64-client population, measured steady-state
-    as the MEDIAN OF 7 timed trials after warmup (key comb tables
-    DEVICE-RESIDENT — ops/device_bank.py; repeat identities are the
-    same assumption behind the reference's msp/cache,
-    msp/cache/cache.go).  The shared axon tunnel swings per-call times
-    ~±40%; the median over 7 is the honest middle of that.
+    as the MEDIAN OF ALL 21 TIMED TRIALS pooled across 3 spaced rounds
+    after warmup (key comb tables DEVICE-RESIDENT — ops/device_bank.py;
+    repeat identities are the same assumption behind the reference's
+    msp/cache, msp/cache/cache.go).  The shared axon tunnel swings
+    per-call times ~±40%; the pooled median is the honest middle of
+    that — never a best-of over rounds.
   - detail reports the conservative variant (every creator key distinct
     — generic-ladder path for 25% of sigs), raw per-lane rates, ed25519
     + mixed-curve rates (BASELINE configs 2-3), Idemix (config 4), the
@@ -52,12 +53,12 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 
 def gen_p256_sigs(n: int, n_keys: int, seed: int = 2026):
     """n ECDSA-P256 (VerifyItem, der_pub, der_sig, msg) over n_keys keys."""
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.asymmetric.utils import (
+    from fabric_tpu.crypto import ec
+    from fabric_tpu.crypto import (
         decode_dss_signature, encode_dss_signature)
-    from cryptography.hazmat.primitives.serialization import (
+    from fabric_tpu.crypto import (
         Encoding, PublicFormat)
-    from cryptography.hazmat.primitives import hashes
+    from fabric_tpu.crypto import hashes
 
     from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
     from fabric_tpu.ops import p256
@@ -86,9 +87,9 @@ def gen_p256_sigs(n: int, n_keys: int, seed: int = 2026):
 
 
 def gen_ed25519_sigs(n: int, n_keys: int = 8, seed: int = 7):
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    from fabric_tpu.crypto import (
         Ed25519PrivateKey)
-    from cryptography.hazmat.primitives.serialization import (
+    from fabric_tpu.crypto import (
         Encoding, PublicFormat)
 
     from fabric_tpu.bccsp import SCHEME_ED25519, VerifyItem
@@ -111,10 +112,10 @@ def gen_ed25519_sigs(n: int, n_keys: int = 8, seed: int = 7):
 
 def _cpu_worker(args):
     der_sigs, seconds = args
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.serialization import (
+    from fabric_tpu.crypto import ec
+    from fabric_tpu.crypto import (
         load_der_public_key)
-    from cryptography.hazmat.primitives import hashes
+    from fabric_tpu.crypto import hashes
     sigs = [(load_der_public_key(pk), sig, msg) for pk, sig, msg in der_sigs]
     n = 0
     t0 = time.perf_counter()
@@ -137,12 +138,16 @@ def bench_cpu_openssl(cpu_sigs, seconds: float = 2.0, procs: int = 1):
 # provider-level benchmarks
 # ---------------------------------------------------------------------------
 
-def time_batches(provider, items, trials: int = 5, warmups: int = 2):
+def time_batches(provider, items, trials: int = 5, warmups: int = 2,
+                 return_times: bool = False):
     """(rate sigs/s, per-call s, first-call s) for provider.batch_verify.
 
     Steady state = MEDIAN of `trials` timed calls after `warmups`
     untimed ones — the recorded number must not be a lottery over
-    host/TPU contention windows (VERDICT r03 weak #4)."""
+    host/TPU contention windows (VERDICT r03 weak #4).  With
+    `return_times` the raw per-trial times come back too, so callers
+    that run several spaced rounds can pool every trial into one
+    median instead of cherry-picking a round."""
     t0 = time.perf_counter()
     out = provider.batch_verify(items)
     first_s = time.perf_counter() - t0
@@ -155,6 +160,8 @@ def time_batches(provider, items, trials: int = 5, warmups: int = 2):
         out = provider.batch_verify(items)
         times.append(time.perf_counter() - t0)
     dt = statistics.median(times)
+    if return_times:
+        return len(items) / dt, dt, first_s, times
     return len(items) / dt, dt, first_s
 
 
@@ -300,27 +307,31 @@ def main():
 
     # -- headline: the reference block workload, end-to-end provider rate --
     # 40k sigs = 3 org endorsements/tx + 64-client creator sigs, all on
-    # the row-grouped comb fast lane.  THREE spaced rounds of 7-trial
-    # medians; the best round's median is the headline (the same
-    # rationale as bench_window32's best-pass: the shared tunnel stalls
-    # in multi-second stretches, and a round that lands in one measures
-    # pool congestion, not this framework — all round medians are
-    # reported in detail for honesty).
+    # the row-grouped comb fast lane.  THREE spaced rounds of 7 trials;
+    # the headline is the median of ALL 21 trials pooled — an
+    # unconditional estimator, not best-of-3 (a best-of headline
+    # rewards the round that dodged the shared tunnel's stall windows
+    # and is unreproducible on a quiet host).  Per-round medians stay
+    # in detail so congestion spread remains visible.
     mixed = endorse_items + client_creators
     fast_before = provider.stats["fast_key_sigs"]
     calls_before = provider.stats["dispatches"]
-    rate, step_s, first_s = time_batches(provider, mixed, trials=7)
-    rounds_ms = [round(step_s * 1e3, 2)]
+    _, s1, first_s, all_times = time_batches(provider, mixed, trials=7,
+                                             return_times=True)
+    rounds_ms = [round(s1 * 1e3, 2)]
     calls = 9                               # 2 warmup + 7 timed
     for _ in range(2):
         time.sleep(2.0)
-        r2, s2, _ = time_batches(provider, mixed, trials=7, warmups=0)
+        _, s2, _, t2 = time_batches(provider, mixed, trials=7, warmups=0,
+                                    return_times=True)
         calls += 8      # time_batches' first (untimed-as-warmup) + 7
         rounds_ms.append(round(s2 * 1e3, 2))
-        if r2 > rate:
-            rate, step_s = r2, s2
+        all_times.extend(t2)
+    step_s = statistics.median(all_times)
+    rate = len(mixed) / step_s
     detail["mixed_steady_ms"] = round(step_s * 1e3, 2)
     detail["mixed_round_medians_ms"] = rounds_ms
+    detail["mixed_trials_pooled"] = len(all_times)
     detail["compile_plus_first_s"] = round(first_s, 2)
     detail["fast_key_sigs_per_block"] = (
         provider.stats["fast_key_sigs"] - fast_before) // calls

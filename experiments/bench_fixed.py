@@ -2,9 +2,9 @@
 import hashlib, os, random, time
 import numpy as np, jax
 
-from cryptography.hazmat.primitives.asymmetric import ec as cec
-from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
-from cryptography.hazmat.primitives import hashes
+from fabric_tpu.crypto import ec as cec
+from fabric_tpu.crypto import decode_dss_signature
+from fabric_tpu.crypto import hashes
 
 from fabric_tpu.ops import p256, p256_fixed, p256_tables
 

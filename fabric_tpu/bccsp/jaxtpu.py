@@ -22,11 +22,12 @@ atomic to keep determinism).
 from __future__ import annotations
 
 import logging
+from dataclasses import dataclass, field
 from typing import Optional, Sequence
 
 import numpy as np
 
-from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
+from fabric_tpu.crypto import decode_dss_signature
 
 from . import provider as prov
 from .provider import (VerifyItem, SCHEME_P256, SCHEME_ED25519,
@@ -61,11 +62,41 @@ def _bucket(n: int) -> int:
     return b
 
 
+@dataclass(frozen=True)
+class ProviderStats:
+    """Immutable point-in-time snapshot of a JaxTpuProvider's counters
+    and effective tuning — the public observability surface."""
+    dispatches: int = 0
+    device_sigs: int = 0
+    host_rejects: int = 0
+    fallbacks: int = 0
+    fast_key_sigs: int = 0        # sigs that rode the fixed-comb lane
+    h2d_bytes: int = 0
+    p256_table_builds: int = 0
+    ed25519_table_builds: int = 0
+    tuning: dict = field(default_factory=dict)
+
+
 class JaxTpuProvider(prov.Provider):
     name = "jaxtpu"
 
     def __init__(self, require_low_s: bool = True, mesh=None,
-                 fallback: Optional[SoftwareProvider] = None):
+                 fallback: Optional[SoftwareProvider] = None,
+                 fast_row_c: Optional[int] = None,
+                 rows_chunk: Optional[int] = None,
+                 fast_key_threshold: Optional[int] = None,
+                 max_cached_keys: Optional[int] = None):
+        """Tuning knobs are per-instance constructor parameters (the
+        public surface — no class-attribute monkeypatching needed);
+        None means the FABRIC_TPU_* env default for that knob.
+
+          fast_row_c          lanes per row in the fixed-base comb grid
+          rows_chunk          soft per-dispatch row cap (pack/compute
+                              overlap vs per-dispatch round-trip cost)
+          fast_key_threshold  sigs/batch a key must bring to earn a
+                              device-resident table slot
+          max_cached_keys     table-bank slots (HBM residency cap)
+        """
         import os
         self.require_low_s = require_low_s
         self.mesh = mesh
@@ -91,8 +122,15 @@ class JaxTpuProvider(prov.Provider):
         # realistic ~67-hot-key block workload: pinning makes the slot
         # count a PER-BATCH fast-lane cap)
         _default_keys = "256" if _jax.default_backend() != "cpu" else "96"
-        max_keys = int(os.environ.get("FABRIC_TPU_KEY_CACHE",
-                                      _default_keys))
+        max_keys = int(max_cached_keys if max_cached_keys is not None
+                       else os.environ.get("FABRIC_TPU_KEY_CACHE",
+                                           _default_keys))
+        self.max_cached_keys = max_keys
+        # instance geometry shadows the env-derived class defaults
+        self.fast_row_c = int(fast_row_c if fast_row_c is not None
+                              else self.FAST_ROW_C)
+        self.rows_chunk = int(rows_chunk if rows_chunk is not None
+                              else self.ROWS_CHUNK)
 
         def _build_p256(pk: bytes):
             if len(pk) != 65 or pk[0] != 0x04:
@@ -118,7 +156,21 @@ class JaxTpuProvider(prov.Provider):
             max_keys, (_et.COMB_WINDOWS * _et.COMB_ROWS, 3 * _et.L),
             _build_ed, mesh=mesh)
         self.fast_key_threshold = int(
-            os.environ.get("FABRIC_TPU_FAST_KEY_THRESHOLD", "64"))
+            fast_key_threshold if fast_key_threshold is not None
+            else os.environ.get("FABRIC_TPU_FAST_KEY_THRESHOLD", "64"))
+
+    def stats_snapshot(self) -> ProviderStats:
+        """Point-in-time copy of the provider's counters plus the table
+        banks' build accounting — callers observe through this instead
+        of reaching into the live mutable dicts."""
+        return ProviderStats(
+            **self.stats,
+            p256_table_builds=self.key_tables.stats.get("builds", 0),
+            ed25519_table_builds=self.ed_key_tables.stats.get("builds", 0),
+            tuning={"fast_row_c": self.fast_row_c,
+                    "rows_chunk": self.rows_chunk,
+                    "fast_key_threshold": self.fast_key_threshold,
+                    "max_cached_keys": self.max_cached_keys})
 
     # signing / key-gen are host-side: delegate
     def key_gen(self, scheme: str):
@@ -437,7 +489,7 @@ class JaxTpuProvider(prov.Provider):
         """Vectorized rows-lane packing: key-major (R, C) grid built by
         numpy gathers over the batch word arrays; chunked by
         ROWS_CHUNK/ROW_BUCKETS like the rec path."""
-        C = self.FAST_ROW_C
+        C = self.fast_row_c
         order = sel[np.argsort(key_ids[sel], kind="stable")]
         gids, starts, ngs = np.unique(key_ids[order], return_index=True,
                                       return_counts=True)
@@ -463,7 +515,7 @@ class JaxTpuProvider(prov.Provider):
         R = sel_grid.shape[0]
         fn = self._get_fn("p256-rows")
         bank = self.key_tables.array()
-        max_rows = min(self.ROW_BUCKETS[-1], max(self.ROWS_CHUNK, 1))
+        max_rows = min(self.ROW_BUCKETS[-1], max(self.rows_chunk, 1))
         for lo in range(0, R, max_rows):
             hi = min(lo + max_rows, R)
             sg, rk, og = sel_grid[lo:hi], row_key[lo:hi], slot_grid[lo:hi]
@@ -524,8 +576,8 @@ class JaxTpuProvider(prov.Provider):
         bucket, row counts padded to a bucket (and to the mesh size),
         padding slots marked -1 (dropped at resolve).  row_key entries
         are device-bank slot indices — no per-chunk table list."""
-        C = self.FAST_ROW_C
-        max_rows = min(self.ROW_BUCKETS[-1], max(self.ROWS_CHUNK, 1))
+        C = self.fast_row_c
+        max_rows = min(self.ROW_BUCKETS[-1], max(self.rows_chunk, 1))
         chunks = []
         cur = {"row_key": [], "recs": [], "slots": []}
 
@@ -585,7 +637,7 @@ class JaxTpuProvider(prov.Provider):
         (idx, pk, r32, s32, e32)).  The table bank is already in HBM —
         only r/s/e words and the slot vector cross host->device."""
         from fabric_tpu.ops import p256 as p256mod
-        C = self.FAST_ROW_C
+        C = self.fast_row_c
         fn = self._get_fn("p256-rows")
         bank = self.key_tables.array()
         for row_key, frecs, slots, Rb in self._row_chunks(fast):
@@ -602,7 +654,7 @@ class JaxTpuProvider(prov.Provider):
         """ed25519 row-grid dispatches (fast: [(bank_slot, recs)], recs:
         (idx, pk, sig, msg))."""
         from fabric_tpu.ops import ed25519 as edmod
-        C = self.FAST_ROW_C
+        C = self.fast_row_c
         fn = self._get_fn("ed25519-rows")
         bank = self.ed_key_tables.array()
         for row_key, frecs, slots, Rb in self._row_chunks(fast):

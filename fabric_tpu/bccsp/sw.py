@@ -13,14 +13,14 @@ from typing import Sequence
 
 import numpy as np
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+from fabric_tpu.crypto import InvalidSignature
+from fabric_tpu.crypto import hashes
+from fabric_tpu.crypto import ec
+from fabric_tpu.crypto import (
     Ed25519PrivateKey, Ed25519PublicKey)
-from cryptography.hazmat.primitives.asymmetric.utils import (
+from fabric_tpu.crypto import (
     Prehashed, decode_dss_signature, encode_dss_signature)
-from cryptography.hazmat.primitives import serialization
+from fabric_tpu.crypto import serialization
 
 from . import provider as prov
 from .provider import (VerifyItem, SCHEME_P256, SCHEME_ED25519,

@@ -33,13 +33,12 @@ import struct
 import threading
 from typing import Callable, Dict, Optional
 
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
+from fabric_tpu.crypto import (
+    Aead,
     X25519PrivateKey,
     X25519PublicKey,
+    hkdf_sha256,
 )
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives import hashes as chashes
 
 from fabric_tpu.utils import serde
 
@@ -52,8 +51,7 @@ class HandshakeError(Exception):
 
 
 def _hkdf(secret: bytes, transcript: bytes, label: bytes) -> bytes:
-    return HKDF(algorithm=chashes.SHA256(), length=32, salt=transcript,
-                info=label).derive(secret)
+    return hkdf_sha256(secret, salt=transcript, info=label, length=32)
 
 
 def _read_exact(sock: socket.socket, n: int) -> bytes:
@@ -84,8 +82,8 @@ class SecureChannel:
                  recv_key: bytes):
         self._sock = sock
         self.peer_identity = peer_identity      # verified msp Identity
-        self._send = ChaCha20Poly1305(send_key)
-        self._recv = ChaCha20Poly1305(recv_key)
+        self._send = Aead(send_key)
+        self._recv = Aead(recv_key)
         self._send_ctr = 0
         self._recv_ctr = 0
         self._wlock = threading.Lock()

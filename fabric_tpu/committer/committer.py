@@ -37,6 +37,14 @@ class Committer:
         self.confighistory = confighistory
         # wire the duplicate-txid oracle to the block store
         self.validator.ledger_has_txid = ledger.blockstore.has_txid
+        # post-commit hooks fed (block, final TxFlags); the gateway's
+        # commit-status notifier rides here so clients learn a txid's
+        # validation code without polling the ledger
+        self._commit_listeners = []
+
+    def add_commit_listener(self, fn) -> None:
+        """Register fn(block, final_flags), called after every commit."""
+        self._commit_listeners.append(fn)
 
     def store_block(self, block: Block) -> BlockCommitResult:
         """Validate (verify-then-gate) and commit one block.
@@ -141,6 +149,12 @@ class Committer:
         stats = self.ledger.commit(block)
         final = TxFlags.from_bytes(block.metadata.items[META_TXFLAGS])
         self._observe_metrics(block, vr, stats)
+        for fn in self._commit_listeners:
+            try:
+                fn(block, final)
+            except Exception:
+                logger.exception("commit listener failed for block %d",
+                                 block.header.number)
         if new_cfg is not None and final.is_valid(0):
             try:
                 from fabric_tpu.config import Bundle

@@ -1,0 +1,84 @@
+"""Crypto shim: one import surface for host-side cryptography.
+
+Every module in the framework that needs host crypto (sign, X.509-style
+identity certs, ECDH transport keys) imports it from HERE instead of
+from `cryptography` directly.  When the real `cryptography` package is
+installed, this module re-exports it verbatim, so behavior (and wire
+formats: real X.509 PEM, PKCS8, DER ECDSA) is exactly the upstream
+library's.  When it is missing — common on minimal TPU pods and CI
+hosts — a pure-Python fallback with the same API subset takes over:
+
+  * P-256 ECDSA + keygen       (fabric_tpu.crypto._p256)
+  * Ed25519 / X25519           (_ed25519 / _x25519, RFC 8032 / 7748)
+  * DER ECDSA sig codec        (_der — used in BOTH modes is fine; we
+                                re-export the C one when present)
+  * lite "X.509" identity certs (lite_x509 — serde-encoded TBS in a
+                                FABRICTPU PEM armor; NOT ASN.1)
+
+The two modes are NOT wire-compatible with each other (lite certs are
+not ASN.1 X.509), but a deployment is always homogeneous: every node in
+a dev/test topology runs from the same environment, and all framework
+trust decisions flow through MSPs built from certs minted in-process by
+msp/ca.py.  `HAVE_CRYPTOGRAPHY` tells callers (and tests) which mode
+is active.
+
+AEAD + HKDF for the secure channel live in `fabric_tpu.crypto.aead`
+and are re-exported here; HKDF is pure-Python in both modes (RFC 5869
+over hashlib, deterministic, identical output either way).
+"""
+
+from __future__ import annotations
+
+try:  # pragma: no cover - environment probe
+    import cryptography  # noqa: F401
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:
+    HAVE_CRYPTOGRAPHY = False
+
+if HAVE_CRYPTOGRAPHY:  # pragma: no cover - exercised only with the real lib
+    from cryptography import x509
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import ec, ed25519
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey, Ed25519PublicKey)
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed, decode_dss_signature, encode_dss_signature)
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey)
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, NoEncryption, PrivateFormat, PublicFormat,
+        load_der_public_key, load_pem_private_key, load_pem_public_key)
+    from cryptography.x509.oid import NameOID
+else:
+    from fabric_tpu.crypto import lite_ec as ec
+    from fabric_tpu.crypto import lite_ed25519 as ed25519
+    from fabric_tpu.crypto import lite_hashes as hashes
+    from fabric_tpu.crypto import lite_serialization as serialization
+    from fabric_tpu.crypto import lite_x509 as x509
+    from fabric_tpu.crypto._der import (decode_dss_signature,
+                                        encode_dss_signature)
+    from fabric_tpu.crypto._errors import InvalidSignature
+    from fabric_tpu.crypto.lite_ec import Prehashed
+    from fabric_tpu.crypto.lite_ed25519 import (Ed25519PrivateKey,
+                                                Ed25519PublicKey)
+    from fabric_tpu.crypto.lite_serialization import (
+        Encoding, NoEncryption, PrivateFormat, PublicFormat,
+        load_der_public_key, load_pem_private_key, load_pem_public_key)
+    from fabric_tpu.crypto.lite_x25519 import (X25519PrivateKey,
+                                               X25519PublicKey)
+    from fabric_tpu.crypto.lite_x509 import NameOID
+
+from fabric_tpu.crypto.aead import Aead, hkdf_sha256
+
+__all__ = [
+    "HAVE_CRYPTOGRAPHY",
+    "x509", "ec", "ed25519", "hashes", "serialization", "NameOID",
+    "InvalidSignature", "Prehashed",
+    "decode_dss_signature", "encode_dss_signature",
+    "Ed25519PrivateKey", "Ed25519PublicKey",
+    "X25519PrivateKey", "X25519PublicKey",
+    "Encoding", "NoEncryption", "PrivateFormat", "PublicFormat",
+    "load_der_public_key", "load_pem_private_key", "load_pem_public_key",
+    "Aead", "hkdf_sha256",
+]

@@ -1,0 +1,73 @@
+"""Minimal DER codec for ECDSA signatures: SEQUENCE of two INTEGERs.
+
+API parity with cryptography.hazmat.primitives.asymmetric.utils'
+encode_dss_signature / decode_dss_signature.  Strict DER: minimal
+integer encodings, definite short/long lengths, no trailing bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+
+def _enc_int(v: int) -> bytes:
+    if v < 0:
+        raise ValueError("negative integers not supported")
+    n = max(1, (v.bit_length() + 7) // 8)
+    body = v.to_bytes(n, "big")
+    if body[0] & 0x80:
+        body = b"\x00" + body
+    return b"\x02" + _enc_len(len(body)) + body
+
+
+def _enc_len(n: int) -> bytes:
+    if n < 0x80:
+        return bytes([n])
+    body = n.to_bytes((n.bit_length() + 7) // 8, "big")
+    return bytes([0x80 | len(body)]) + body
+
+
+def encode_dss_signature(r: int, s: int) -> bytes:
+    body = _enc_int(r) + _enc_int(s)
+    return b"\x30" + _enc_len(len(body)) + body
+
+
+def _dec_len(data: bytes, off: int) -> Tuple[int, int]:
+    first = data[off]
+    off += 1
+    if first < 0x80:
+        return first, off
+    n = first & 0x7F
+    if not n or n > 8:
+        raise ValueError("bad DER length")
+    val = int.from_bytes(data[off:off + n], "big")
+    if len(data[off:off + n]) != n or val < 0x80:
+        raise ValueError("non-minimal DER length")
+    return val, off + n
+
+
+def _dec_int(data: bytes, off: int) -> Tuple[int, int]:
+    if off >= len(data) or data[off] != 0x02:
+        raise ValueError("expected DER INTEGER")
+    ln, off = _dec_len(data, off + 1)
+    body = data[off:off + ln]
+    if len(body) != ln or not ln:
+        raise ValueError("truncated DER INTEGER")
+    if ln > 1 and body[0] == 0 and not (body[1] & 0x80):
+        raise ValueError("non-minimal DER INTEGER")
+    if body[0] & 0x80:
+        raise ValueError("negative DER INTEGER")
+    return int.from_bytes(body, "big"), off + ln
+
+
+def decode_dss_signature(sig: bytes) -> Tuple[int, int]:
+    if not sig or sig[0] != 0x30:
+        raise ValueError("expected DER SEQUENCE")
+    ln, off = _dec_len(sig, 1)
+    if off + ln != len(sig):
+        raise ValueError("trailing bytes after DER SEQUENCE")
+    r, off = _dec_int(sig, off)
+    s, off = _dec_int(sig, off)
+    if off != len(sig):
+        raise ValueError("trailing bytes inside DER SEQUENCE")
+    return r, s

@@ -1,0 +1,11 @@
+"""Exception types shared by the pure-Python crypto fallback modules."""
+
+
+class InvalidSignature(Exception):
+    """Raised when a signature fails verification (API parity with
+    cryptography.exceptions.InvalidSignature)."""
+
+
+class UnsupportedAlgorithm(Exception):
+    """Raised for algorithm/format combinations outside the fallback's
+    supported subset."""

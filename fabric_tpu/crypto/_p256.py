@@ -1,0 +1,314 @@
+"""Pure-Python NIST P-256 (secp256r1) group + ECDSA host operations.
+
+Fallback engine for hosts without the `cryptography` package.  Built for
+correctness first, then for "fast enough to run the test topology":
+Jacobian coordinates throughout, a fixed-comb table for base-point
+multiples (built once at first use) and a per-call 4-bit window for
+arbitrary points.  A sign is ~64 mixed additions; a verify is ~320
+point ops — around a millisecond each on a laptop-class core, which is
+plenty for dev topologies (the batch-verify hot path runs on the
+JAX/TPU provider, never here).
+
+Private keys are plain ints; public keys are affine (x, y) int pairs.
+"""
+
+from __future__ import annotations
+
+import hmac
+import hashlib
+import secrets
+from typing import Optional, Tuple
+
+# curve parameters (FIPS 186-4, D.1.2.3)
+P = 0xFFFFFFFF00000001000000000000000000000000FFFFFFFFFFFFFFFFFFFFFFFF
+N = 0xFFFFFFFF00000000FFFFFFFFFFFFFFFFBCE6FAADA7179E84F3B9CAC2FC632551
+A = P - 3
+B = 0x5AC635D8AA3A93E7B3EBBD55769886BC651D06B0CC53B0F63BCE3C3E27D2604B
+GX = 0x6B17D1F2E12C4247F8BCE6E563A440F277037D812DEB33A0F4A13945D898C296
+GY = 0x4FE342E2FE1A7F9B8EE7EB4A7C0F9E162BCE33576B315ECECBB6406837BF51F5
+HALF_N = N // 2
+
+Affine = Tuple[int, int]
+# Jacobian point (X, Y, Z); Z == 0 is the point at infinity
+_Jac = Tuple[int, int, int]
+_INF: _Jac = (0, 1, 0)
+
+
+def _jac_double(pt: _Jac) -> _Jac:
+    X1, Y1, Z1 = pt
+    if not Z1 or not Y1:
+        return _INF
+    # dbl-2001-b (a = -3)
+    delta = Z1 * Z1 % P
+    gamma = Y1 * Y1 % P
+    beta = X1 * gamma % P
+    alpha = 3 * (X1 - delta) * (X1 + delta) % P
+    X3 = (alpha * alpha - 8 * beta) % P
+    Z3 = ((Y1 + Z1) * (Y1 + Z1) - gamma - delta) % P
+    Y3 = (alpha * (4 * beta - X3) - 8 * gamma * gamma) % P
+    return (X3, Y3, Z3)
+
+
+def _jac_add(p1: _Jac, p2: _Jac) -> _Jac:
+    if not p1[2]:
+        return p2
+    if not p2[2]:
+        return p1
+    X1, Y1, Z1 = p1
+    X2, Y2, Z2 = p2
+    Z1Z1 = Z1 * Z1 % P
+    Z2Z2 = Z2 * Z2 % P
+    U1 = X1 * Z2Z2 % P
+    U2 = X2 * Z1Z1 % P
+    S1 = Y1 * Z2 * Z2Z2 % P
+    S2 = Y2 * Z1 * Z1Z1 % P
+    H = (U2 - U1) % P
+    R = (S2 - S1) % P
+    if not H:
+        if not R:
+            return _jac_double(p1)
+        return _INF
+    HH = H * H % P
+    HHH = H * HH % P
+    V = U1 * HH % P
+    X3 = (R * R - HHH - 2 * V) % P
+    Y3 = (R * (V - X3) - S1 * HHH) % P
+    Z3 = Z1 * Z2 * H % P
+    return (X3, Y3, Z3)
+
+
+def _jac_add_affine(p1: _Jac, p2: Affine) -> _Jac:
+    """Mixed addition: Jacobian + affine (Z2 == 1)."""
+    if not p1[2]:
+        return (p2[0], p2[1], 1)
+    X1, Y1, Z1 = p1
+    X2, Y2 = p2
+    Z1Z1 = Z1 * Z1 % P
+    U2 = X2 * Z1Z1 % P
+    S2 = Y2 * Z1 * Z1Z1 % P
+    H = (U2 - X1) % P
+    R = (S2 - Y1) % P
+    if not H:
+        if not R:
+            return _jac_double(p1)
+        return _INF
+    HH = H * H % P
+    HHH = H * HH % P
+    V = X1 * HH % P
+    X3 = (R * R - HHH - 2 * V) % P
+    Y3 = (R * (V - X3) - Y1 * HHH) % P
+    Z3 = Z1 * H % P
+    return (X3, Y3, Z3)
+
+
+def _to_affine(pt: _Jac) -> Optional[Affine]:
+    X, Y, Z = pt
+    if not Z:
+        return None
+    zi = pow(Z, -1, P)
+    zi2 = zi * zi % P
+    return (X * zi2 % P, Y * zi2 * zi % P)
+
+
+def is_on_curve(x: int, y: int) -> bool:
+    if not (0 <= x < P and 0 <= y < P):
+        return False
+    return (y * y - (x * x * x + A * x + B)) % P == 0
+
+
+# ---------------------------------------------------------------------------
+# fixed-comb table for G: _GTBL[w][d-1] = (d << (4*w)) * G in affine,
+# for w in 0..63, d in 1..15.  Built lazily on first scalar_base_mult;
+# one batch inversion (Montgomery's trick) converts the whole table.
+
+_GTBL: Optional[list] = None
+
+
+def _batch_to_affine(pts: list) -> list:
+    zs = [pt[2] for pt in pts]
+    # prefix products
+    acc = 1
+    pre = []
+    for z in zs:
+        pre.append(acc)
+        acc = acc * z % P
+    inv = pow(acc, -1, P)
+    out = [None] * len(pts)
+    for i in range(len(pts) - 1, -1, -1):
+        zi = inv * pre[i] % P
+        inv = inv * zs[i] % P
+        X, Y, _ = pts[i]
+        zi2 = zi * zi % P
+        out[i] = (X * zi2 % P, Y * zi2 * zi % P)
+    return out
+
+
+def _build_gtbl() -> list:
+    rows = []
+    flat: list = []
+    base: _Jac = (GX, GY, 1)
+    for _ in range(64):
+        row = [base]
+        for _ in range(14):
+            row.append(_jac_add(row[-1], base))
+        rows.append(row)
+        flat.extend(row)
+        base = row[-1]
+        base = _jac_add(base, rows[-1][0])  # 16 * (16^w * G)
+    aff = _batch_to_affine(flat)
+    return [aff[i * 15:(i + 1) * 15] for i in range(64)]
+
+
+def scalar_base_mult(k: int) -> Optional[Affine]:
+    """k*G in affine coordinates (None for the point at infinity)."""
+    global _GTBL
+    if _GTBL is None:
+        _GTBL = _build_gtbl()
+    k %= N
+    acc = _INF
+    w = 0
+    while k:
+        d = k & 0xF
+        if d:
+            acc = _jac_add_affine(acc, _GTBL[w][d - 1])
+        k >>= 4
+        w += 1
+    return _to_affine(acc)
+
+
+def scalar_mult(k: int, pt: Affine) -> Optional[Affine]:
+    """k*pt for an arbitrary affine point, 4-bit fixed window."""
+    k %= N
+    if not k:
+        return None
+    # window table 1..15 in Jacobian via mixed adds
+    tbl: list = [(pt[0], pt[1], 1)]
+    for _ in range(14):
+        tbl.append(_jac_add_affine(tbl[-1], pt))
+    acc = _INF
+    nibbles = []
+    while k:
+        nibbles.append(k & 0xF)
+        k >>= 4
+    for d in reversed(nibbles):
+        for _ in range(4):
+            acc = _jac_double(acc)
+        if d:
+            acc = _jac_add(acc, tbl[d - 1])
+    return _to_affine(acc)
+
+
+def _double_mult(u1: int, u2: int, q: Affine) -> Optional[Affine]:
+    """u1*G + u2*Q — comb for G, windowed for Q, shared accumulator."""
+    global _GTBL
+    if _GTBL is None:
+        _GTBL = _build_gtbl()
+    u1 %= N
+    u2 %= N
+    tbl: list = [(q[0], q[1], 1)]
+    for _ in range(14):
+        tbl.append(_jac_add_affine(tbl[-1], q))
+    acc = _INF
+    started = False
+    for w in range(63, -1, -1):
+        if started:
+            for _ in range(4):
+                acc = _jac_double(acc)
+        d2 = (u2 >> (4 * w)) & 0xF
+        if d2:
+            acc = _jac_add(acc, tbl[d2 - 1])
+        started = started or acc[2] != 0
+    # add u1*G via the comb (no doublings needed)
+    w = 0
+    while u1:
+        d = u1 & 0xF
+        if d:
+            acc = _jac_add_affine(acc, _GTBL[w][d - 1])
+        u1 >>= 4
+        w += 1
+    return _to_affine(acc)
+
+
+# ---------------------------------------------------------------------------
+# key + ECDSA operations
+
+def generate_private_scalar() -> int:
+    while True:
+        d = secrets.randbelow(N)
+        if d:
+            return d
+
+
+def public_from_scalar(d: int) -> Affine:
+    pt = scalar_base_mult(d)
+    if pt is None:
+        raise ValueError("invalid private scalar")
+    return pt
+
+
+def _rfc6979_k(d: int, e: int) -> int:
+    """Deterministic nonce (RFC 6979, SHA-256) — no RNG misuse possible."""
+    holen = 32
+    x = d.to_bytes(32, "big")
+    h1 = (e % N).to_bytes(32, "big")
+    V = b"\x01" * holen
+    K = b"\x00" * holen
+    K = hmac.new(K, V + b"\x00" + x + h1, hashlib.sha256).digest()
+    V = hmac.new(K, V, hashlib.sha256).digest()
+    K = hmac.new(K, V + b"\x01" + x + h1, hashlib.sha256).digest()
+    V = hmac.new(K, V, hashlib.sha256).digest()
+    while True:
+        V = hmac.new(K, V, hashlib.sha256).digest()
+        k = int.from_bytes(V, "big")
+        if 1 <= k < N:
+            return k
+        K = hmac.new(K, V + b"\x00", hashlib.sha256).digest()
+        V = hmac.new(K, V, hashlib.sha256).digest()
+
+
+def sign_digest(d: int, digest: bytes) -> Tuple[int, int]:
+    """ECDSA over a 32-byte digest; returns (r, s) (s NOT low-S
+    normalized — callers that care, normalize)."""
+    e = int.from_bytes(digest, "big")
+    while True:
+        k = _rfc6979_k(d, e)
+        pt = scalar_base_mult(k)
+        if pt is None:
+            continue
+        r = pt[0] % N
+        if not r:
+            continue
+        s = pow(k, -1, N) * (e + r * d) % N
+        if not s:
+            continue
+        return r, s
+
+
+def verify_digest(q: Affine, digest: bytes, r: int, s: int) -> bool:
+    if not (1 <= r < N and 1 <= s < N):
+        return False
+    if not is_on_curve(*q):
+        return False
+    e = int.from_bytes(digest, "big")
+    w = pow(s, -1, N)
+    pt = _double_mult(e * w % N, r * w % N, q)
+    if pt is None:
+        return False
+    return pt[0] % N == r
+
+
+# ---------------------------------------------------------------------------
+# SEC1 point codec
+
+def encode_point(q: Affine) -> bytes:
+    return b"\x04" + q[0].to_bytes(32, "big") + q[1].to_bytes(32, "big")
+
+
+def decode_point(data: bytes) -> Affine:
+    if len(data) != 65 or data[0] != 0x04:
+        raise ValueError("only 65-byte uncompressed SEC1 points supported")
+    x = int.from_bytes(data[1:33], "big")
+    y = int.from_bytes(data[33:65], "big")
+    if not is_on_curve(x, y):
+        raise ValueError("point not on curve")
+    return (x, y)

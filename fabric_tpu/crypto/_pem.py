@@ -1,0 +1,37 @@
+"""PEM-style armor for the fallback's serde-encoded key/cert blobs.
+
+Labels use a FABRICTPU prefix on purpose: these blobs are NOT ASN.1 and
+must never be mistaken for real X.509 / PKCS8 material by other tools.
+"""
+
+from __future__ import annotations
+
+import base64
+
+
+def armor(label: str, der: bytes) -> bytes:
+    b64 = base64.b64encode(der).decode()
+    lines = [b64[i:i + 64] for i in range(0, len(b64), 64)] or [""]
+    return ("-----BEGIN %s-----\n%s\n-----END %s-----\n"
+            % (label, "\n".join(lines), label)).encode()
+
+
+def dearmor(pem: bytes, label: str) -> bytes:
+    text = pem.decode() if isinstance(pem, (bytes, bytearray)) else str(pem)
+    begin = "-----BEGIN %s-----" % label
+    end = "-----END %s-----" % label
+    try:
+        start = text.index(begin) + len(begin)
+        stop = text.index(end, start)
+    except ValueError:
+        raise ValueError("no %s PEM block found" % label) from None
+    return base64.b64decode("".join(text[start:stop].split()))
+
+
+def first_label(pem: bytes) -> str:
+    text = pem.decode() if isinstance(pem, (bytes, bytearray)) else str(pem)
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("-----BEGIN ") and line.endswith("-----"):
+            return line[len("-----BEGIN "):-len("-----")]
+    raise ValueError("no PEM block found")

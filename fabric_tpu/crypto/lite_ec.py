@@ -1,0 +1,120 @@
+"""Fallback EC API (secp256r1 only) with cryptography-compatible
+surface: generate/derive_private_key, ECDSA with SHA-256 (plain or
+Prehashed), X962 uncompressed public bytes, PEM private keys."""
+
+from __future__ import annotations
+
+import hashlib
+
+from fabric_tpu.crypto import _p256, lite_serialization as _ser
+from fabric_tpu.crypto._der import decode_dss_signature, encode_dss_signature
+from fabric_tpu.crypto._errors import InvalidSignature
+
+
+class SECP256R1:
+    name = "secp256r1"
+    key_size = 256
+
+
+class Prehashed:
+    def __init__(self, algorithm):
+        self.algorithm = algorithm
+
+
+class ECDSA:
+    def __init__(self, algorithm):
+        self.algorithm = algorithm
+
+
+def _digest_for(signature_algorithm, data: bytes) -> bytes:
+    algo = getattr(signature_algorithm, "algorithm", signature_algorithm)
+    if isinstance(algo, Prehashed):
+        if len(data) != algo.algorithm.digest_size:
+            raise ValueError("prehashed data has wrong length")
+        return bytes(data)
+    if getattr(algo, "name", None) != "sha256":
+        raise ValueError("fallback ECDSA supports SHA-256 only")
+    return hashlib.sha256(data).digest()
+
+
+class EllipticCurveNumbers:
+    def __init__(self, x: int, y: int):
+        self.x = x
+        self.y = y
+
+
+class EllipticCurvePublicKey:
+    def __init__(self, point):
+        self._q = point
+        self.curve = SECP256R1()
+
+    @classmethod
+    def from_encoded_point(cls, curve, data: bytes):
+        return cls(_p256.decode_point(bytes(data)))
+
+    def public_numbers(self) -> EllipticCurveNumbers:
+        return EllipticCurveNumbers(*self._q)
+
+    def public_bytes(self, encoding, format) -> bytes:
+        if (encoding == _ser.Encoding.X962
+                and format == _ser.PublicFormat.UncompressedPoint):
+            return _p256.encode_point(self._q)
+        if format == _ser.PublicFormat.SubjectPublicKeyInfo:
+            return _ser.serialize_public(
+                "p256", _p256.encode_point(self._q), encoding)
+        raise ValueError("unsupported EC public_bytes format")
+
+    def verify(self, signature: bytes, data: bytes,
+               signature_algorithm) -> None:
+        digest = _digest_for(signature_algorithm, data)
+        try:
+            r, s = decode_dss_signature(signature)
+        except ValueError:
+            raise InvalidSignature("malformed DER signature") from None
+        if not _p256.verify_digest(self._q, digest, r, s):
+            raise InvalidSignature("ECDSA verification failed")
+
+    def __eq__(self, other):
+        return (isinstance(other, EllipticCurvePublicKey)
+                and self._q == other._q)
+
+    def __hash__(self):
+        return hash(("p256-pub", self._q))
+
+
+class EllipticCurvePrivateKey:
+    def __init__(self, d: int):
+        if not (1 <= d < _p256.N):
+            raise ValueError("private scalar out of range")
+        self._d = d
+        self._pub = EllipticCurvePublicKey(_p256.public_from_scalar(d))
+        self.curve = SECP256R1()
+
+    def public_key(self) -> EllipticCurvePublicKey:
+        return self._pub
+
+    def sign(self, data: bytes, signature_algorithm) -> bytes:
+        digest = _digest_for(signature_algorithm, data)
+        r, s = _p256.sign_digest(self._d, digest)
+        return encode_dss_signature(r, s)
+
+    def private_bytes(self, encoding, format, encryption_algorithm) -> bytes:
+        if encoding != _ser.Encoding.PEM:
+            raise ValueError("fallback EC private keys serialize as PEM only")
+        return _ser.serialize_private("p256", self._d.to_bytes(32, "big"))
+
+    def private_numbers(self):
+        key = self
+
+        class _Numbers:
+            private_value = key._d
+        return _Numbers()
+
+
+def generate_private_key(curve, backend=None) -> EllipticCurvePrivateKey:
+    return EllipticCurvePrivateKey(_p256.generate_private_scalar())
+
+
+def derive_private_key(private_value: int, curve,
+                       backend=None) -> EllipticCurvePrivateKey:
+    return EllipticCurvePrivateKey(private_value % _p256.N or 1)

@@ -1,0 +1,74 @@
+"""Fallback Ed25519 API with cryptography-compatible surface."""
+
+from __future__ import annotations
+
+import secrets
+
+from fabric_tpu.crypto import _ed25519, lite_serialization as _ser
+from fabric_tpu.crypto._errors import InvalidSignature
+
+
+class Ed25519PublicKey:
+    def __init__(self, raw: bytes):
+        if len(raw) != 32:
+            raise ValueError("Ed25519 public keys are 32 bytes")
+        self._raw = bytes(raw)
+
+    @classmethod
+    def from_public_bytes(cls, data: bytes) -> "Ed25519PublicKey":
+        return cls(bytes(data))
+
+    def public_bytes(self, encoding, format) -> bytes:
+        if (encoding == _ser.Encoding.Raw
+                and format == _ser.PublicFormat.Raw):
+            return self._raw
+        if format == _ser.PublicFormat.SubjectPublicKeyInfo:
+            return _ser.serialize_public("ed25519", self._raw, encoding)
+        raise ValueError("unsupported Ed25519 public_bytes format")
+
+    def public_bytes_raw(self) -> bytes:
+        return self._raw
+
+    def verify(self, signature: bytes, data: bytes) -> None:
+        if not _ed25519.verify(self._raw, bytes(signature), bytes(data)):
+            raise InvalidSignature("Ed25519 verification failed")
+
+    def __eq__(self, other):
+        return (isinstance(other, Ed25519PublicKey)
+                and self._raw == other._raw)
+
+    def __hash__(self):
+        return hash(("ed-pub", self._raw))
+
+
+class Ed25519PrivateKey:
+    def __init__(self, seed: bytes):
+        if len(seed) != 32:
+            raise ValueError("Ed25519 seeds are 32 bytes")
+        self._seed = bytes(seed)
+        self._pub = Ed25519PublicKey(_ed25519.public_from_seed(self._seed))
+
+    @classmethod
+    def generate(cls) -> "Ed25519PrivateKey":
+        return cls(secrets.token_bytes(32))
+
+    @classmethod
+    def from_private_bytes(cls, data: bytes) -> "Ed25519PrivateKey":
+        return cls(bytes(data))
+
+    def public_key(self) -> Ed25519PublicKey:
+        return self._pub
+
+    def sign(self, data: bytes) -> bytes:
+        return _ed25519.sign(self._seed, bytes(data))
+
+    def private_bytes(self, encoding, format, encryption_algorithm) -> bytes:
+        if (encoding == _ser.Encoding.Raw
+                and format == _ser.PrivateFormat.Raw):
+            return self._seed
+        if encoding != _ser.Encoding.PEM:
+            raise ValueError("fallback Ed25519 keys serialize as PEM or Raw")
+        return _ser.serialize_private("ed25519", self._seed)
+
+    def private_bytes_raw(self) -> bytes:
+        return self._seed

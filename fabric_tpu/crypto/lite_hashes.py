@@ -1,0 +1,37 @@
+"""Hash algorithm descriptors (API parity with
+cryptography.hazmat.primitives.hashes for the subset the framework
+uses).  These are descriptors only — actual hashing runs on hashlib."""
+
+from __future__ import annotations
+
+import hashlib
+
+
+class SHA256:
+    name = "sha256"
+    digest_size = 32
+    block_size = 64
+
+
+class SHA384:
+    name = "sha384"
+    digest_size = 48
+    block_size = 128
+
+
+class SHA512:
+    name = "sha512"
+    digest_size = 64
+    block_size = 128
+
+
+class Hash:
+    def __init__(self, algorithm):
+        self.algorithm = algorithm
+        self._h = hashlib.new(algorithm.name)
+
+    def update(self, data: bytes) -> None:
+        self._h.update(data)
+
+    def finalize(self) -> bytes:
+        return self._h.digest()

@@ -1,0 +1,84 @@
+"""Key (de)serialization enums + loaders for the fallback.
+
+API parity with cryptography.hazmat.primitives.serialization for the
+subset the framework uses.  Private keys serialize as a serde dict
+{"scheme", "secret"} in FABRICTPU PRIVATE KEY armor; public keys as
+{"scheme", "pub"} (armored for PEM, bare serde bytes for "DER").
+"""
+
+from __future__ import annotations
+
+import enum
+
+from fabric_tpu.crypto import _pem
+from fabric_tpu.utils import serde
+
+PRIVATE_LABEL = "FABRICTPU PRIVATE KEY"
+PUBLIC_LABEL = "FABRICTPU PUBLIC KEY"
+
+
+class Encoding(enum.Enum):
+    PEM = "PEM"
+    DER = "DER"
+    X962 = "X962"
+    Raw = "Raw"
+
+
+class PublicFormat(enum.Enum):
+    SubjectPublicKeyInfo = "SubjectPublicKeyInfo"
+    UncompressedPoint = "UncompressedPoint"
+    Raw = "Raw"
+
+
+class PrivateFormat(enum.Enum):
+    PKCS8 = "PKCS8"
+    Raw = "Raw"
+
+
+class NoEncryption:
+    pass
+
+
+def serialize_private(scheme: str, secret: bytes) -> bytes:
+    return _pem.armor(PRIVATE_LABEL,
+                      serde.encode({"scheme": scheme, "secret": secret}))
+
+
+def serialize_public(scheme: str, pub: bytes, encoding: Encoding) -> bytes:
+    der = serde.encode({"scheme": scheme, "pub": pub})
+    if encoding == Encoding.DER:
+        return der
+    return _pem.armor(PUBLIC_LABEL, der)
+
+
+def _public_from_fields(scheme: str, pub: bytes):
+    from fabric_tpu.crypto import lite_ec, lite_ed25519
+    if scheme == "p256":
+        return lite_ec.EllipticCurvePublicKey.from_encoded_point(
+            lite_ec.SECP256R1(), pub)
+    if scheme == "ed25519":
+        return lite_ed25519.Ed25519PublicKey.from_public_bytes(pub)
+    raise ValueError("unsupported key scheme: %r" % scheme)
+
+
+def load_pem_private_key(data: bytes, password=None, backend=None):
+    if password is not None:
+        raise ValueError("fallback keys are never encrypted")
+    d = serde.decode(_pem.dearmor(data, PRIVATE_LABEL))
+    scheme, secret = d["scheme"], d["secret"]
+    from fabric_tpu.crypto import lite_ec, lite_ed25519
+    if scheme == "p256":
+        return lite_ec.derive_private_key(
+            int.from_bytes(secret, "big"), lite_ec.SECP256R1())
+    if scheme == "ed25519":
+        return lite_ed25519.Ed25519PrivateKey.from_private_bytes(secret)
+    raise ValueError("unsupported key scheme: %r" % scheme)
+
+
+def load_der_public_key(data: bytes, backend=None):
+    d = serde.decode(bytes(data))
+    return _public_from_fields(d["scheme"], d["pub"])
+
+
+def load_pem_public_key(data: bytes, backend=None):
+    return load_der_public_key(_pem.dearmor(data, PUBLIC_LABEL))

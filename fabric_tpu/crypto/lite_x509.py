@@ -1,0 +1,416 @@
+"""Fallback "X.509" identity certificates for hosts without the
+`cryptography` package.
+
+API parity with the subset of cryptography.x509 the MSP layer uses
+(builders, Name/NameAttribute, BasicConstraints/KeyUsage extensions,
+CRLs, verify_directly_issued_by).  The encoding is NOT ASN.1: the TBS
+is a canonical serde dict and certs travel in FABRICTPU CERTIFICATE
+armor, so real X.509 material and fallback material can never be
+confused.  All trust decisions in the framework go through MSPs built
+from certs minted by msp/ca.py in the SAME process environment, so the
+two modes never need to interoperate on the wire.
+"""
+
+from __future__ import annotations
+
+import datetime
+import secrets
+from typing import List, Optional
+
+from fabric_tpu.crypto import _pem, lite_serialization as _ser
+from fabric_tpu.crypto._errors import InvalidSignature
+from fabric_tpu.utils import serde
+
+CERT_LABEL = "FABRICTPU CERTIFICATE"
+CRL_LABEL = "FABRICTPU CRL"
+
+
+class NameOID:
+    COMMON_NAME = "CN"
+    ORGANIZATION_NAME = "O"
+    ORGANIZATIONAL_UNIT_NAME = "OU"
+    COUNTRY_NAME = "C"
+    LOCALITY_NAME = "L"
+    STATE_OR_PROVINCE_NAME = "ST"
+
+
+class ExtensionNotFound(Exception):
+    def __init__(self, msg, oid=None):
+        super().__init__(msg)
+        self.oid = oid
+
+
+class NameAttribute:
+    def __init__(self, oid: str, value: str):
+        self.oid = oid
+        self.value = value
+
+    def __eq__(self, other):
+        return (isinstance(other, NameAttribute)
+                and (self.oid, self.value) == (other.oid, other.value))
+
+    def __hash__(self):
+        return hash((self.oid, self.value))
+
+
+class Name:
+    def __init__(self, attributes: List[NameAttribute]):
+        self._attrs = list(attributes)
+
+    def public_bytes(self, backend=None) -> bytes:
+        return serde.encode([[a.oid, a.value] for a in self._attrs])
+
+    def rfc4514_string(self) -> str:
+        return ",".join("%s=%s" % (a.oid, a.value)
+                        for a in reversed(self._attrs))
+
+    def get_attributes_for_oid(self, oid: str) -> List[NameAttribute]:
+        return [a for a in self._attrs if a.oid == oid]
+
+    @staticmethod
+    def _from_wire(pairs) -> "Name":
+        return Name([NameAttribute(o, v) for o, v in pairs])
+
+    def _wire(self):
+        return [[a.oid, a.value] for a in self._attrs]
+
+    def __eq__(self, other):
+        return isinstance(other, Name) and self._wire() == other._wire()
+
+    def __hash__(self):
+        return hash(self.public_bytes())
+
+    def __iter__(self):
+        return iter(self._attrs)
+
+
+class BasicConstraints:
+    oid = "basicConstraints"
+
+    def __init__(self, ca: bool, path_length: Optional[int]):
+        self.ca = bool(ca)
+        self.path_length = path_length
+
+
+class KeyUsage:
+    oid = "keyUsage"
+
+    _FIELDS = ("digital_signature", "content_commitment", "key_encipherment",
+               "data_encipherment", "key_agreement", "key_cert_sign",
+               "crl_sign", "encipher_only", "decipher_only")
+
+    def __init__(self, digital_signature=False, content_commitment=False,
+                 key_encipherment=False, data_encipherment=False,
+                 key_agreement=False, key_cert_sign=False, crl_sign=False,
+                 encipher_only=False, decipher_only=False):
+        self.digital_signature = digital_signature
+        self.content_commitment = content_commitment
+        self.key_encipherment = key_encipherment
+        self.data_encipherment = data_encipherment
+        self.key_agreement = key_agreement
+        self.key_cert_sign = key_cert_sign
+        self.crl_sign = crl_sign
+        self.encipher_only = encipher_only
+        self.decipher_only = decipher_only
+
+
+class Extension:
+    def __init__(self, value, critical: bool):
+        self.value = value
+        self.critical = critical
+
+
+class Extensions:
+    def __init__(self, exts: List[Extension]):
+        self._exts = exts
+
+    def get_extension_for_class(self, extclass) -> Extension:
+        for ext in self._exts:
+            if isinstance(ext.value, extclass):
+                return ext
+        raise ExtensionNotFound(
+            "no %s extension" % extclass.__name__,
+            getattr(extclass, "oid", None))
+
+    def __iter__(self):
+        return iter(self._exts)
+
+
+def random_serial_number() -> int:
+    return secrets.randbits(63) | 1
+
+
+def _ts(dt: datetime.datetime) -> float:
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=datetime.timezone.utc)
+    return dt.timestamp()
+
+
+def _dt(ts: float) -> datetime.datetime:
+    return datetime.datetime.fromtimestamp(ts, datetime.timezone.utc)
+
+
+def _key_scheme_and_wire(public_key):
+    from fabric_tpu.crypto import lite_ec, lite_ed25519
+    if isinstance(public_key, lite_ec.EllipticCurvePublicKey):
+        return "p256", public_key.public_bytes(
+            _ser.Encoding.X962, _ser.PublicFormat.UncompressedPoint)
+    if isinstance(public_key, lite_ed25519.Ed25519PublicKey):
+        return "ed25519", public_key.public_bytes_raw()
+    raise ValueError("unsupported public key type for certificates")
+
+
+def _public_from_wire(scheme: str, wire: bytes):
+    return _ser._public_from_fields(scheme, wire)
+
+
+def _sign_payload(private_key, payload: bytes) -> bytes:
+    from fabric_tpu.crypto import lite_ec, lite_hashes
+    if isinstance(private_key, lite_ec.EllipticCurvePrivateKey):
+        return private_key.sign(payload, lite_ec.ECDSA(lite_hashes.SHA256()))
+    return private_key.sign(payload)
+
+
+def _verify_payload(public_key, signature: bytes, payload: bytes) -> None:
+    from fabric_tpu.crypto import lite_ec, lite_hashes
+    if isinstance(public_key, lite_ec.EllipticCurvePublicKey):
+        public_key.verify(signature, payload,
+                          lite_ec.ECDSA(lite_hashes.SHA256()))
+    else:
+        public_key.verify(signature, payload)
+
+
+class Certificate:
+    def __init__(self, der: bytes):
+        outer = serde.decode(der)
+        self._der = bytes(der)
+        self._tbs = outer["tbs"]
+        self._sig = outer["sig"]
+        self._sig_scheme = outer["sig_scheme"]
+        t = serde.decode(self._tbs)
+        self.subject = Name._from_wire(t["subject"])
+        self.issuer = Name._from_wire(t["issuer"])
+        self.serial_number = t["serial"]
+        self._nbf = t["nbf"]
+        self._naf = t["naf"]
+        self._scheme = t["scheme"]
+        self._pub = t["pub"]
+        exts = []
+        if t["bc"] is not None:
+            ca, pl = t["bc"]
+            exts.append(Extension(BasicConstraints(ca, pl), critical=True))
+        if t["ku"] is not None:
+            exts.append(Extension(
+                KeyUsage(**dict(zip(KeyUsage._FIELDS, t["ku"]))),
+                critical=True))
+        self.extensions = Extensions(exts)
+
+    @property
+    def not_valid_before_utc(self) -> datetime.datetime:
+        return _dt(self._nbf)
+
+    @property
+    def not_valid_after_utc(self) -> datetime.datetime:
+        return _dt(self._naf)
+
+    # naive variants for older-cryptography-style callers
+    @property
+    def not_valid_before(self) -> datetime.datetime:
+        return _dt(self._nbf).replace(tzinfo=None)
+
+    @property
+    def not_valid_after(self) -> datetime.datetime:
+        return _dt(self._naf).replace(tzinfo=None)
+
+    def public_key(self):
+        return _public_from_wire(self._scheme, self._pub)
+
+    def public_bytes(self, encoding=_ser.Encoding.PEM) -> bytes:
+        if encoding == _ser.Encoding.DER:
+            return self._der
+        return _pem.armor(CERT_LABEL, self._der)
+
+    def verify_directly_issued_by(self, issuer_cert: "Certificate") -> None:
+        if self.issuer != issuer_cert.subject:
+            raise ValueError("issuer name does not match candidate subject")
+        try:
+            _verify_payload(issuer_cert.public_key(), self._sig, self._tbs)
+        except InvalidSignature:
+            raise
+        except Exception as exc:
+            raise InvalidSignature(str(exc)) from exc
+
+    def __eq__(self, other):
+        return isinstance(other, Certificate) and self._der == other._der
+
+    def __hash__(self):
+        return hash(self._der)
+
+
+class CertificateBuilder:
+    def __init__(self):
+        self._subject = None
+        self._issuer = None
+        self._pub = None
+        self._serial = None
+        self._nbf = None
+        self._naf = None
+        self._bc = None
+        self._ku = None
+
+    def subject_name(self, name: Name) -> "CertificateBuilder":
+        self._subject = name
+        return self
+
+    def issuer_name(self, name: Name) -> "CertificateBuilder":
+        self._issuer = name
+        return self
+
+    def public_key(self, key) -> "CertificateBuilder":
+        self._pub = key
+        return self
+
+    def serial_number(self, sn: int) -> "CertificateBuilder":
+        self._serial = sn
+        return self
+
+    def not_valid_before(self, dt: datetime.datetime) -> "CertificateBuilder":
+        self._nbf = _ts(dt)
+        return self
+
+    def not_valid_after(self, dt: datetime.datetime) -> "CertificateBuilder":
+        self._naf = _ts(dt)
+        return self
+
+    def add_extension(self, ext, critical: bool) -> "CertificateBuilder":
+        if isinstance(ext, BasicConstraints):
+            self._bc = ext
+        elif isinstance(ext, KeyUsage):
+            self._ku = ext
+        else:
+            raise ValueError("unsupported extension type")
+        return self
+
+    def sign(self, private_key, algorithm, backend=None) -> Certificate:
+        if None in (self._subject, self._issuer, self._pub,
+                    self._serial, self._nbf, self._naf):
+            raise ValueError("certificate builder is missing fields")
+        scheme, wire = _key_scheme_and_wire(self._pub)
+        tbs = serde.encode({
+            "v": 1,
+            "subject": self._subject._wire(),
+            "issuer": self._issuer._wire(),
+            "serial": self._serial,
+            "nbf": int(self._nbf),
+            "naf": int(self._naf),
+            "scheme": scheme,
+            "pub": wire,
+            "bc": ([self._bc.ca, self._bc.path_length]
+                   if self._bc is not None else None),
+            "ku": ([bool(getattr(self._ku, f)) for f in KeyUsage._FIELDS]
+                   if self._ku is not None else None),
+        })
+        signer_scheme = ("p256" if hasattr(private_key, "curve")
+                         else "ed25519")
+        sig = _sign_payload(private_key, tbs)
+        return Certificate(serde.encode(
+            {"tbs": tbs, "sig": sig, "sig_scheme": signer_scheme}))
+
+
+def load_pem_x509_certificate(data: bytes, backend=None) -> Certificate:
+    return Certificate(_pem.dearmor(data, CERT_LABEL))
+
+
+def load_der_x509_certificate(data: bytes, backend=None) -> Certificate:
+    return Certificate(bytes(data))
+
+
+# ---------------------------------------------------------------------------
+# CRLs
+
+class RevokedCertificate:
+    def __init__(self, serial_number: int, revocation_date_ts: float):
+        self.serial_number = serial_number
+        self.revocation_date_utc = _dt(revocation_date_ts)
+
+
+class RevokedCertificateBuilder:
+    def __init__(self):
+        self._serial = None
+        self._date = None
+
+    def serial_number(self, sn: int) -> "RevokedCertificateBuilder":
+        self._serial = sn
+        return self
+
+    def revocation_date(self, dt) -> "RevokedCertificateBuilder":
+        self._date = _ts(dt)
+        return self
+
+    def build(self, backend=None) -> RevokedCertificate:
+        if self._serial is None:
+            raise ValueError("revoked certificate needs a serial number")
+        return RevokedCertificate(self._serial, self._date or 0.0)
+
+
+class CertificateRevocationList:
+    def __init__(self, der: bytes):
+        d = serde.decode(der)
+        self._der = bytes(der)
+        self.issuer = Name._from_wire(d["issuer"])
+        self._revoked = [RevokedCertificate(sn, ts)
+                         for sn, ts in d["revoked"]]
+
+    def public_bytes(self, encoding=_ser.Encoding.PEM) -> bytes:
+        if encoding == _ser.Encoding.DER:
+            return self._der
+        return _pem.armor(CRL_LABEL, self._der)
+
+    def __iter__(self):
+        return iter(self._revoked)
+
+    def __len__(self):
+        return len(self._revoked)
+
+
+class CertificateRevocationListBuilder:
+    def __init__(self):
+        self._issuer = None
+        self._last = None
+        self._next = None
+        self._revoked: List[RevokedCertificate] = []
+
+    def issuer_name(self, name: Name) -> "CertificateRevocationListBuilder":
+        self._issuer = name
+        return self
+
+    def last_update(self, dt) -> "CertificateRevocationListBuilder":
+        self._last = _ts(dt)
+        return self
+
+    def next_update(self, dt) -> "CertificateRevocationListBuilder":
+        self._next = _ts(dt)
+        return self
+
+    def add_revoked_certificate(
+            self, rc: RevokedCertificate
+    ) -> "CertificateRevocationListBuilder":
+        self._revoked.append(rc)
+        return self
+
+    def sign(self, private_key, algorithm,
+             backend=None) -> CertificateRevocationList:
+        if self._issuer is None:
+            raise ValueError("CRL builder needs an issuer name")
+        return CertificateRevocationList(serde.encode({
+            "issuer": self._issuer._wire(),
+            "last": int(self._last or 0),
+            "next": int(self._next or 0),
+            "revoked": [[rc.serial_number,
+                         int(rc.revocation_date_utc.timestamp())]
+                        for rc in self._revoked],
+        }))
+
+
+def load_pem_x509_crl(data: bytes, backend=None) -> CertificateRevocationList:
+    return CertificateRevocationList(_pem.dearmor(data, CRL_LABEL))

@@ -1,0 +1,135 @@
+"""Batched orderer broadcast with backoff + failover.
+
+The transmit half of the gateway: coalesced envelope batches go to one
+orderer as a single `broadcast_batch` RPC; connection failures and
+SERVICE_UNAVAILABLE responses (no raft leader, halted chain) rotate to
+the next orderer under capped exponential backoff — the same policy
+the deliver plane uses in gossip/blocksprovider.py (failures counter,
+min(max, base * 2^failures)).  Per-envelope outcomes come back
+independently: a 4xx (bad envelope, unknown channel, filter veto) is
+final for that envelope only, while 503s requeue for the next attempt
+until the deadline lapses.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional, Sequence, Tuple
+
+from fabric_tpu.comm import connect
+
+logger = logging.getLogger("fabric_tpu.gateway")
+
+
+class BatchBroadcaster:
+    def __init__(self, orderers: Sequence[Tuple[str, int]], signer, msps,
+                 backoff_base_s: float = 0.05, backoff_max_s: float = 2.0,
+                 deadline_s: float = 10.0, rpc_timeout_s: float = 10.0):
+        if not orderers:
+            raise ValueError("gateway needs at least one orderer")
+        self.orderers = [tuple(o) for o in orderers]
+        self.signer = signer
+        self.msps = msps
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.deadline_s = deadline_s
+        self.rpc_timeout_s = rpc_timeout_s
+        self._lock = threading.Lock()
+        self._idx = 0          # current orderer (sticky while healthy)
+        self._conn = None
+        self._failures = 0
+
+    # connection management --------------------------------------------
+
+    def _backoff(self) -> float:
+        return min(self.backoff_max_s,
+                   self.backoff_base_s * (2 ** min(self._failures, 16)))
+
+    def _connection(self):
+        with self._lock:
+            if self._conn is not None:
+                return self._conn
+            addr = self.orderers[self._idx % len(self.orderers)]
+            self._conn = connect(addr, self.signer, self.msps,
+                                 timeout=min(self.rpc_timeout_s, 5.0))
+            return self._conn
+
+    def _rotate(self, reason: str) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = None
+            self._idx = (self._idx + 1) % len(self.orderers)
+            self._failures += 1
+        try:
+            from fabric_tpu.ops_plane import registry
+            registry.counter(
+                "gateway_broadcast_retries_total",
+                "orderer broadcast attempts that failed over").add(
+                    1, reason=reason)
+        except Exception:
+            pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = None
+
+    # broadcast ---------------------------------------------------------
+
+    def broadcast_batch(
+            self, envs: Sequence,
+            deadline_s: Optional[float] = None) -> List[Tuple[int, str]]:
+        """Send every envelope, retrying transient failures across the
+        orderer set; returns one (status, info) per envelope in order."""
+        results: List[Optional[Tuple[int, str]]] = [None] * len(envs)
+        pending = list(enumerate(envs))
+        deadline = time.monotonic() + (deadline_s if deadline_s is not None
+                                       else self.deadline_s)
+        while pending:
+            try:
+                conn = self._connection()
+                out = conn.call(
+                    "broadcast_batch",
+                    {"envelopes": [e.serialize() for _, e in pending]},
+                    timeout=self.rpc_timeout_s)
+                statuses = [int(s) for s in out["statuses"]]
+                infos = [str(s) for s in out.get(
+                    "infos", [""] * len(statuses))]
+            except Exception as exc:
+                logger.debug("broadcast to orderer failed: %s", exc)
+                self._rotate("connection")
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(self._backoff())
+                continue
+            retry = []
+            for (i, env), st, info in zip(pending, statuses, infos):
+                if st == 503:
+                    retry.append((i, env))
+                    results[i] = (st, info)   # stands if the deadline hits
+                else:
+                    results[i] = (st, info)
+            if not retry:
+                with self._lock:
+                    self._failures = 0
+                break
+            pending = retry
+            self._rotate("unavailable")
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(self._backoff())
+        for i, _ in pending:
+            if results[i] is None:
+                results[i] = (503, "broadcast deadline exceeded")
+        return [r if r is not None else (503, "not attempted")
+                for r in results]

@@ -1,0 +1,189 @@
+"""GatewayClient: the thin client SDK over the gateway verbs.
+
+What the Fabric v2.4 client libraries (fabric-gateway) became once the
+gateway absorbed the transaction lifecycle: the client builds and signs
+the proposal and the final envelope (signing NEVER delegates to the
+gateway — the peer must not hold client keys), while endorsement
+fan-out, ordering, retry, and commit tracking all happen server-side.
+
+    gw = GatewayClient(("127.0.0.1", 7051), signer, msps, channel_id="ch")
+    value = gw.evaluate("assets", "read", [b"a1"])
+    code, block = gw.submit_transaction("assets", "create",
+                                        [b"a1", b"owner", b"100"])
+    gw.close()
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional, Sequence, Tuple
+
+from fabric_tpu.comm import RpcError, connect
+from fabric_tpu.endorser.proposal import (
+    ProposalResponse,
+    SignedProposal,
+    assemble_transaction,
+    signed_proposal,
+)
+from fabric_tpu.protocol import Endorsement, Envelope
+from fabric_tpu.protocol.txflags import ValidationCode
+
+logger = logging.getLogger("fabric_tpu.gateway")
+
+
+class GatewayError(Exception):
+    """A gateway verb failed (endorsement, ordering, or commit)."""
+
+    def __init__(self, message: str, status: int = 0):
+        super().__init__(message)
+        self.status = status
+
+
+class GatewayClient:
+    """Client handle onto one peer's gateway service.
+
+    Thread-safe: concurrent submit_transaction calls share the single
+    authenticated connection (the RPC plane multiplexes by request id,
+    but calls here serialize on a lock for the blocking-reply pattern).
+    """
+
+    def __init__(self, peer_addr: Tuple[str, int], signer, msps,
+                 channel_id: Optional[str] = None,
+                 timeout: float = 5.0):
+        self.peer_addr = tuple(peer_addr)
+        self.signer = signer
+        self.msps = msps
+        self.channel_id = channel_id
+        self._timeout = timeout
+        self._lock = threading.Lock()
+        self._conn = None
+
+    # plumbing ----------------------------------------------------------
+
+    def _call(self, verb: str, body: dict, timeout: float = 30.0) -> dict:
+        with self._lock:
+            if self._conn is None:
+                self._conn = connect(self.peer_addr, self.signer, self.msps,
+                                     timeout=self._timeout)
+            try:
+                return self._conn.call(verb, body, timeout=timeout)
+            except RpcError:
+                raise
+            except Exception:
+                # connection damaged: drop it so the next call redials
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = None
+                raise
+
+    def _channel(self, channel: Optional[str]) -> str:
+        ch = channel or self.channel_id
+        if not ch:
+            raise GatewayError("no channel: pass channel= or set channel_id")
+        return ch
+
+    def close(self) -> None:
+        with self._lock:
+            if self._conn is not None:
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = None
+
+    # verbs -------------------------------------------------------------
+
+    def evaluate(self, chaincode_id: str, fn: str, args: Sequence[bytes],
+                 channel: Optional[str] = None) -> bytes:
+        """Query: endorse on the gateway peer only, return the payload."""
+        ch = self._channel(channel)
+        sp = signed_proposal(ch, chaincode_id, fn, args, self.signer)
+        out = self._call("gateway.evaluate",
+                         {"channel": ch, "proposal": sp.proposal_bytes,
+                          "signature": sp.signature})
+        if out.get("status") != 200:
+            raise GatewayError(
+                f"evaluate failed: {out.get('message', '')}",
+                status=int(out.get("status", 0)))
+        return out["payload"]
+
+    def endorse(self, chaincode_id: str, fn: str, args: Sequence[bytes],
+                channel: Optional[str] = None
+                ) -> Tuple[SignedProposal, List[ProposalResponse]]:
+        """Collect endorsements via the gateway; returns the signed
+        proposal plus responses ready for assemble_transaction."""
+        ch = self._channel(channel)
+        sp = signed_proposal(ch, chaincode_id, fn, args, self.signer)
+        out = self._call("gateway.endorse",
+                         {"channel": ch, "proposal": sp.proposal_bytes,
+                          "signature": sp.signature})
+        if out.get("status") != 200 or not out.get("endorsements"):
+            raise GatewayError(
+                f"endorse failed: {out.get('message', '')}",
+                status=int(out.get("status", 0)))
+        responses = [
+            ProposalResponse(200, "", out["payload"],
+                             Endorsement(e["endorser"], e["signature"]))
+            for e in out["endorsements"]]
+        return sp, responses
+
+    def submit_envelope(self, env: Envelope,
+                        timeout_s: Optional[float] = None) -> dict:
+        """Hand an assembled envelope to the gateway's admission queue;
+        returns {"txid", "status", "info", "deduped"} once ordered."""
+        body = {"envelope": env.serialize()}
+        if timeout_s is not None:
+            # serde is float-free by design: timeouts ride as int ms
+            body["timeout_ms"] = int(timeout_s * 1000)
+        out = self._call("gateway.submit", body,
+                         timeout=(timeout_s or 20.0) + 10.0)
+        if out.get("status") != 200:
+            raise GatewayError(
+                f"submit failed ({out.get('status')}): "
+                f"{out.get('info', '')}", status=int(out.get("status", 0)))
+        return out
+
+    def commit_status(self, txid: str, channel: Optional[str] = None,
+                      timeout_s: float = 15.0) -> Tuple[int, int]:
+        """Block until the txid commits; returns (validation code, block
+        number).  Raises GatewayError if the wait times out."""
+        ch = self._channel(channel)
+        out = self._call("gateway.commit_status",
+                         {"channel": ch, "txid": txid,
+                          "timeout_ms": int(timeout_s * 1000)},
+                         timeout=timeout_s + 10.0)
+        if not out.get("found"):
+            raise GatewayError(f"txid {txid} not committed within "
+                               f"{timeout_s}s")
+        return int(out["code"]), int(out["block"])
+
+    # the full lifecycle -------------------------------------------------
+
+    def submit_transaction(self, chaincode_id: str, fn: str,
+                           args: Sequence[bytes],
+                           channel: Optional[str] = None,
+                           commit_timeout_s: float = 15.0
+                           ) -> Tuple[int, int]:
+        """endorse -> assemble -> submit -> wait for commit.
+
+        Returns (validation code, block number); raises GatewayError if
+        the tx commits with a non-VALID code.
+        """
+        ch = self._channel(channel)
+        sp, responses = self.endorse(chaincode_id, fn, args, channel=ch)
+        env = assemble_transaction(sp, responses, self.signer)
+        txid = env.header().channel_header.txid
+        self.submit_envelope(env)
+        code, block = self.commit_status(txid, channel=ch,
+                                         timeout_s=commit_timeout_s)
+        if code != int(ValidationCode.VALID):
+            try:
+                name = ValidationCode(code).name
+            except ValueError:
+                name = str(code)
+            raise GatewayError(
+                f"tx {txid} invalidated at commit: {name}", status=code)
+        return code, block

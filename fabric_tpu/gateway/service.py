@@ -1,0 +1,311 @@
+"""GatewayService: peer-hosted client verbs + bounded admission queue.
+
+The receive half of the gateway.  Submissions land in a bounded queue
+(full queue -> immediate backpressure error, never unbounded buffering)
+and a single batcher thread coalesces them — up to `max_batch`
+envelopes or `linger_s` of accumulation — into one orderer
+`broadcast_batch` call, sized to feed the TPU verify lane with big
+blocks instead of trickling singleton envelopes at the consenter.
+A txid dedup window makes submission idempotent: a duplicate of an
+in-flight txid attaches to the existing entry, a duplicate of a
+recently-finished one replays its recorded outcome.
+
+Every verb records per-verb latency; the queue depth gauge, batch-size
+histogram, retry/dedup/backpressure counters land in the same
+ops_plane registry the /metrics endpoint exposes.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import OrderedDict
+from typing import Dict, List, Optional
+
+from fabric_tpu.comm import connect
+from fabric_tpu.endorser.proposal import SignedProposal
+from fabric_tpu.gateway.broadcaster import BatchBroadcaster
+from fabric_tpu.gateway.notifier import CommitNotifier
+from fabric_tpu.ops_plane import registry
+from fabric_tpu.protocol import Envelope
+from fabric_tpu.protocol.txflags import ValidationCode
+
+logger = logging.getLogger("fabric_tpu.gateway")
+
+
+class _Pending:
+    __slots__ = ("env", "txid", "event", "status", "info")
+
+    def __init__(self, env: Envelope, txid: str):
+        self.env = env
+        self.txid = txid
+        self.event = threading.Event()
+        self.status = 0
+        self.info = ""
+
+
+class GatewayService:
+    """Hosts the four gateway verbs on a PeerNode's RPC server."""
+
+    def __init__(self, node, cfg: Optional[dict] = None):
+        cfg = dict(cfg or {})
+        self.node = node
+        self.max_queue = int(cfg.get("max_queue", 256))
+        self.max_batch = int(cfg.get("max_batch", 64))
+        self.linger_s = float(cfg.get("linger_s", 0.005))
+        self.recent_window = int(cfg.get("dedup_window", 8192))
+        self.submit_timeout_s = float(cfg.get("submit_timeout_s", 20.0))
+        self.broadcaster = BatchBroadcaster(
+            node.orderers, node.signer, node.msps,
+            backoff_base_s=float(cfg.get("backoff_base_s", 0.05)),
+            backoff_max_s=float(cfg.get("backoff_max_s", 2.0)),
+            deadline_s=float(cfg.get("broadcast_deadline_s", 10.0)))
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._queue: List[_Pending] = []
+        self._inflight: Dict[str, _Pending] = {}
+        # txid -> (status, info) of finished submissions (dedup window)
+        self._recent: "OrderedDict[str, tuple]" = OrderedDict()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._batch_loop, name="gateway-batcher", daemon=True)
+        # metrics (ops_plane singleton registry -> /metrics exposition)
+        self._m_latency = registry.histogram(
+            "gateway_request_duration_seconds", "gateway verb latency")
+        self._m_requests = registry.counter(
+            "gateway_requests_total", "gateway verb calls")
+        self._m_depth = registry.gauge(
+            "gateway_queue_depth", "admission queue occupancy")
+        self._m_batch = registry.histogram(
+            "gateway_batch_size", "envelopes per orderer broadcast",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128, float("inf")))
+        self._m_dedup = registry.counter(
+            "gateway_dedup_total", "duplicate txid submissions absorbed")
+        self._m_backpressure = registry.counter(
+            "gateway_backpressure_total",
+            "submissions rejected on a full admission queue")
+        # commit notifiers attach per channel as channels are touched
+        for ch in getattr(node, "channels", {}).values():
+            self._notifier(ch)
+
+    # lifecycle ---------------------------------------------------------
+
+    def register(self, rpc) -> None:
+        rpc.serve("gateway.evaluate", self._rpc_evaluate)
+        rpc.serve("gateway.endorse", self._rpc_endorse)
+        rpc.serve("gateway.submit", self._rpc_submit)
+        rpc.serve("gateway.commit_status", self._rpc_commit_status)
+
+    def start(self) -> None:
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        with self._cv:
+            self._cv.notify_all()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2.0)
+        self.broadcaster.close()
+
+    # helpers -----------------------------------------------------------
+
+    def _notifier(self, ch) -> CommitNotifier:
+        with self._lock:
+            n = getattr(ch, "commit_notifier", None)
+            if n is None:
+                n = CommitNotifier(ch.channel_id)
+                ch.committer.add_commit_listener(n.on_block)
+                ch.commit_notifier = n
+            return n
+
+    def _observe(self, verb: str, t0: float) -> None:
+        try:
+            self._m_requests.add(1, verb=verb)
+            self._m_latency.observe(time.monotonic() - t0, verb=verb)
+        except Exception:
+            pass
+
+    # verbs -------------------------------------------------------------
+
+    def _rpc_evaluate(self, body: dict, peer_identity) -> dict:
+        """Endorse-only: simulate on this peer and hand the result back;
+        nothing reaches the orderer (read path / queries)."""
+        t0 = time.monotonic()
+        try:
+            ch = self.node._chan(body)
+            sp = SignedProposal(body["proposal"], body["signature"])
+            resp = ch.endorser.process_proposal(sp)
+            return {"status": resp.status, "message": resp.message,
+                    "payload": resp.payload}
+        finally:
+            self._observe("evaluate", t0)
+
+    def _rpc_endorse(self, body: dict, peer_identity) -> dict:
+        """Collect endorsements: this peer first, then the org peers it
+        is configured with, so a client reaches every org through ONE
+        gateway round trip (gateway/endorse.go's plan execution)."""
+        t0 = time.monotonic()
+        try:
+            ch = self.node._chan(body)
+            sp = SignedProposal(body["proposal"], body["signature"])
+            resp = ch.endorser.process_proposal(sp)
+            if resp.status != 200 or resp.endorsement is None:
+                return {"status": resp.status, "message": resp.message,
+                        "payload": resp.payload, "endorsements": []}
+            endorsements = [{"endorser": resp.endorsement.endorser,
+                             "signature": resp.endorsement.signature}]
+            errors = []
+            fan_body = {"proposal": body["proposal"],
+                        "signature": body["signature"],
+                        "channel": ch.channel_id}
+            for addr in self.node.peers:
+                try:
+                    conn = connect(tuple(addr[:2]), self.node.signer,
+                                   ch.msps, timeout=3.0)
+                    try:
+                        out = conn.call("endorse", fan_body, timeout=10.0)
+                    finally:
+                        conn.close()
+                except Exception as exc:
+                    errors.append(f"{addr[0]}:{addr[1]}: {exc}")
+                    continue
+                if out.get("status") != 200:
+                    errors.append(f"{addr[0]}:{addr[1]}: "
+                                  f"{out.get('message', 'endorse failed')}")
+                elif out.get("payload") != resp.payload:
+                    errors.append(f"{addr[0]}:{addr[1]}: divergent "
+                                  "simulation payload")
+                else:
+                    endorsements.append({
+                        "endorser": out["endorser"],
+                        "signature": out["endorsement_sig"]})
+            return {"status": 200, "message": "; ".join(errors),
+                    "payload": resp.payload, "endorsements": endorsements}
+        finally:
+            self._observe("endorse", t0)
+
+    def _rpc_submit(self, body: dict, peer_identity) -> dict:
+        """Admit an assembled envelope; blocks until its batch clears the
+        orderer (or the submit timeout lapses with it still queued)."""
+        t0 = time.monotonic()
+        try:
+            env = Envelope.deserialize(body["envelope"])
+            header = env.header().channel_header
+            txid = header.txid
+            if not txid:
+                raise ValueError("envelope has no txid")
+            ch = self.node.channels.get(header.channel_id)
+            if ch is not None:
+                self._notifier(ch)   # attach before ordering can commit it
+            with self._cv:
+                pending = self._inflight.get(txid)
+                deduped = pending is not None
+                if pending is None and txid in self._recent:
+                    st, info = self._recent[txid]
+                    self._m_dedup.add(1)
+                    return {"txid": txid, "status": st, "info": info,
+                            "deduped": True}
+                if pending is None:
+                    if len(self._queue) >= self.max_queue:
+                        self._m_backpressure.add(1)
+                        raise RuntimeError(
+                            "gateway admission queue full "
+                            f"({self.max_queue}): backpressure, retry later")
+                    pending = _Pending(env, txid)
+                    self._inflight[txid] = pending
+                    self._queue.append(pending)
+                    self._m_depth.set(len(self._queue))
+                    self._cv.notify()
+            if deduped:
+                self._m_dedup.add(1)
+            if "timeout_ms" in body:
+                timeout = min(int(body["timeout_ms"]) / 1000.0, 120.0)
+            else:
+                timeout = self.submit_timeout_s
+            if not pending.event.wait(timeout):
+                return {"txid": txid, "status": 0,
+                        "info": "submit still in flight (timeout waiting "
+                                "for orderer ack)", "deduped": deduped}
+            return {"txid": txid, "status": pending.status,
+                    "info": pending.info, "deduped": deduped}
+        finally:
+            self._observe("submit", t0)
+
+    def _rpc_commit_status(self, body: dict, peer_identity) -> dict:
+        """Block until the committer records the txid's validation code
+        (VALID / MVCC_READ_CONFLICT / ...), no ledger polling."""
+        t0 = time.monotonic()
+        try:
+            ch = self.node._chan(body)
+            txid = str(body["txid"])
+            timeout = min(int(body.get("timeout_ms", 15000)) / 1000.0, 120.0)
+            notifier = self._notifier(ch)
+            got = notifier.peek(txid)
+            if got is None:
+                # committed before this gateway attached its notifier
+                # (or long ago): the block store is authoritative
+                try:
+                    if ch.ledger.blockstore.has_txid(txid):
+                        code = ch.ledger.blockstore.get_tx_validation_code(
+                            txid)
+                        got = (int(code), -1)
+                except Exception:
+                    got = None
+            if got is None:
+                got = notifier.wait(txid, timeout)
+            if got is None:
+                return {"found": False, "txid": txid}
+            code, block_num = got
+            try:
+                name = ValidationCode(code).name
+            except ValueError:
+                name = str(code)
+            return {"found": True, "txid": txid, "code": int(code),
+                    "code_name": name, "block": block_num}
+        finally:
+            self._observe("commit_status", t0)
+
+    # batcher -----------------------------------------------------------
+
+    def _drain(self) -> List[_Pending]:
+        with self._cv:
+            while not self._queue and not self._stop.is_set():
+                self._cv.wait(0.2)
+            if self._stop.is_set() and not self._queue:
+                return []
+        # linger briefly so concurrent submitters coalesce into one
+        # orderer call (the admission layer's whole point)
+        if self.linger_s > 0:
+            time.sleep(self.linger_s)
+        with self._cv:
+            batch = self._queue[:self.max_batch]
+            del self._queue[:len(batch)]
+            self._m_depth.set(len(self._queue))
+            return batch
+
+    def _batch_loop(self) -> None:
+        while not self._stop.is_set():
+            batch = self._drain()
+            if not batch:
+                continue
+            try:
+                self._m_batch.observe(len(batch))
+            except Exception:
+                pass
+            try:
+                results = self.broadcaster.broadcast_batch(
+                    [p.env for p in batch])
+            except Exception as exc:
+                logger.exception("broadcast batch failed")
+                results = [(500, f"gateway broadcast error: {exc}")] \
+                    * len(batch)
+            with self._cv:
+                for p, (st, info) in zip(batch, results):
+                    p.status, p.info = int(st), str(info)
+                    self._inflight.pop(p.txid, None)
+                    self._recent[p.txid] = (p.status, p.info)
+                while len(self._recent) > self.recent_window:
+                    self._recent.popitem(last=False)
+            for p in batch:
+                p.event.set()

@@ -75,7 +75,7 @@ class RevocationAuthority:
     """Issues epoch records and per-handle weak-BB signatures."""
 
     def __init__(self):
-        from cryptography.hazmat.primitives.asymmetric import ec
+        from fabric_tpu.crypto import ec
         self._lt_key = ec.generate_private_key(ec.SECP256R1())
         self._epochs: Dict[int, int] = {}       # epoch -> x_e
         self.revoked: Set[int] = set()
@@ -83,14 +83,14 @@ class RevocationAuthority:
     # -- long-term key -------------------------------------------------------
 
     def public_key_pem(self) -> bytes:
-        from cryptography.hazmat.primitives import serialization
+        from fabric_tpu.crypto import serialization
         return self._lt_key.public_key().public_bytes(
             serialization.Encoding.PEM,
             serialization.PublicFormat.SubjectPublicKeyInfo)
 
     def _sign(self, body: bytes) -> bytes:
-        from cryptography.hazmat.primitives import hashes
-        from cryptography.hazmat.primitives.asymmetric import ec
+        from fabric_tpu.crypto import hashes
+        from fabric_tpu.crypto import ec
         return self._lt_key.sign(body, ec.ECDSA(hashes.SHA256()))
 
     # -- epochs --------------------------------------------------------------
@@ -125,9 +125,9 @@ class RevocationAuthority:
 
 
 def verify_epoch_pk(epk: EpochPK, ra_public_key_pem: bytes) -> bool:
-    from cryptography.exceptions import InvalidSignature
-    from cryptography.hazmat.primitives import hashes, serialization
-    from cryptography.hazmat.primitives.asymmetric import ec
+    from fabric_tpu.crypto import InvalidSignature
+    from fabric_tpu.crypto import hashes, serialization
+    from fabric_tpu.crypto import ec
     try:
         pub = serialization.load_pem_public_key(ra_public_key_pem)
         pub.verify(epk.signature, epk.body(), ec.ECDSA(hashes.SHA256()))

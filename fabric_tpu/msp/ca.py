@@ -11,10 +11,10 @@ from __future__ import annotations
 import datetime
 from typing import List, Optional, Tuple
 
-from cryptography import x509
-from cryptography.hazmat.primitives import hashes, serialization
-from cryptography.hazmat.primitives.asymmetric import ec, ed25519
-from cryptography.x509.oid import NameOID
+from fabric_tpu.crypto import x509
+from fabric_tpu.crypto import hashes, serialization
+from fabric_tpu.crypto import ec, ed25519
+from fabric_tpu.crypto import NameOID
 
 from fabric_tpu.bccsp import SCHEME_P256, SCHEME_ED25519
 from fabric_tpu.bccsp.sw import SigningKey

@@ -13,8 +13,8 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import Optional
 
-from cryptography import x509
-from cryptography.hazmat.primitives import serialization
+from fabric_tpu.crypto import x509
+from fabric_tpu.crypto import serialization
 
 from fabric_tpu.bccsp import VerifyItem, SCHEME_P256, SCHEME_ED25519
 from fabric_tpu.bccsp.factory import get_default
@@ -22,7 +22,7 @@ from fabric_tpu.utils import serde
 
 
 def scheme_of_cert(cert: x509.Certificate) -> str:
-    from cryptography.hazmat.primitives.asymmetric import ec, ed25519
+    from fabric_tpu.crypto import ec, ed25519
     pub = cert.public_key()
     if isinstance(pub, ec.EllipticCurvePublicKey):
         if pub.curve.name != "secp256r1":
@@ -35,7 +35,7 @@ def scheme_of_cert(cert: x509.Certificate) -> str:
 
 def pubkey_wire_bytes(cert: x509.Certificate) -> bytes:
     """Provider wire format: SEC1 uncompressed (p256) or raw 32B (ed25519)."""
-    from cryptography.hazmat.primitives.asymmetric import ec
+    from fabric_tpu.crypto import ec
     pub = cert.public_key()
     if isinstance(pub, ec.EllipticCurvePublicKey):
         return pub.public_bytes(serialization.Encoding.X962,

@@ -19,8 +19,8 @@ import datetime
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Sequence
 
-from cryptography import x509
-from cryptography.x509.oid import NameOID
+from fabric_tpu.crypto import x509
+from fabric_tpu.crypto import NameOID
 
 from .identity import Identity
 

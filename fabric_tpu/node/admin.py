@@ -17,6 +17,15 @@ here touches node state directly.
             --name asset --version 1.0 --sequence 1 [--policy EXPR]
         chaincode commit  --peer ... --orderer ... (same flags)
         chaincode querycommitted --peer ... --channel ch --name asset
+        gateway evaluate --peer ... --channel ch --name asset \
+            --fn read --arg a1
+        gateway submit --peer ... --channel ch --name asset \
+            --fn create --arg a1 --arg alice --arg 100
+
+The gateway verbs go through the peer's gateway service
+(fabric_tpu/gateway): one peer connection drives the whole endorse ->
+order -> commit-status lifecycle instead of the client dialing every
+peer and orderer itself.
 
 `--msp-config` supplies the verification MSPs for the transport
 handshake: a node JSON (its channel_config_hex) or a serialized
@@ -165,6 +174,21 @@ def main(argv=None) -> int:
     q.add_argument("--channel", required=True)
     q.add_argument("--name", required=True)
 
+    gw = sub.add_parser("gateway").add_subparsers(dest="verb",
+                                                  required=True)
+    for name in ("evaluate", "submit"):
+        p = gw.add_parser(name)
+        p.add_argument("--peer", required=True,
+                       help="gateway peer addr (host:port)")
+        p.add_argument("--channel", required=True)
+        p.add_argument("--name", required=True, help="chaincode name")
+        p.add_argument("--fn", required=True)
+        p.add_argument("--arg", action="append", default=[],
+                       help="chaincode argument (repeatable)")
+        if name == "submit":
+            p.add_argument("--timeout", default="30",
+                           help="commit-status wait (seconds)")
+
     args = ap.parse_args(argv)
     signer = _load_client(args.client)
     msps = _load_msps(args.msp_config)
@@ -244,6 +268,28 @@ def main(argv=None) -> int:
         defn = {k: (v.hex() if isinstance(v, bytes) else v)
                 for k, v in defn.items()}
         print(json.dumps({"definition": defn}))
+    elif args.group == "gateway":
+        from fabric_tpu.gateway import GatewayClient, GatewayError
+        from fabric_tpu.utils import serde
+        gwc = GatewayClient(_addr(args.peer), signer, msps,
+                            channel_id=args.channel)
+        fnargs = [a.encode() for a in args.arg]
+        try:
+            if args.verb == "evaluate":
+                payload = gwc.evaluate(args.name, args.fn, fnargs)
+                resp = serde.decode(payload)["action"]["response_payload"]
+                print(json.dumps({
+                    "result": resp.decode("utf-8", "backslashreplace")}))
+            else:
+                code, block = gwc.submit_transaction(
+                    args.name, args.fn, fnargs,
+                    commit_timeout_s=float(args.timeout))
+                print(json.dumps({"status": "committed", "code": code,
+                                  "block": block}))
+        except GatewayError as exc:
+            raise SystemExit(f"gateway {args.verb} failed: {exc}")
+        finally:
+            gwc.close()
     return 0
 
 

@@ -39,11 +39,11 @@ logger = logging.getLogger("fabric_tpu.node.orderer")
 
 def load_signing_identity(mspid: str, cert_pem: bytes, key_pem: bytes,
                           scheme: str = None) -> SigningIdentity:
-    from cryptography import x509
-    from cryptography.hazmat.primitives import serialization
+    from fabric_tpu.crypto import x509
+    from fabric_tpu.crypto import serialization
     from fabric_tpu.bccsp.sw import SigningKey
 
-    from cryptography.hazmat.primitives.asymmetric import ec as _ec
+    from fabric_tpu.crypto import ec as _ec
     from fabric_tpu.bccsp import SCHEME_ED25519, SCHEME_P256
 
     cert = x509.load_pem_x509_certificate(cert_pem)
@@ -124,6 +124,7 @@ class OrdererNode:
         self.broadcast = BroadcastHandler(self.registrar)
         self.deliver = DeliverHandler(self.registrar)
         self.rpc.serve("broadcast", self._rpc_broadcast)
+        self.rpc.serve("broadcast_batch", self._rpc_broadcast_batch)
         self.rpc.serve("status", self._rpc_status)
         self.rpc.serve_stream("deliver", self._rpc_deliver)
         self.rpc.serve("participation.join", self._rpc_join)
@@ -309,6 +310,18 @@ class OrdererNode:
         resp = self.broadcast.handle(env)
         return {"status": resp.status, "info": resp.info or "",
                 "leader": getattr(resp, "leader_hint", 0) or 0}
+
+    def _rpc_broadcast_batch(self, body: dict, peer_identity) -> dict:
+        """Gateway fan-in: many envelopes per RPC round trip.  Each is
+        admitted independently; statuses/infos line up by index."""
+        envs = [Envelope.deserialize(e) for e in body["envelopes"]]
+        resps = self.broadcast.handle_batch(envs)
+        leader = 0
+        for r in resps:
+            leader = getattr(r, "leader_hint", 0) or leader
+        return {"statuses": [r.status for r in resps],
+                "infos": [r.info or "" for r in resps],
+                "leader": leader}
 
     def _rpc_deliver(self, body: dict, peer_identity):
         seek = SeekInfo(start=body.get("start", 0), stop=body.get("stop"),

@@ -73,6 +73,12 @@ def _asset_contract():
         stub.put_state(key.decode(), value)
         return b"created"
 
+    def read(stub, key):
+        v = stub.get_state(key.decode())
+        if v is None:
+            raise SimulationError("no such asset")
+        return v
+
     def transfer(stub, key, owner):
         v = stub.get_state(key.decode())
         if v is None:
@@ -85,7 +91,7 @@ def _asset_contract():
         stub.put_private_data(collection.decode(), key.decode(), value)
         return b"ok"
 
-    return FuncContract(create=create, transfer=transfer,
+    return FuncContract(create=create, read=read, transfer=transfer,
                         put_private=put_private)
 
 
@@ -531,6 +537,14 @@ class PeerNode:
         self.rpc.serve("privdata.fetch", self._rpc_privdata_fetch)
         self.rpc.serve_cast("privdata.push", self._rpc_privdata_push)
 
+        # gateway: the batched client front door (needs orderers to
+        # broadcast to; a peer with no orderer list serves peers only)
+        self.gateway = None
+        if self.orderers and cfg.get("gateway_enabled", True):
+            from fabric_tpu.gateway import GatewayService
+            self.gateway = GatewayService(self, cfg.get("gateway", {}))
+            self.gateway.register(self.rpc)
+
         self.ops = None
         if cfg.get("ops_port") is not None:
             from fabric_tpu.ops_plane import OperationsServer
@@ -866,6 +880,8 @@ class PeerNode:
         if self.ops is not None:
             self.ops.start()
         self._started = True
+        if self.gateway is not None:
+            self.gateway.start()
         for ch in self.channels.values():
             ch.start()
         logger.info("peer %s serving on %s (%d channels)", self.mspid,
@@ -874,6 +890,8 @@ class PeerNode:
 
     def stop(self) -> None:
         self._stop.set()
+        if self.gateway is not None:
+            self.gateway.stop()
         self.rpc.stop()
         if getattr(self, "cc_support", None) is not None:
             self.cc_support.stop()      # kills external chaincode processes

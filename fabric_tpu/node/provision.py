@@ -13,7 +13,7 @@ import json
 import os
 from typing import List, Tuple
 
-from cryptography.hazmat.primitives import serialization
+from fabric_tpu.crypto import serialization
 
 from fabric_tpu.config import BatchConfig, ChannelConfig, OrgConfig, default_policies
 from fabric_tpu.msp.ca import DevOrg

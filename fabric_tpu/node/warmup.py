@@ -24,11 +24,11 @@ def gen_p256_sigs(n: int, n_keys: int, seed: int = 2026):
     import hashlib
     import random
 
-    from cryptography.hazmat.primitives import hashes
-    from cryptography.hazmat.primitives.asymmetric import ec
-    from cryptography.hazmat.primitives.asymmetric.utils import (
+    from fabric_tpu.crypto import hashes
+    from fabric_tpu.crypto import ec
+    from fabric_tpu.crypto import (
         decode_dss_signature, encode_dss_signature)
-    from cryptography.hazmat.primitives.serialization import (
+    from fabric_tpu.crypto import (
         Encoding, PublicFormat)
 
     from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
@@ -55,9 +55,9 @@ def gen_p256_sigs(n: int, n_keys: int, seed: int = 2026):
 def gen_ed25519_sigs(n: int, n_keys: int = 4, seed: int = 7):
     import random
 
-    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    from fabric_tpu.crypto import (
         Ed25519PrivateKey)
-    from cryptography.hazmat.primitives.serialization import (
+    from fabric_tpu.crypto import (
         Encoding, PublicFormat)
 
     from fabric_tpu.bccsp import SCHEME_ED25519, VerifyItem

@@ -108,8 +108,9 @@ class Histogram:
                 for ub, c in zip(self.buckets, counts):
                     cum += c
                     le = "+Inf" if ub == float("inf") else repr(ub)
+                    le_label = 'le="%s"' % le
                     out.append(f"{self.name}_bucket"
-                               f"{_fmt_labels(k, f'le=\"{le}\"')} {cum}")
+                               f"{_fmt_labels(k, le_label)} {cum}")
                 out.append(f"{self.name}_sum{_fmt_labels(k)} {self._sum[k]}")
                 out.append(f"{self.name}_count{_fmt_labels(k)} {self._n[k]}")
         return out

@@ -11,7 +11,7 @@ way each stream iteration does.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Optional
+from typing import List, Optional, Sequence
 
 from fabric_tpu.orderer.consensus import ChainHaltedError
 from fabric_tpu.orderer.msgprocessor import MsgClass, MsgProcessorError
@@ -64,3 +64,12 @@ class BroadcastHandler:
         except ChainHaltedError as e:
             return BroadcastResponse(STATUS_UNAVAILABLE, str(e))
         return BroadcastResponse(STATUS_SUCCESS)
+
+    def handle_batch(
+            self, envs: Sequence[Envelope]) -> List[BroadcastResponse]:
+        """Ingest a coalesced batch in one call (the gateway's admission
+        queue ships these).  Envelopes are independent — each routes by
+        its own channel header and gets its own response, exactly as if
+        streamed one by one; the batching only amortizes the RPC round
+        trip and handshake-authenticated framing."""
+        return [self.handle(env) for env in envs]

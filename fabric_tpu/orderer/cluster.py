@@ -30,7 +30,7 @@ def cert_fingerprint(cert) -> str:
     against the consenter set, cluster/comm.go).
     """
     import hashlib
-    from cryptography.hazmat.primitives import serialization
+    from fabric_tpu.crypto import serialization
     der = cert.public_bytes(serialization.Encoding.DER)
     return hashlib.sha256(der).hexdigest()
 

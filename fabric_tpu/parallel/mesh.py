@@ -20,6 +20,11 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as PSpec
 
+try:                                      # jax >= 0.4.x moved it to top level
+    _shard_map = jax.shard_map
+except AttributeError:                    # 0.4.37 ships it under experimental
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from fabric_tpu.ops import p256, ed25519
 
 BATCH_AXIS = "batch"
@@ -63,7 +68,7 @@ def sharded_p256_verify(mesh: Mesh, require_low_s: bool = True):
         count = jax.lax.psum(jnp.sum(v.astype(jnp.int32)), BATCH_AXIS)
         return v, count
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(spec_in,) * 5,
         out_specs=(PSpec(BATCH_AXIS), PSpec()))
@@ -90,7 +95,7 @@ def sharded_p256_rows_verify(mesh: Mesh, require_low_s: bool = True):
         count = jax.lax.psum(jnp.sum(v.astype(jnp.int32)), BATCH_AXIS)
         return v, count
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(bank_spec, row_spec, word_spec, word_spec, word_spec),
         out_specs=(PSpec(BATCH_AXIS), PSpec()))
@@ -113,7 +118,7 @@ def sharded_ed25519_rows_verify(mesh: Mesh):
         count = jax.lax.psum(jnp.sum(v.astype(jnp.int32)), BATCH_AXIS)
         return v, count
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(bank_spec, row_spec, word_spec, sign_spec, word_spec,
                   word_spec),
@@ -134,7 +139,7 @@ def sharded_ed25519_verify(mesh: Mesh):
         count = jax.lax.psum(jnp.sum(v.astype(jnp.int32)), BATCH_AXIS)
         return v, count
 
-    fn = jax.shard_map(
+    fn = _shard_map(
         local, mesh=mesh,
         in_specs=(word_spec, bit_spec, word_spec, bit_spec, word_spec, word_spec),
         out_specs=(PSpec(BATCH_AXIS), PSpec()))

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Fast gate for CI and pre-commit: collection must be CLEAN (a single
+# collection error silently masks an entire test module, which is how
+# the seed shipped with 29 uncollectable modules), then the non-slow
+# subset must pass.
+#
+#   bash tests/smoke.sh            # collection check + non-slow subset
+#   bash tests/smoke.sh --collect  # collection check only (seconds)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+unset PALLAS_AXON_POOL_IPS 2>/dev/null || true
+
+echo "== pytest collection (must be error-free) =="
+collect_out=$(python -m pytest tests/ -q --collect-only -p no:cacheprovider 2>&1 | tail -5)
+echo "$collect_out"
+if echo "$collect_out" | grep -qiE "error"; then
+    echo "FAIL: test collection has errors" >&2
+    exit 1
+fi
+
+if [[ "${1:-}" == "--collect" ]]; then
+    echo "OK: collection clean"
+    exit 0
+fi
+
+echo "== non-slow test subset =="
+python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
+echo "OK: smoke passed"

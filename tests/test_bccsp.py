@@ -74,7 +74,7 @@ def test_malformed_items_are_false_not_fatal(sw, tpu):
 
 
 def test_high_s_rejected_by_both(sw, tpu):
-    from cryptography.hazmat.primitives.asymmetric.utils import (
+    from fabric_tpu.crypto import (
         decode_dss_signature, encode_dss_signature)
     from fabric_tpu.bccsp.sw import P256_N
     k = sw.key_gen(SCHEME_P256)

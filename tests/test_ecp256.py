@@ -20,9 +20,9 @@ from fabric_tpu.ops import ecp256 as ec
 from fabric_tpu.ops import flatfield as ff
 from fabric_tpu.ops import p256
 
-from cryptography.hazmat.primitives.asymmetric import ec as cec
-from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
-from cryptography.hazmat.primitives import hashes
+from fabric_tpu.crypto import ec as cec
+from fabric_tpu.crypto import decode_dss_signature
+from fabric_tpu.crypto import hashes
 
 
 def to_l(vals):
@@ -193,7 +193,7 @@ def test_fixed_path_matches_generic(cases):
 def test_key_table_cache():
     from fabric_tpu.ops.p256_tables import KeyTableCache
     key = cec.generate_private_key(cec.SECP256R1()).public_key()
-    from cryptography.hazmat.primitives import serialization
+    from fabric_tpu.crypto import serialization
     sec1 = key.public_bytes(serialization.Encoding.X962,
                             serialization.PublicFormat.UncompressedPoint)
     cache = KeyTableCache(max_keys=2)

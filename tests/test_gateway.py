@@ -1,0 +1,241 @@
+"""Gateway service: batched client front door (fabric_tpu/gateway).
+
+Covers the four verbs end-to-end on a LIVE in-process topology
+(3 raft orderers + one peer per org, AND(Org1,Org2) endorsement
+policy):
+
+  - two concurrent clients drive submit -> commit_status to VALID
+  - evaluate answers without ordering anything
+  - duplicate txid submissions are deduped (in-flight + recent window)
+  - killing the orderer the gateway is stuck to mid-stream fails over
+    to a surviving orderer and the tx still commits
+  - a full admission queue rejects immediately (backpressure), unit
+  - gateway metrics appear in the Prometheus exposition
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from fabric_tpu.config import BatchConfig
+from fabric_tpu.node.orderer import OrdererNode, load_signing_identity
+from fabric_tpu.node.peer import PeerNode
+from fabric_tpu.node.provision import provision_network
+from fabric_tpu.protocol.txflags import ValidationCode
+
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    """3 orderers + Org1/Org2 peers, all in-process; gateway tuned for
+    fast tests (short linger, small batches)."""
+    base = str(tmp_path_factory.mktemp("gwnet"))
+    paths = provision_network(
+        base, n_orderers=3, peer_orgs=["Org1", "Org2"], peers_per_org=1,
+        batch=BatchConfig(max_message_count=8, timeout_s=0.1))
+    orderers, peers = [], []
+    try:
+        for p in paths["orderers"]:
+            with open(p) as f:
+                cfg = json.load(f)
+            orderers.append(OrdererNode(cfg, data_dir=cfg["data_dir"]).start())
+        for i, p in enumerate(paths["peers"]):
+            with open(p) as f:
+                cfg = json.load(f)
+            cfg["gateway"] = {"linger_s": 0.002, "max_batch": 8,
+                              "broadcast_deadline_s": 20.0}
+            if i == 0:
+                cfg["ops_port"] = 0    # ephemeral /metrics endpoint
+            peers.append(PeerNode(cfg, data_dir=cfg["data_dir"]).start())
+        # raft needs a leader before anything orders
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if any(o.support.chain.node.role == "leader" for o in orderers):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("no raft leader elected")
+        yield {"paths": paths, "orderers": orderers, "peers": peers}
+    finally:
+        for n in peers + orderers:
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+
+def _client(net, org="Org1"):
+    from fabric_tpu.gateway import GatewayClient
+    with open(net["paths"]["clients"][org]) as f:
+        cc = json.load(f)
+    signer = load_signing_identity(cc["mspid"], cc["cert_pem"].encode(),
+                                   cc["key_pem"].encode())
+    peer = net["peers"][0]
+    return GatewayClient(peer.rpc.addr, signer, peer.msps, channel_id="ch")
+
+
+def test_concurrent_submit_and_commit_status(net):
+    """Two clients push transactions through the one gateway at once;
+    every tx lands VALID and the queue coalesces without loss."""
+    results, errors = {}, []
+
+    def run(tag):
+        gw = _client(net)
+        try:
+            for i in range(3):
+                key = f"{tag}-{i}".encode()
+                code, block = gw.submit_transaction(
+                    "assets", "create", [key, b"alice"],
+                    commit_timeout_s=60.0)
+                results[(tag, i)] = (code, block)
+        except Exception as exc:  # surfaced after join
+            errors.append((tag, exc))
+        finally:
+            gw.close()
+
+    threads = [threading.Thread(target=run, args=(t,))
+               for t in ("clientA", "clientB")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert len(results) == 6
+    assert all(code == int(ValidationCode.VALID)
+               for code, _ in results.values()), results
+
+
+def test_evaluate_reads_without_ordering(net):
+    gw = _client(net)
+    try:
+        gw.submit_transaction("assets", "create",
+                              [b"evalme", b"bob"],
+                              commit_timeout_s=60.0)
+        height_before = net["peers"][0].channels["ch"].ledger.height
+        payload = gw.evaluate("assets", "read", [b"evalme"])
+        assert b"bob" in payload
+        # an evaluate is endorse-only: nothing reached the orderer
+        time.sleep(0.3)
+        assert net["peers"][0].channels["ch"].ledger.height == height_before
+    finally:
+        gw.close()
+
+
+def test_duplicate_txid_deduped(net):
+    """The same assembled envelope submitted repeatedly is absorbed:
+    concurrent duplicates share one pending entry, later duplicates
+    replay the recorded outcome from the recent window."""
+    from fabric_tpu.endorser.proposal import assemble_transaction
+
+    gw = _client(net)
+    try:
+        sp, responses = gw.endorse("assets", "create",
+                                   [b"dup1", b"carol"])
+        env = assemble_transaction(sp, responses, gw.signer)
+        txid = env.header().channel_header.txid
+
+        outs = []
+        def submit():
+            outs.append(gw.submit_envelope(env, timeout_s=60.0))
+        threads = [threading.Thread(target=submit) for _ in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=90)
+        assert len(outs) == 2
+        assert all(o["status"] == 200 for o in outs), outs
+        assert all(o["txid"] == txid for o in outs)
+
+        # now it's in the recent window: a re-submit replays the result
+        out = gw.submit_envelope(env, timeout_s=60.0)
+        assert out["deduped"] is True, out
+        code, _ = gw.commit_status(txid, timeout_s=60.0)
+        assert code == int(ValidationCode.VALID)
+        # exactly ONE copy of the tx was ordered: the key exists once and
+        # any duplicate that slipped through ordering would have been
+        # flagged DUPLICATE_TXID, not VALID — check the dedup counter saw it
+        from fabric_tpu.ops_plane import registry
+        text = registry.expose_text()
+        assert "gateway_dedup_total" in text
+    finally:
+        gw.close()
+
+
+def test_orderer_failover_mid_submit(net):
+    """Kill the orderer the gateway's broadcaster is currently stuck to;
+    the next submit must rotate to a survivor and still commit."""
+    gws = net["peers"][0].gateway
+    bc = gws.broadcaster
+    victim_idx = bc._idx % len(bc.orderers)
+    victim_addr = bc.orderers[victim_idx]
+    victim = next(o for o in net["orderers"]
+                  if o.rpc.addr[1] == victim_addr[1])
+    victim.stop()
+    net["orderers"].remove(victim)
+
+    gw = _client(net)
+    try:
+        code, _ = gw.submit_transaction("assets", "create",
+                                        [b"failover1", b"dave"],
+                                        commit_timeout_s=90.0)
+        assert code == int(ValidationCode.VALID)
+        # the broadcaster moved off the dead orderer
+        assert bc.orderers[bc._idx % len(bc.orderers)] != victim_addr \
+            or bc._failures == 0
+    finally:
+        gw.close()
+
+
+def test_backpressure_full_queue_rejects():
+    """Unit: with the batcher not draining, the bounded admission queue
+    rejects the overflow submission instead of buffering unboundedly."""
+    from types import SimpleNamespace
+
+    from fabric_tpu.gateway.service import GatewayService
+    from fabric_tpu.protocol import KVWrite, NsRwSet, TxRwSet, build
+    from fabric_tpu.msp.ca import DevOrg
+
+    org = DevOrg("Org1")
+    signer = org.new_identity("u1")
+    node = SimpleNamespace(orderers=[("127.0.0.1", 1)], signer=signer,
+                           msps={}, channels={}, peers=[])
+    svc = GatewayService(node, {"max_queue": 1})   # batcher NOT started
+
+    def env(i):
+        rw = TxRwSet((NsRwSet("cc", writes=(KVWrite(f"k{i}", b"v"),)),))
+        return build.endorser_tx("ch", "cc", "1.0", rw, signer, [signer])
+
+    env0, env1 = env(0).serialize(), env(1).serialize()
+    first = svc._rpc_submit({"envelope": env0, "timeout_ms": 0}, None)
+    assert first["status"] == 0          # still queued, nobody draining
+    with pytest.raises(RuntimeError, match="backpressure"):
+        svc._rpc_submit({"envelope": env1, "timeout_ms": 0}, None)
+    # the duplicate of the QUEUED tx is absorbed, not rejected: dedup
+    # outranks backpressure for an already-admitted txid
+    dup = svc._rpc_submit({"envelope": env0, "timeout_ms": 0}, None)
+    assert dup["deduped"] is True
+    svc.stop()
+
+
+def test_gateway_metrics_exposed(net):
+    from fabric_tpu.ops_plane import registry
+    text = registry.expose_text()
+    for name in ("gateway_request_duration_seconds", "gateway_queue_depth",
+                 "gateway_batch_size", "gateway_requests_total"):
+        assert name in text, f"{name} missing from exposition"
+    assert 'verb="submit"' in text and 'verb="commit_status"' in text
+    # and over HTTP, through the peer's operations endpoint
+    ops = net["peers"][0].ops
+    if ops is not None:
+        host, port = ops._httpd.server_address[:2]
+        body = urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5).read().decode()
+        assert "gateway_queue_depth" in body

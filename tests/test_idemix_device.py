@@ -14,6 +14,12 @@ Ver's pairing check in amcl host loops.
 import numpy as np
 import pytest
 
+# CPU tier-1 note: this module jit-compiles full device kernels on the
+# CPU backend (minutes of XLA compile, no TPU involved) -- slow-marked so
+# the quick gate stays inside its budget; the full suite still runs it.
+pytestmark = pytest.mark.slow
+
+
 from fabric_tpu.bccsp import VerifyItem
 
 

@@ -12,9 +12,9 @@ import numpy as np
 import jax
 import pytest
 
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import decode_dss_signature
-from cryptography.hazmat.primitives import hashes
+from fabric_tpu.crypto import ec
+from fabric_tpu.crypto import decode_dss_signature
+from fabric_tpu.crypto import hashes
 
 from fabric_tpu.ops import p256
 
@@ -95,9 +95,9 @@ def test_swapped_signatures(verify_jit):
 
 def test_matches_openssl_on_random_noise(verify_jit):
     """Random r/s values against a fixed key: oracle and TPU path agree."""
-    from cryptography.hazmat.primitives.asymmetric.utils import (
+    from fabric_tpu.crypto import (
         encode_dss_signature, Prehashed)
-    from cryptography.exceptions import InvalidSignature
+    from fabric_tpu.crypto import InvalidSignature
 
     key = ec.generate_private_key(ec.SECP256R1())
     pubkey = key.public_key()
@@ -134,11 +134,11 @@ def test_rows_kernel_many_keys_differential():
     import random
 
     import numpy as np
-    from cryptography.hazmat.primitives import hashes
-    from cryptography.hazmat.primitives.asymmetric import ec as cec
-    from cryptography.hazmat.primitives.asymmetric.utils import (
+    from fabric_tpu.crypto import hashes
+    from fabric_tpu.crypto import ec as cec
+    from fabric_tpu.crypto import (
         decode_dss_signature, encode_dss_signature)
-    from cryptography.hazmat.primitives.serialization import (
+    from fabric_tpu.crypto import (
         Encoding, PublicFormat)
 
     from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
@@ -181,11 +181,11 @@ def test_rows_kernel_chunking_across_dispatches(monkeypatch):
     import random
 
     import numpy as np
-    from cryptography.hazmat.primitives import hashes
-    from cryptography.hazmat.primitives.asymmetric import ec as cec
-    from cryptography.hazmat.primitives.asymmetric.utils import (
+    from fabric_tpu.crypto import hashes
+    from fabric_tpu.crypto import ec as cec
+    from fabric_tpu.crypto import (
         decode_dss_signature, encode_dss_signature)
-    from cryptography.hazmat.primitives.serialization import (
+    from fabric_tpu.crypto import (
         Encoding, PublicFormat)
 
     from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
@@ -212,10 +212,8 @@ def test_rows_kernel_chunking_across_dispatches(monkeypatch):
                                 encode_dss_signature(r, s), d))
         expect.append(ok)
 
-    prov = JaxTpuProvider()
-    prov.fast_key_threshold = 4
-    monkeypatch.setattr(JaxTpuProvider, "FAST_ROW_C", 8)
     monkeypatch.setattr(JaxTpuProvider, "ROW_BUCKETS", (2, 3, 4))
+    prov = JaxTpuProvider(fast_row_c=8, fast_key_threshold=4)
     out = np.asarray(prov.batch_verify(items))
     assert prov.stats["dispatches"] >= 3   # forced chunking
     assert (out == np.asarray(expect)).all()

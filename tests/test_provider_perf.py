@@ -18,11 +18,11 @@ import random
 import numpy as np
 import pytest
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec as cec
-from cryptography.hazmat.primitives.asymmetric.utils import (
+from fabric_tpu.crypto import hashes
+from fabric_tpu.crypto import ec as cec
+from fabric_tpu.crypto import (
     decode_dss_signature, encode_dss_signature)
-from cryptography.hazmat.primitives.serialization import (
+from fabric_tpu.crypto import (
     Encoding, PublicFormat)
 
 from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
@@ -65,6 +65,7 @@ def _fresh(monkeypatch, **env):
     return prov
 
 
+@pytest.mark.slow
 def test_steady_state_ships_no_tables(monkeypatch, keypool):
     """After the first batch builds tables, later batches must ship only
     signature words: h2d per call stays ~100 B/sig, nowhere near the
@@ -84,6 +85,7 @@ def test_steady_state_ships_no_tables(monkeypatch, keypool):
     assert bool(np.asarray(out).all())
 
 
+@pytest.mark.slow
 def test_table_upload_once_per_key(monkeypatch, keypool):
     prov = _fresh(monkeypatch)
     items = _sigs(keypool[:8], 10)
@@ -95,6 +97,7 @@ def test_table_upload_once_per_key(monkeypatch, keypool):
 
 
 @pytest.mark.parametrize("n_keys", [3, 8, 64])
+@pytest.mark.slow
 def test_lane_choice_hot_keys_ride_rows(monkeypatch, keypool, n_keys):
     """>= threshold sigs per key in one batch -> every sig on the comb
     lane regardless of how many distinct keys there are (the round-3
@@ -107,6 +110,7 @@ def test_lane_choice_hot_keys_ride_rows(monkeypatch, keypool, n_keys):
     assert prov.key_tables.stats["builds"] == n_keys
 
 
+@pytest.mark.slow
 def test_lane_choice_cold_keys_ride_generic(monkeypatch, keypool):
     """Below-threshold groups must NOT earn a table build (one-off
     creators ride the generic ladder)."""
@@ -124,6 +128,7 @@ def test_lane_choice_cold_keys_ride_generic(monkeypatch, keypool):
     assert prov.stats["fast_key_sigs"] == len(warm) + len(one)
 
 
+@pytest.mark.slow
 def test_capacity_cliff_overflow_spills_to_generic(monkeypatch, keypool):
     """More hot keys than slots in ONE batch: the first max_keys groups
     win slots (pinned for the batch), the overflow rides the generic
@@ -146,6 +151,7 @@ def test_capacity_cliff_overflow_spills_to_generic(monkeypatch, keypool):
     assert prov.stats["fast_key_sigs"] == 2 * 4 * 5
 
 
+@pytest.mark.slow
 def test_capacity_cliff_rotation_evicts_correctly(monkeypatch, keypool):
     """Alternating hot-key populations churn the LRU across batches;
     verdicts stay correct and rebuild cost is bounded by the rotation."""
@@ -171,6 +177,7 @@ def test_capacity_cliff_rotation_evicts_correctly(monkeypatch, keypool):
     assert prov2.key_tables.stats["builds"] == builds == 6
 
 
+@pytest.mark.slow
 def test_dispatch_count_single_rows_dispatch(monkeypatch, keypool):
     """A mixed hot-key batch that fits one row chunk = exactly one
     device dispatch (merged rows lane), no generic-lane dispatch."""
@@ -182,12 +189,12 @@ def test_dispatch_count_single_rows_dispatch(monkeypatch, keypool):
     assert prov.stats["dispatches"] - d0 == 1
 
 
-def test_rows_chunk_splits_large_grids(monkeypatch, keypool):
-    """Grids beyond ROWS_CHUNK rows split into several dispatches (the
-    pack/compute overlap), with verdicts identical."""
-    prov = _fresh(monkeypatch)
-    monkeypatch.setattr(JaxTpuProvider, "FAST_ROW_C", 4)
-    prov.ROWS_CHUNK = 2
+def test_rows_chunk_splits_large_grids(keypool):
+    """Grids beyond rows_chunk rows split into several dispatches (the
+    pack/compute overlap), with verdicts identical.  Geometry comes in
+    through the PUBLIC constructor knobs — no class monkeypatching."""
+    prov = JaxTpuProvider(fast_row_c=4, rows_chunk=2,
+                          fast_key_threshold=4)
     items = _sigs(keypool[:3], 9)            # 3 rows/key of C=4
     d0 = prov.stats["dispatches"]
     out = prov.batch_verify(items)
@@ -195,3 +202,24 @@ def test_rows_chunk_splits_large_grids(monkeypatch, keypool):
     assert prov.stats["dispatches"] - d0 >= 3
     sw = prov.fallback.batch_verify(items)
     assert (np.asarray(out) == np.asarray(sw)).all()
+
+
+def test_stats_snapshot_public_surface(keypool):
+    """stats_snapshot() exposes counters + table-bank builds + the
+    effective tuning as a frozen dataclass, decoupled from the live
+    mutable dicts."""
+    import dataclasses
+
+    prov = JaxTpuProvider(fast_row_c=8, rows_chunk=16,
+                          fast_key_threshold=4, max_cached_keys=12)
+    items = _sigs(keypool[:2], 6)
+    prov.batch_verify(items)
+    snap = prov.stats_snapshot()
+    assert snap.dispatches >= 1
+    assert snap.p256_table_builds == 2
+    assert snap.tuning == {"fast_row_c": 8, "rows_chunk": 16,
+                           "fast_key_threshold": 4,
+                           "max_cached_keys": 12}
+    # a snapshot is immutable: observers can't poke the provider
+    with pytest.raises(dataclasses.FrozenInstanceError):
+        snap.dispatches = -1
