@@ -55,10 +55,12 @@ def boot(base, n_orderers):
         with open(p) as f:
             cfg = json.load(f)
         orderers.append(OrdererNode(cfg, data_dir=cfg["data_dir"]).start())
-    for p in paths["peers"]:
+    for i, p in enumerate(paths["peers"]):
         with open(p) as f:
             cfg = json.load(f)
         cfg["gateway"] = {"linger_s": 0.005, "max_batch": 64}
+        if i == 0:
+            cfg["ops_port"] = 0     # /metrics, /traces, /spans/stats
         peers.append(PeerNode(cfg, data_dir=cfg["data_dir"]).start())
     deadline = time.time() + 60
     while time.time() < deadline:
@@ -89,7 +91,8 @@ def main():
             cc["mspid"], cc["cert_pem"].encode(), cc["key_pem"].encode())
 
         lat_endorse, lat_commit, lat_e2e = [], [], []
-        bad, lock = [], threading.Lock()
+        bad, trace_ids, lock = [], [], threading.Lock()
+        from fabric_tpu.ops_plane import tracing
 
         def worker(wid):
             gw = GatewayClient(gw_peer.rpc.addr, signer, gw_peer.msps,
@@ -98,17 +101,24 @@ def main():
                 for i in range(args.txs):
                     key = f"w{wid}-tx{i}".encode()
                     t0 = time.monotonic()
-                    sp, responses = gw.endorse(
-                        "assets", "create", [key, b"load"])
-                    t1 = time.monotonic()
-                    from fabric_tpu.endorser.proposal import (
-                        assemble_transaction)
-                    env = assemble_transaction(sp, responses, signer)
-                    txid = env.header().channel_header.txid
-                    gw.submit_envelope(env, timeout_s=60.0)
-                    code, _ = gw.commit_status(txid, timeout_s=60.0)
+                    # one root span per tx: all three gateway verbs ride
+                    # this context, so the whole lifecycle is ONE trace
+                    with tracing.tracer.start_span(
+                            "client.tx",
+                            attributes={"worker": wid, "i": i}) as span:
+                        sp, responses = gw.endorse(
+                            "assets", "create", [key, b"load"])
+                        t1 = time.monotonic()
+                        from fabric_tpu.endorser.proposal import (
+                            assemble_transaction)
+                        env = assemble_transaction(sp, responses, signer)
+                        txid = env.header().channel_header.txid
+                        gw.submit_envelope(env, timeout_s=60.0)
+                        code, _ = gw.commit_status(txid, timeout_s=60.0)
                     t2 = time.monotonic()
                     with lock:
+                        if span.recording and not trace_ids:
+                            trace_ids.append(span.context.trace_id)
                         lat_endorse.append(t1 - t0)
                         lat_commit.append(t2 - t1)
                         lat_e2e.append(t2 - t0)
@@ -152,6 +162,32 @@ def main():
         for line in registry.expose_text().splitlines():
             if line.startswith("gateway_") and not line.startswith("#"):
                 print(" ", line)
+
+        # fetch one tx's trace over the peer's ops server: the flight
+        # recorder stitches the request trace to its block trace, so the
+        # Chrome JSON covers admission -> endorse -> order -> device
+        # verify -> MVCC -> commit notification in one Perfetto load
+        if trace_ids and gw_peer.ops is not None:
+            import urllib.request
+            host, port = gw_peer.ops.addr
+            url = f"http://{host}:{port}/traces/{trace_ids[0]}"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                doc = json.loads(r.read())
+            names = {e["name"] for e in doc["traceEvents"]
+                     if e.get("ph") == "X"}
+            print(f"\ntrace {trace_ids[0]} "
+                  f"({len(doc['traceEvents'])} events) via {url}")
+            stages = {"admission": "gateway.queue_wait",
+                      "endorsement": "endorser.simulate",
+                      "ordering": "orderer.broadcast",
+                      "device batch-verify": "bccsp.batch_verify",
+                      "MVCC": "ledger.mvcc",
+                      "commit notification": "gateway.commit_wait"}
+            for stage, span_name in stages.items():
+                mark = "ok" if span_name in names else "MISSING"
+                print(f"  {stage:22s} {span_name:22s} {mark}")
+                if span_name not in names:
+                    bad.append(("trace", f"missing span {span_name}"))
 
         for n in peers + orderers:
             try:

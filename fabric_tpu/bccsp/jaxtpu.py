@@ -819,9 +819,18 @@ class JaxTpuProvider(prov.Provider):
         (SURVEY.md §7 hard-part #3 overlap); resolve() blocks on the
         results.  Fallback stays atomic: ANY device failure — at enqueue
         or at resolve — recomputes the whole batch on the sw provider."""
+        from fabric_tpu.ops_plane import tracing
         items = list(items)
         verdicts = np.zeros(len(items), dtype=bool)
         pending = []
+        # device-time bridge: one span per dispatched batch, started at
+        # enqueue on the caller's trace and ended from whichever thread
+        # resolves it, carrying batch size, block_until_ready wall time
+        # and the cache-hit deltas from stats_snapshot()
+        span = tracing.tracer.start_span(
+            "bccsp.batch_verify", require_parent=True,
+            attributes={"provider": self.name, "batch_size": len(items)})
+        snap0 = self.stats_snapshot() if span.recording else None
         try:
             by_scheme = {}
             for i, it in enumerate(items):
@@ -839,7 +848,15 @@ class JaxTpuProvider(prov.Provider):
             logger.exception(
                 "TPU dispatch failed; falling back to sw provider")
             self.stats["fallbacks"] += 1
-            return lambda: self.fallback.batch_verify(items)
+            span.set_attribute("fallback", "dispatch")
+
+            def resolve_fallback():
+                try:
+                    return self.fallback.batch_verify(items)
+                finally:
+                    span.end(status="ERROR")
+
+            return resolve_fallback
 
         def resolve():
             import time as _time
@@ -853,7 +870,26 @@ class JaxTpuProvider(prov.Provider):
                 logger.exception(
                     "TPU resolve failed; falling back to sw provider")
                 self.stats["fallbacks"] += 1
+                span.set_attribute("fallback", "resolve")
+                span.end(status="ERROR")
                 return self.fallback.batch_verify(items)
+            wall = _time.perf_counter() - t0
+            if span.recording:
+                snap1 = self.stats_snapshot()
+                span.set_attribute("block_until_ready_s", round(wall, 6))
+                span.set_attribute(
+                    "dispatches", snap1.dispatches - snap0.dispatches)
+                span.set_attribute(
+                    "device_sigs", snap1.device_sigs - snap0.device_sigs)
+                span.set_attribute(
+                    "fast_key_sigs",
+                    snap1.fast_key_sigs - snap0.fast_key_sigs)
+                span.set_attribute(
+                    "table_builds",
+                    (snap1.p256_table_builds - snap0.p256_table_builds)
+                    + (snap1.ed25519_table_builds
+                       - snap0.ed25519_table_builds))
+                span.end()
             try:
                 # device-phase observability (the jax.profiler trace is
                 # the deep view; these are the always-on numbers):
@@ -861,8 +897,7 @@ class JaxTpuProvider(prov.Provider):
                 from fabric_tpu.ops_plane import registry
                 registry.histogram(
                     "provider_resolve_seconds",
-                    "batch_verify device resolve wait").observe(
-                        _time.perf_counter() - t0)
+                    "batch_verify device resolve wait").observe(wall)
                 registry.counter(
                     "provider_device_sigs_total",
                     "signatures resolved on device").add(len(items))

@@ -87,8 +87,27 @@ class Provider:
         immediately, letting the caller overlap further host-side
         collection with device compute (SURVEY.md §7 hard-part #3).  The
         default is lazy-but-correct: work happens at resolve()."""
+        from fabric_tpu.ops_plane import tracing
         items = list(items)
-        return lambda: self.batch_verify(items)
+        span = tracing.tracer.start_span(
+            "bccsp.batch_verify", require_parent=True,
+            attributes={"provider": self.name, "batch_size": len(items)})
+
+        def resolve():
+            import time as _t
+            t0 = _t.perf_counter()
+            try:
+                out = self.batch_verify(items)
+            except BaseException as exc:
+                span.set_attribute("error", repr(exc))
+                span.end(status="ERROR")
+                raise
+            span.set_attribute("block_until_ready_s",
+                               round(_t.perf_counter() - t0, 6))
+            span.end()
+            return out
+
+        return resolve
 
     def hash(self, data: bytes, algo: str = HASH_SHA256) -> bytes:
         return hash_payload(data, algo)

@@ -19,6 +19,7 @@ import time as _time
 import threading
 from typing import Callable, Dict, Optional
 
+from fabric_tpu.ops_plane import tracing
 from fabric_tpu.utils import serde
 
 from .secure import SecureChannel, SecureServer, dial
@@ -94,8 +95,11 @@ class RpcConnection:
                     pass
 
     def cast(self, method: str, body: dict) -> None:
-        self.channel.send(serde.encode(
-            {"kind": "cast", "method": method, "body": body}))
+        frame = {"kind": "cast", "method": method, "body": body}
+        tp = tracing.tracer.traceparent()
+        if tp:
+            frame["tp"] = tp
+        self.channel.send(serde.encode(frame))
 
     def _start(self, method, body) -> "_Waiter":
         with self._lock:
@@ -105,8 +109,11 @@ class RpcConnection:
             self._next_id += 1
             w = _Waiter(rid)
             self._waiters[rid] = w
-        self.channel.send(serde.encode(
-            {"kind": "req", "id": rid, "method": method, "body": body}))
+        frame = {"kind": "req", "id": rid, "method": method, "body": body}
+        tp = tracing.tracer.traceparent()
+        if tp:
+            frame["tp"] = tp
+        self.channel.send(serde.encode(frame))
         return w
 
     def _finish(self, w: "_Waiter") -> None:
@@ -183,8 +190,12 @@ class RpcServer:
                 if kind == "cast":
                     fn = self._cast.get(msg["method"])
                     if fn is not None:
+                        ctx = tracing.tracer.context_from(msg.get("tp"))
                         try:
-                            fn(msg.get("body", {}), ch.peer_identity)
+                            with tracing.tracer.start_span(
+                                    "rpc." + msg["method"], parent=ctx,
+                                    require_parent=True):
+                                fn(msg.get("body", {}), ch.peer_identity)
                         except Exception:
                             logger.exception("cast handler %s failed",
                                              msg["method"])
@@ -207,6 +218,12 @@ class RpcServer:
         body = msg.get("body", {})
         t0 = _time.perf_counter()
         ok = True
+        # continue the caller's trace (W3C traceparent carried in the
+        # frame's "tp" field); no tp => no span, untraced traffic is free
+        ctx = tracing.tracer.context_from(msg.get("tp"))
+        span = tracing.tracer.start_span("rpc." + method, parent=ctx,
+                                         require_parent=True)
+        span.__enter__()
         try:
             if method in self._stream:
                 key = (id(ch), rid)
@@ -227,12 +244,18 @@ class RpcServer:
                                   "body": out or {}}))
         except Exception as exc:
             ok = False
+            if span.recording:
+                span.set_attribute("error", str(exc)[:200])
             try:
                 ch.send(serde.encode({"kind": "resp", "id": rid, "ok": False,
                                       "error": str(exc)[:500]}))
             except Exception:
                 pass
         finally:
+            if span.recording:
+                span.set_attribute("ok", ok)
+                span.status = "OK" if ok else "ERROR"
+            span.__exit__(None, None, None)
             _observe_rpc(method, ok, _time.perf_counter() - t0)
 
 

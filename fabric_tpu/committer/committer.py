@@ -8,10 +8,13 @@ coordinator.StoreBlock -> txvalidator.Validate -> CommitLegacy).
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass
 from typing import Optional
 
 from fabric_tpu.ledger import KVLedger
+from fabric_tpu.ops_plane import tracing
+from fabric_tpu.ops_plane.logging import jlog
 from fabric_tpu.protocol import Block
 
 from .txvalidator import TxValidator, ValidationResult
@@ -54,6 +57,21 @@ class Committer:
         boundary), so the config tx itself is validated under the previous
         configuration — matching configtx/validator.go sequencing.
         """
+        # root of the block-domain trace: everything downstream (VSCC
+        # batch verify, MVCC, ledger append, commit notification) hangs
+        # off this span, and commit_status links request traces to it
+        with tracing.tracer.start_span(
+                "committer.store_block",
+                attributes={"channel": self.validator.channel_id,
+                            "block": int(block.header.number),
+                            "txs": len(block.data)}) as span:
+            result = self._store_block_inner(block)
+            if span.recording:
+                span.set_attribute("valid",
+                                   result.final_flags.valid_count())
+            return result
+
+    def _store_block_inner(self, block: Block) -> BlockCommitResult:
         from fabric_tpu.protocol.txflags import TxFlags, ValidationCode
         from fabric_tpu.protocol.types import META_TXFLAGS
 
@@ -144,17 +162,30 @@ class Committer:
                     logger.warning(
                         "config tx in block %d invalid at commit: %s",
                         block.header.number, err)
+                    jlog(logger, "committer.config_tx_invalid",
+                         level=logging.WARNING, exc=err,
+                         channel=self.validator.channel_id,
+                         block=int(block.header.number))
                     flags.set(0, ValidationCode.INVALID_CONFIG_TRANSACTION)
                     block.metadata.items[META_TXFLAGS] = flags.to_bytes()
+        t_commit = time.perf_counter()
         stats = self.ledger.commit(block)
+        self._record_phase_spans(t_commit, stats)
         final = TxFlags.from_bytes(block.metadata.items[META_TXFLAGS])
         self._observe_metrics(block, vr, stats)
-        for fn in self._commit_listeners:
-            try:
-                fn(block, final)
-            except Exception:
-                logger.exception("commit listener failed for block %d",
-                                 block.header.number)
+        with tracing.tracer.start_span(
+                "committer.notify", require_parent=True,
+                attributes={"listeners": len(self._commit_listeners)}):
+            for fn in self._commit_listeners:
+                try:
+                    fn(block, final)
+                except Exception as exc:
+                    logger.exception("commit listener failed for block %d",
+                                     block.header.number)
+                    jlog(logger, "committer.listener_failed",
+                         level=logging.ERROR, exc=exc,
+                         channel=self.validator.channel_id,
+                         block=int(block.header.number))
         if new_cfg is not None and final.is_valid(0):
             try:
                 from fabric_tpu.config import Bundle
@@ -169,6 +200,22 @@ class Committer:
                 logger.exception("config application failed for block %d",
                                  block.header.number)
         return BlockCommitResult(vr, stats, final)
+
+    @staticmethod
+    def _record_phase_spans(t0: float, stats) -> None:
+        """Retroactive child spans for the sequential ledger commit
+        phases, laid end-to-end from the commit start using the wall
+        times CommitStats already measured (kvledger.commit)."""
+        base = t0
+        for attr, name in (("state_validation_s", "ledger.mvcc"),
+                           ("block_commit_s", "ledger.block_commit"),
+                           ("state_commit_s", "ledger.state_commit"),
+                           ("history_commit_s", "ledger.history_commit")):
+            dur = getattr(stats, attr, None)
+            if dur is None:
+                continue
+            tracing.tracer.record_span(name, base, base + dur)
+            base += dur
 
     def _observe_metrics(self, block, vr, stats) -> None:
         """Per-phase commit metrics (metric parity: the reference's

@@ -38,6 +38,7 @@ import numpy as np
 
 from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
 from fabric_tpu.msp import Identity
+from fabric_tpu.ops_plane import tracing
 from fabric_tpu.policy import PolicyEvaluator, SignaturePolicy, SignedData
 from fabric_tpu.protocol import Block
 from fabric_tpu.protocol.txflags import TxFlags, ValidationCode
@@ -482,10 +483,15 @@ class TxValidator:
                 flush()
         flush()
         self._inflight_txids.append((num, seen_txids))
+        collect_s = time.perf_counter() - t0
+        tracing.tracer.record_span(
+            "validator.collect", t0, t0 + collect_s,
+            attributes={"block": int(num), "txs": n,
+                        "unique_items": len(items)})
         return {"block": block, "flags": flags, "items": items,
                 "works": works, "resolvers": resolvers,
                 "msps": self._msps_snapshot, "seen_txids": seen_txids,
-                "collect_s": time.perf_counter() - t0}
+                "collect_s": collect_s}
 
     def _finish_inner(self, state: dict) -> ValidationResult:
         block = state["block"]
@@ -502,6 +508,10 @@ class TxValidator:
             verdict.update(
                 (k, bool(v)) for k, v in zip(chunk_keys, out))
         dispatch_s = time.perf_counter() - t0
+        tracing.tracer.record_span(
+            "validator.dispatch_wait", t0, t0 + dispatch_s,
+            attributes={"block": int(block.header.number),
+                        "unique_items": len(keys)})
 
         t0 = time.perf_counter()
         from fabric_tpu.committer.sbe import SbeOverlay
@@ -520,6 +530,10 @@ class TxValidator:
         for work in works:
             self._gate_tx(work, flags, verdict, overlay, plugin=plugin)
         gate_s = time.perf_counter() - t0
+        tracing.tracer.record_span(
+            "validator.gate", t0, t0 + gate_s,
+            attributes={"block": int(block.header.number),
+                        "txs": len(works)})
 
         n_refs = sum(1 + sum(len(s) for _, _, s in w.namespaces) for w in works)
         block.metadata.items[META_TXFLAGS] = flags.to_bytes()

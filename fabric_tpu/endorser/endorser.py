@@ -24,6 +24,7 @@ from fabric_tpu.endorser.proposal import (
 )
 from fabric_tpu.ledger.statedb import StateDB
 from fabric_tpu.msp import SigningIdentity, deserialize_from_msps
+from fabric_tpu.ops_plane import tracing
 from fabric_tpu.policy import PolicyEvaluator, SignaturePolicy, SignedData
 from fabric_tpu.protocol.build import compute_txid
 from fabric_tpu.protocol.types import (ChaincodeAction, Endorsement,
@@ -79,8 +80,13 @@ class Endorser:
         exception — the reference returns a ProposalResponse with an error
         status to the client in all failure modes."""
         try:
-            prop, creator = self._validate(sp)
-            payload, rwset, events = self._simulate(prop, creator)
+            with tracing.tracer.start_span("endorser.validate",
+                                           require_parent=True):
+                prop, creator = self._validate(sp)
+            with tracing.tracer.start_span(
+                    "endorser.simulate", require_parent=True,
+                    attributes={"chaincode": prop.chaincode_id}):
+                payload, rwset, events = self._simulate(prop, creator)
             action = ChaincodeAction(
                 prop.chaincode_id,
                 self._version_of(prop.chaincode_id),
@@ -89,8 +95,10 @@ class Endorser:
             endorsed = ta.endorsed_bytes()
             # ESCC slot: the endorsement plugin signs
             # endorsed-bytes || endorser identity
-            endorser_bytes, sig = self.endorsement_plugin(self.signer,
-                                                          endorsed)
+            with tracing.tracer.start_span("endorser.sign",
+                                           require_parent=True):
+                endorser_bytes, sig = self.endorsement_plugin(self.signer,
+                                                              endorsed)
             return ProposalResponse(200, "", endorsed,
                                     Endorsement(endorser_bytes, sig))
         except (EndorserError, SimulationError) as err:

@@ -88,9 +88,14 @@ class BatchBroadcaster:
 
     def broadcast_batch(
             self, envs: Sequence,
-            deadline_s: Optional[float] = None) -> List[Tuple[int, str]]:
+            deadline_s: Optional[float] = None,
+            tps: Optional[Sequence[str]] = None) -> List[Tuple[int, str]]:
         """Send every envelope, retrying transient failures across the
-        orderer set; returns one (status, info) per envelope in order."""
+        orderer set; returns one (status, info) per envelope in order.
+
+        `tps` (optional, aligned with envs) carries each envelope's
+        traceparent so the orderer can continue per-tx traces even
+        though the whole batch rides one RPC frame."""
         results: List[Optional[Tuple[int, str]]] = [None] * len(envs)
         pending = list(enumerate(envs))
         deadline = time.monotonic() + (deadline_s if deadline_s is not None
@@ -98,9 +103,12 @@ class BatchBroadcaster:
         while pending:
             try:
                 conn = self._connection()
+                body = {"envelopes": [e.serialize() for _, e in pending]}
+                if tps and any(tps):
+                    body["tps"] = [tps[i] if i < len(tps) else ""
+                                   for i, _ in pending]
                 out = conn.call(
-                    "broadcast_batch",
-                    {"envelopes": [e.serialize() for _, e in pending]},
+                    "broadcast_batch", body,
                     timeout=self.rpc_timeout_s)
                 statuses = [int(s) for s in out["statuses"]]
                 infos = [str(s) for s in out.get(

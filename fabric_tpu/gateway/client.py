@@ -20,6 +20,7 @@ import threading
 from typing import List, Optional, Sequence, Tuple
 
 from fabric_tpu.comm import RpcError, connect
+from fabric_tpu.ops_plane import tracing
 from fabric_tpu.endorser.proposal import (
     ProposalResponse,
     SignedProposal,
@@ -173,12 +174,20 @@ class GatewayClient:
         the tx commits with a non-VALID code.
         """
         ch = self._channel(channel)
-        sp, responses = self.endorse(chaincode_id, fn, args, channel=ch)
-        env = assemble_transaction(sp, responses, self.signer)
-        txid = env.header().channel_header.txid
-        self.submit_envelope(env)
-        code, block = self.commit_status(txid, channel=ch,
-                                         timeout_s=commit_timeout_s)
+        # one root span per lifecycle: endorse/submit/commit_status all
+        # propagate this context in their RPC frames, so the whole tx
+        # lands in ONE trace in the peer's flight recorder
+        with tracing.tracer.start_span(
+                "client.tx", attributes={"channel": ch,
+                                         "chaincode": chaincode_id,
+                                         "fn": fn}) as span:
+            sp, responses = self.endorse(chaincode_id, fn, args, channel=ch)
+            env = assemble_transaction(sp, responses, self.signer)
+            txid = env.header().channel_header.txid
+            span.set_attribute("txid", txid)
+            self.submit_envelope(env)
+            code, block = self.commit_status(txid, channel=ch,
+                                             timeout_s=commit_timeout_s)
         if code != int(ValidationCode.VALID):
             try:
                 name = ValidationCode(code).name

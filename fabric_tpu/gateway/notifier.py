@@ -19,6 +19,7 @@ import threading
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
+from fabric_tpu.ops_plane import tracing
 from fabric_tpu.protocol import Envelope
 
 logger = logging.getLogger("fabric_tpu.gateway")
@@ -29,14 +30,19 @@ class CommitNotifier:
         self.channel_id = channel_id
         self.window = int(window)
         self._lock = threading.Lock()
-        # txid -> (validation code int, block number)
-        self._history: "OrderedDict[str, Tuple[int, int]]" = OrderedDict()
+        # txid -> (validation code int, block number, block trace id|None)
+        self._history: "OrderedDict[str, Tuple[int, int, Optional[str]]]" \
+            = OrderedDict()
         self._waiters: Dict[str, List[threading.Event]] = {}
 
     # committer hook ----------------------------------------------------
 
     def on_block(self, block, flags) -> None:
         notified = []
+        # listeners run inside committer.store_block's span, so the
+        # ambient trace id here IS the block trace — remember it so
+        # commit_status can link the request trace to the block trace
+        block_trace = tracing.tracer.current_trace_id()
         with self._lock:
             for i, env_bytes in enumerate(block.data):
                 try:
@@ -47,7 +53,8 @@ class CommitNotifier:
                 if not txid:
                     continue
                 self._history[txid] = (int(flags.flag(i)),
-                                       int(block.header.number))
+                                       int(block.header.number),
+                                       block_trace)
                 evs = self._waiters.pop(txid, None)
                 if evs:
                     notified.extend(evs)
@@ -58,11 +65,12 @@ class CommitNotifier:
 
     # client side -------------------------------------------------------
 
-    def peek(self, txid: str) -> Optional[Tuple[int, int]]:
+    def peek(self, txid: str) -> Optional[Tuple[int, int, Optional[str]]]:
         with self._lock:
             return self._history.get(txid)
 
-    def wait(self, txid: str, timeout: float) -> Optional[Tuple[int, int]]:
+    def wait(self, txid: str,
+             timeout: float) -> Optional[Tuple[int, int, Optional[str]]]:
         """Block until the txid commits or the timeout lapses."""
         ev = threading.Event()
         with self._lock:

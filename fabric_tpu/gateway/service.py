@@ -27,7 +27,8 @@ from fabric_tpu.comm import connect
 from fabric_tpu.endorser.proposal import SignedProposal
 from fabric_tpu.gateway.broadcaster import BatchBroadcaster
 from fabric_tpu.gateway.notifier import CommitNotifier
-from fabric_tpu.ops_plane import registry
+from fabric_tpu.ops_plane import registry, tracing
+from fabric_tpu.ops_plane.logging import jlog
 from fabric_tpu.protocol import Envelope
 from fabric_tpu.protocol.txflags import ValidationCode
 
@@ -35,7 +36,8 @@ logger = logging.getLogger("fabric_tpu.gateway")
 
 
 class _Pending:
-    __slots__ = ("env", "txid", "event", "status", "info")
+    __slots__ = ("env", "txid", "event", "status", "info", "ctx",
+                 "span_queue")
 
     def __init__(self, env: Envelope, txid: str):
         self.env = env
@@ -43,6 +45,12 @@ class _Pending:
         self.event = threading.Event()
         self.status = 0
         self.info = ""
+        # tracing: the submitter's span context + its queue-wait span,
+        # started on the submit thread and ended by the batcher thread
+        self.ctx = tracing.tracer.current_context()
+        self.span_queue = tracing.tracer.start_span(
+            "gateway.queue_wait", require_parent=True,
+            attributes={"txid": txid})
 
 
 class GatewayService:
@@ -209,6 +217,10 @@ class GatewayService:
                 if pending is None:
                     if len(self._queue) >= self.max_queue:
                         self._m_backpressure.add(1)
+                        jlog(logger, "gateway.backpressure",
+                             level=logging.WARNING, txid=txid,
+                             channel=header.channel_id,
+                             queue_depth=len(self._queue))
                         raise RuntimeError(
                             "gateway admission queue full "
                             f"({self.max_queue}): backpressure, retry later")
@@ -241,28 +253,41 @@ class GatewayService:
             txid = str(body["txid"])
             timeout = min(int(body.get("timeout_ms", 15000)) / 1000.0, 120.0)
             notifier = self._notifier(ch)
-            got = notifier.peek(txid)
-            if got is None:
-                # committed before this gateway attached its notifier
-                # (or long ago): the block store is authoritative
-                try:
-                    if ch.ledger.blockstore.has_txid(txid):
-                        code = ch.ledger.blockstore.get_tx_validation_code(
-                            txid)
-                        got = (int(code), -1)
-                except Exception:
-                    got = None
-            if got is None:
-                got = notifier.wait(txid, timeout)
-            if got is None:
-                return {"found": False, "txid": txid}
-            code, block_num = got
+            with tracing.tracer.start_span(
+                    "gateway.commit_wait", require_parent=True,
+                    attributes={"txid": txid}) as span:
+                got = notifier.peek(txid)
+                if got is None:
+                    # committed before this gateway attached its notifier
+                    # (or long ago): the block store is authoritative
+                    try:
+                        if ch.ledger.blockstore.has_txid(txid):
+                            code = \
+                                ch.ledger.blockstore.get_tx_validation_code(
+                                    txid)
+                            got = (int(code), -1, None)
+                    except Exception:
+                        got = None
+                if got is None:
+                    got = notifier.wait(txid, timeout)
+                if got is None:
+                    span.set_attribute("found", False)
+                    return {"found": False, "txid": txid}
+                code, block_num, block_trace = got
+                span.set_attribute("found", True)
+                span.set_attribute("code", int(code))
+                span.set_attribute("block", block_num)
+                # stitch the request trace to the block's pipeline trace
+                span.add_link(block_trace)
             try:
                 name = ValidationCode(code).name
             except ValueError:
                 name = str(code)
-            return {"found": True, "txid": txid, "code": int(code),
-                    "code_name": name, "block": block_num}
+            out = {"found": True, "txid": txid, "code": int(code),
+                   "code_name": name, "block": block_num}
+            if block_trace:
+                out["block_trace_id"] = block_trace
+            return out
         finally:
             self._observe("commit_status", t0)
 
@@ -293,16 +318,35 @@ class GatewayService:
                 self._m_batch.observe(len(batch))
             except Exception:
                 pass
+            # batch coalesce point: close each tx's queue-wait span and
+            # open its ordering span (parented to that tx's own trace)
+            spans_order = []
+            for p in batch:
+                p.span_queue.set_attribute("batch_size", len(batch))
+                p.span_queue.end()
+                spans_order.append(tracing.tracer.start_span(
+                    "gateway.order", parent=p.ctx, require_parent=True,
+                    attributes={"txid": p.txid, "batch_size": len(batch)}))
+            # each envelope's traceparent rides beside it in the batch
+            # frame: the batcher thread has no ambient context, so this
+            # is how orderer-side spans join the right per-tx trace
+            tps = [tracing.format_traceparent(sp.context)
+                   if sp.recording else "" for sp in spans_order]
             try:
                 results = self.broadcaster.broadcast_batch(
-                    [p.env for p in batch])
+                    [p.env for p in batch], tps=tps)
             except Exception as exc:
                 logger.exception("broadcast batch failed")
+                jlog(logger, "gateway.broadcast_failed",
+                     level=logging.ERROR, exc=exc, batch_size=len(batch),
+                     txids=[p.txid for p in batch[:8]])
                 results = [(500, f"gateway broadcast error: {exc}")] \
                     * len(batch)
             with self._cv:
-                for p, (st, info) in zip(batch, results):
+                for p, sp, (st, info) in zip(batch, spans_order, results):
                     p.status, p.info = int(st), str(info)
+                    sp.set_attribute("status", p.status)
+                    sp.end("OK" if p.status == 200 else "ERROR")
                     self._inflight.pop(p.txid, None)
                     self._recent[p.txid] = (p.status, p.info)
                 while len(self._recent) > self.recent_window:

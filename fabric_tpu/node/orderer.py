@@ -133,6 +133,11 @@ class OrdererNode:
 
         # ops plane: /metrics, /healthz (system.go:75-267 parity) + the
         # channelparticipation REST API (channelparticipation/restapi.go)
+        # tx tracing + flight recorder (sample rate / capacity via the
+        # localconfig `tracing` sub-dict, FABRIC_TPU_ORDERER_TRACING__*)
+        from fabric_tpu.ops_plane import tracing as _tracing
+        _tracing.configure(cfg.get("tracing", {}))
+
         self.ops = None
         if cfg.get("ops_port") is not None:
             from fabric_tpu.ops_plane import OperationsServer
@@ -143,6 +148,8 @@ class OrdererNode:
             # profiling surface (orderer/common/server/main.go:408 slot)
             from fabric_tpu.ops_plane.profiling import register_routes
             register_routes(self.ops, enabled=bool(cfg.get("profiling")))
+            # /traces, /traces/<id> (Chrome trace JSON), /spans/stats
+            _tracing.register_routes(self.ops)
             self.ops.register_route("GET", "/participation/v1/channels",
                                     self._rest_channels)
             # the ops server is PLAIN HTTP with no client auth, so the
@@ -315,7 +322,7 @@ class OrdererNode:
         """Gateway fan-in: many envelopes per RPC round trip.  Each is
         admitted independently; statuses/infos line up by index."""
         envs = [Envelope.deserialize(e) for e in body["envelopes"]]
-        resps = self.broadcast.handle_batch(envs)
+        resps = self.broadcast.handle_batch(envs, tps=body.get("tps"))
         leader = 0
         for r in resps:
             leader = getattr(r, "leader_hint", 0) or leader

@@ -545,6 +545,13 @@ class PeerNode:
             self.gateway = GatewayService(self, cfg.get("gateway", {}))
             self.gateway.register(self.rpc)
 
+        # tx tracing + flight recorder: on by default for nodes (the
+        # import-time default stays off so libraries/bench pay nothing);
+        # sample rate and recorder capacity ride localconfig, e.g.
+        # FABRIC_TPU_PEER_TRACING__SAMPLE_RATE=0.1
+        from fabric_tpu.ops_plane import tracing as _tracing
+        _tracing.configure(cfg.get("tracing", {}))
+
         self.ops = None
         if cfg.get("ops_port") is not None:
             from fabric_tpu.ops_plane import OperationsServer
@@ -556,6 +563,8 @@ class PeerNode:
             # peer.profile.enabled slot (internal/peer/node/start.go:813)
             from fabric_tpu.ops_plane.profiling import register_routes
             register_routes(self.ops, enabled=bool(cfg.get("profiling")))
+            # /traces, /traces/<id> (Chrome trace JSON), /spans/stats
+            _tracing.register_routes(self.ops)
 
     # -- channel lifecycle ---------------------------------------------------
 

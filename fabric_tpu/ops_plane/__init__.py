@@ -12,6 +12,12 @@ the TPU kernel package.
 
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry, registry
 from .server import OperationsServer
+from .tracing import (FlightRecorder, Span, SpanContext, Tracer, tracer,
+                      configure as configure_tracing,
+                      register_routes as register_trace_routes)
+from .logging import jlog
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
-           "OperationsServer"]
+           "OperationsServer", "FlightRecorder", "Span", "SpanContext",
+           "Tracer", "tracer", "configure_tracing", "register_trace_routes",
+           "jlog"]

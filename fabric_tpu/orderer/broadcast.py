@@ -13,6 +13,7 @@ from __future__ import annotations
 from dataclasses import dataclass
 from typing import List, Optional, Sequence
 
+from fabric_tpu.ops_plane import tracing
 from fabric_tpu.orderer.consensus import ChainHaltedError
 from fabric_tpu.orderer.msgprocessor import MsgClass, MsgProcessorError
 from fabric_tpu.orderer.raft import NotLeaderError
@@ -39,11 +40,24 @@ class BroadcastHandler:
         self.registrar = registrar
 
     def handle(self, env: Envelope) -> BroadcastResponse:
+        resp = None
+        with tracing.tracer.start_span("orderer.broadcast",
+                                       require_parent=True) as span:
+            resp = self._handle_inner(env, span)
+            if span.recording:
+                span.set_attribute("status", resp.status)
+                if resp.status != STATUS_SUCCESS:
+                    span.status = "ERROR"
+        return resp
+
+    def _handle_inner(self, env: Envelope, span) -> BroadcastResponse:
         try:
             channel_id = env.header().channel_header.channel_id
         except Exception:
             return BroadcastResponse(STATUS_BAD_REQUEST,
                                      "undecodable envelope header")
+        if span.recording:
+            span.set_attribute("channel", channel_id)
         support = self.registrar.get(channel_id)
         if support is None:
             return BroadcastResponse(STATUS_NOT_FOUND,
@@ -66,10 +80,22 @@ class BroadcastHandler:
         return BroadcastResponse(STATUS_SUCCESS)
 
     def handle_batch(
-            self, envs: Sequence[Envelope]) -> List[BroadcastResponse]:
+            self, envs: Sequence[Envelope],
+            tps: Optional[Sequence[str]] = None) -> List[BroadcastResponse]:
         """Ingest a coalesced batch in one call (the gateway's admission
         queue ships these).  Envelopes are independent — each routes by
         its own channel header and gets its own response, exactly as if
         streamed one by one; the batching only amortizes the RPC round
-        trip and handshake-authenticated framing."""
-        return [self.handle(env) for env in envs]
+        trip and handshake-authenticated framing.
+
+        `tps`, when given, aligns a traceparent with each envelope: the
+        gateway batches many client txs into one frame, so per-tx trace
+        context rides next to the envelopes instead of on the frame."""
+        out = []
+        for i, env in enumerate(envs):
+            ctx = None
+            if tps and i < len(tps) and tps[i]:
+                ctx = tracing.tracer.context_from(tps[i])
+            with tracing.tracer.activate(ctx):
+                out.append(self.handle(env))
+        return out

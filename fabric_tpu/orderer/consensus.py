@@ -13,6 +13,7 @@ import threading
 import time
 from typing import Callable, List, Optional
 
+from fabric_tpu.ops_plane import tracing
 from fabric_tpu.orderer.blockcutter import BatchConfig, BlockCutter
 from fabric_tpu.orderer.blockwriter import BlockWriter
 from fabric_tpu.protocol import Envelope
@@ -129,9 +130,17 @@ class SoloChain(Chain):
                                     + self.cutter.config.batch_timeout_s)
 
     def _write(self, batch: List[bytes], is_config: bool = False) -> None:
-        block = self.writer.create_next_block(batch)
-        self.writer.write_block(block, is_config=is_config)
-        self.on_block(block)
+        # consensus cut: spans only when ordered under a traced broadcast
+        # (timer-thread cuts have no ambient context and record nothing)
+        with tracing.tracer.start_span(
+                "orderer.cut_block", require_parent=True,
+                attributes={"batch_size": len(batch),
+                            "is_config": is_config}) as span:
+            block = self.writer.create_next_block(batch)
+            if span.recording:
+                span.set_attribute("block", int(block.header.number))
+            self.writer.write_block(block, is_config=is_config)
+            self.on_block(block)
 
 
 # ---------------------------------------------------------------------------
@@ -265,8 +274,12 @@ class RaftChain(Chain):
             self.node.tick()
 
     def _propose(self, batch, is_config: bool) -> None:
-        self.node.propose(self._serde.encode(
-            {"cfg": is_config, "batch": list(batch)}))
+        with tracing.tracer.start_span(
+                "orderer.cut_propose", require_parent=True,
+                attributes={"batch_size": len(batch),
+                            "is_config": is_config}):
+            self.node.propose(self._serde.encode(
+                {"cfg": is_config, "batch": list(batch)}))
 
     def process_ready(self):
         """Drain the raft node: apply committed entries to the ledger and

@@ -25,6 +25,9 @@ if [[ "${1:-}" == "--collect" ]]; then
     exit 0
 fi
 
+echo "== live trace endpoints (/traces, /spans/stats) =="
+python tests/smoke_traces.py
+
 echo "== non-slow test subset =="
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 echo "OK: smoke passed"

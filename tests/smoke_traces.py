@@ -1,0 +1,112 @@
+"""Smoke probe for the observability surface (called by smoke.sh).
+
+Boots a minimal live topology (1 raft orderer, Org1/Org2 peers, SW
+provider), pushes one transaction through the gateway, then asserts the
+peer's ops endpoint serves non-empty, well-formed JSON from /traces,
+/traces/<id> and /spans/stats.  Named smoke_* (not test_*) on purpose:
+this is a script for the shell gate, not a pytest module.
+"""
+
+import json
+import sys
+import tempfile
+import time
+import urllib.request
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.config import BatchConfig
+from fabric_tpu.gateway import GatewayClient
+from fabric_tpu.node.orderer import OrdererNode, load_signing_identity
+from fabric_tpu.node.peer import PeerNode
+from fabric_tpu.node.provision import provision_network
+from fabric_tpu.protocol.txflags import ValidationCode
+
+
+def main() -> int:
+    init_factories(FactoryOpts(default="SW"))
+    with tempfile.TemporaryDirectory() as base:
+        paths = provision_network(
+            base, n_orderers=1, peer_orgs=["Org1", "Org2"], peers_per_org=1,
+            batch=BatchConfig(max_message_count=8, timeout_s=0.05))
+        orderers, peers = [], []
+        try:
+            for p in paths["orderers"]:
+                with open(p) as f:
+                    cfg = json.load(f)
+                orderers.append(
+                    OrdererNode(cfg, data_dir=cfg["data_dir"]).start())
+            for i, p in enumerate(paths["peers"]):
+                with open(p) as f:
+                    cfg = json.load(f)
+                cfg["gateway"] = {"linger_s": 0.002, "max_batch": 8}
+                if i == 0:
+                    cfg["ops_port"] = 0
+                peers.append(PeerNode(cfg, data_dir=cfg["data_dir"]).start())
+            deadline = time.time() + 60
+            while time.time() < deadline:
+                if any(o.support.chain.node.role == "leader"
+                       for o in orderers):
+                    break
+                time.sleep(0.2)
+            else:
+                print("FAIL: no raft leader", file=sys.stderr)
+                return 1
+
+            with open(paths["clients"]["Org1"]) as f:
+                cc = json.load(f)
+            signer = load_signing_identity(
+                cc["mspid"], cc["cert_pem"].encode(), cc["key_pem"].encode())
+            gw = GatewayClient(peers[0].rpc.addr, signer, peers[0].msps,
+                               channel_id="ch")
+            try:
+                code, _ = gw.submit_transaction(
+                    "assets", "create", [b"smoke1", b"v"],
+                    commit_timeout_s=60.0)
+            finally:
+                gw.close()
+            if code != int(ValidationCode.VALID):
+                print(f"FAIL: tx code {code}", file=sys.stderr)
+                return 1
+
+            host, port = peers[0].ops.addr
+
+            def get(path):
+                url = f"http://{host}:{port}{path}"
+                with urllib.request.urlopen(url, timeout=5) as r:
+                    return json.loads(r.read())
+
+            # the trace finalizes once server-side fragments end
+            tid, deadline = None, time.time() + 10
+            while tid is None and time.time() < deadline:
+                recent = get("/traces")["recent"]
+                tid = next((r["trace_id"] for r in recent
+                            if r["root"] == "client.tx"), None)
+                if tid is None:
+                    time.sleep(0.1)
+            if tid is None:
+                print("FAIL: no client.tx trace in /traces", file=sys.stderr)
+                return 1
+            doc = get(f"/traces/{tid}")
+            events = [e for e in doc["traceEvents"] if e.get("ph") == "X"]
+            if not events:
+                print("FAIL: /traces/<id> has no span events",
+                      file=sys.stderr)
+                return 1
+            stats = get("/spans/stats")
+            if not stats.get("enabled") or not stats.get("spans"):
+                print(f"FAIL: /spans/stats malformed: {stats}",
+                      file=sys.stderr)
+                return 1
+            print(f"OK: trace {tid} ({len(events)} spans), "
+                  f"{len(stats['spans'])} span stages in /spans/stats")
+            return 0
+        finally:
+            for n in peers + orderers:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,331 @@
+"""Tx tracing + flight recorder (fabric_tpu/ops_plane/tracing).
+
+Unit coverage: traceparent round-trip, recorder bounds/eviction with
+slowest-retention, sampling-off propagation, Chrome trace-event JSON
+shape.  Live coverage on the same in-process topology shape as
+test_gateway (3 raft orderers, Org1/Org2 peers, SW provider): a traced
+client tx yields ONE retrievable trace covering gateway admission,
+endorsement, ordering, device batch-verify (with batch size), MVCC and
+commit notification — over the recorder API and over the peer's ops
+HTTP endpoint — and concurrent traced submits keep their traces
+distinct (thread safety).
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from fabric_tpu.config import BatchConfig
+from fabric_tpu.node.orderer import OrdererNode, load_signing_identity
+from fabric_tpu.node.peer import PeerNode
+from fabric_tpu.node.provision import provision_network
+from fabric_tpu.ops_plane import tracing
+from fabric_tpu.ops_plane.tracing import (
+    FlightRecorder,
+    Tracer,
+    format_traceparent,
+    parse_traceparent,
+)
+from fabric_tpu.protocol.txflags import ValidationCode
+
+
+# ---------------------------------------------------------------------------
+# unit: context propagation primitives
+# ---------------------------------------------------------------------------
+
+def test_traceparent_round_trip():
+    t = Tracer(FlightRecorder())
+    t.enabled = True
+    span = t.start_span("root")
+    tp = format_traceparent(span.context)
+    assert tp.startswith("00-") and tp.endswith("-01")
+    ctx = parse_traceparent(tp)
+    assert ctx.trace_id == span.context.trace_id
+    assert ctx.span_id == span.context.span_id
+    assert ctx.sampled and ctx.remote
+    span.end()
+    # malformed inputs never raise, they just don't propagate
+    for bad in (None, 7, "", "00-zz-xx-01", "00-abc-def-01",
+                "00-" + "0" * 32, "no-dashes-at-all"):
+        assert parse_traceparent(bad) is None
+
+
+def test_recorder_bounds_eviction_and_slowest_retention():
+    rec = FlightRecorder(max_traces=4, max_slow=2)
+    durs = [0.01, 5.0, 0.02, 0.03, 3.0, 0.04, 0.05, 0.06, 0.07, 0.08]
+    for i, d in enumerate(durs):
+        rec.add({"trace_id": f"t{i}", "root_name": "r", "start_wall": 0.0,
+                 "duration_s": d, "spans": [{"name": "r"}]})
+    listing = rec.list()
+    assert len(listing["recent"]) == 4          # ring bounded
+    assert [r["trace_id"] for r in listing["recent"]] == \
+        ["t9", "t8", "t7", "t6"]                # newest first
+    # the two slowest survived eviction from the ring
+    assert [r["trace_id"] for r in listing["slowest"]] == ["t1", "t4"]
+    assert rec.get("t1") is not None            # reachable though evicted
+    assert rec.get("t0") is None                # fast + evicted -> gone
+    rec.clear()
+    assert rec.list() == {"recent": [], "slowest": []}
+
+
+def test_sampling_zero_records_nothing_but_propagates():
+    t = Tracer(FlightRecorder())
+    t.enabled = True
+    t.sample_rate = 0.0
+    with t.start_span("root") as root:
+        assert root.recording and not root.context.sampled
+        tp = format_traceparent(root.context)
+        assert tp.endswith("-00")               # unsampled flag on the wire
+        with t.start_span("child", require_parent=True) as child:
+            assert not child.context.sampled    # decision rides the flags
+    # server side of the unsampled context: span exists, records nothing
+    ctx = t.context_from(tp)
+    assert ctx is not None and not ctx.sampled
+    t.start_span("rpc.x", parent=ctx, require_parent=True).end()
+    assert t.recorder.list() == {"recent": [], "slowest": []}
+    # but per-stage stats still observed (histograms are unsampled)
+    assert t.span_stats()["root"]["count"] == 1
+
+
+def test_disabled_tracer_is_noop_everywhere():
+    t = Tracer(FlightRecorder())
+    assert t.start_span("x") is tracing.NOOP_SPAN
+    assert t.traceparent() is None
+    assert t.context_from("00-" + "a" * 32 + "-" + "b" * 16 + "-01") is None
+    t.record_span("y", 0.0, 1.0)
+    assert t.recorder.list() == {"recent": [], "slowest": []}
+
+
+def test_chrome_export_shape_and_late_span_merge():
+    t = Tracer(FlightRecorder())
+    t.enabled = True
+    with t.start_span("root", attributes={"k": "v"}) as root:
+        tid = root.context.trace_id
+        t.start_span("child").end(end_time=root.start + 0.25)
+    # a span ending AFTER its trace finalized still lands in the record
+    late = t.start_span("late", parent=root.context)
+    late.end()
+    doc = t.export_chrome(tid)
+    assert json.loads(json.dumps(doc))          # valid JSON end to end
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"root", "child", "late"}
+    for e in xs:
+        for key in ("name", "ph", "ts", "dur", "pid", "tid", "args"):
+            assert key in e, f"{key} missing from {e['name']}"
+        assert e["dur"] >= 0
+    root_ev = next(e for e in xs if e["name"] == "root")
+    assert root_ev["args"]["k"] == "v"
+    assert root_ev["args"]["trace_id"] == tid
+    # thread lanes carry metadata names
+    assert any(e["ph"] == "M" and e["name"] == "thread_name"
+               for e in doc["traceEvents"])
+    assert t.export_chrome("f" * 32) is None
+
+
+# ---------------------------------------------------------------------------
+# live topology
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    """Same shape as test_gateway's fixture; node constructors enable
+    the process tracer via their localconfig `tracing` sub-dict."""
+    base = str(tmp_path_factory.mktemp("trnet"))
+    paths = provision_network(
+        base, n_orderers=3, peer_orgs=["Org1", "Org2"], peers_per_org=1,
+        batch=BatchConfig(max_message_count=8, timeout_s=0.1))
+    orderers, peers = [], []
+    try:
+        for p in paths["orderers"]:
+            with open(p) as f:
+                cfg = json.load(f)
+            orderers.append(OrdererNode(cfg, data_dir=cfg["data_dir"]).start())
+        for i, p in enumerate(paths["peers"]):
+            with open(p) as f:
+                cfg = json.load(f)
+            cfg["gateway"] = {"linger_s": 0.002, "max_batch": 8,
+                              "broadcast_deadline_s": 20.0}
+            if i == 0:
+                cfg["ops_port"] = 0    # /traces + /spans/stats over HTTP
+            peers.append(PeerNode(cfg, data_dir=cfg["data_dir"]).start())
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if any(o.support.chain.node.role == "leader" for o in orderers):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("no raft leader elected")
+        yield {"paths": paths, "orderers": orderers, "peers": peers}
+    finally:
+        for n in peers + orderers:
+            try:
+                n.stop()
+            except Exception:
+                pass
+        tracing.tracer.sample_rate = 1.0
+
+
+def _client(net, org="Org1"):
+    from fabric_tpu.gateway import GatewayClient
+    with open(net["paths"]["clients"][org]) as f:
+        cc = json.load(f)
+    signer = load_signing_identity(cc["mspid"], cc["cert_pem"].encode(),
+                                   cc["key_pem"].encode())
+    peer = net["peers"][0]
+    return GatewayClient(peer.rpc.addr, signer, peer.msps, channel_id="ch")
+
+
+def _trace_names(trace_id, deadline_s=10.0):
+    """Poll until the trace (plus linked block trace) holds a stable set
+    of span names — late fragments (device resolve, server-side RPC
+    ends) merge into the record shortly after the client returns."""
+    names, doc = set(), None
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        doc = tracing.tracer.export_chrome(trace_id)
+        if doc is not None:
+            names = {e["name"] for e in doc["traceEvents"]
+                     if e["ph"] == "X"}
+            if {"bccsp.batch_verify", "ledger.mvcc",
+                    "gateway.commit_wait"} <= names:
+                break
+        time.sleep(0.1)
+    return names, doc
+
+
+def test_live_tx_trace_covers_pipeline(net):
+    """One traced tx -> one retrievable trace spanning admission,
+    endorsement, ordering, device batch-verify, MVCC and commit
+    notification, with the block trace stitched in by link."""
+    assert tracing.tracer.enabled     # node boot configured the tracer
+    gw = _client(net)
+    try:
+        code, _ = gw.submit_transaction("assets", "create",
+                                        [b"traced1", b"alice"],
+                                        commit_timeout_s=60.0)
+    finally:
+        gw.close()
+    assert code == int(ValidationCode.VALID)
+
+    # the client.tx root is the newest request-family trace; it
+    # finalizes only once the server-side RPC fragments end, which can
+    # trail the client return by a beat — poll for it
+    tid, deadline = None, time.time() + 10
+    while tid is None and time.time() < deadline:
+        recent = tracing.tracer.recorder.list()["recent"]
+        tid = next((r["trace_id"] for r in recent
+                    if r["root"] == "client.tx"), None)
+        if tid is None:
+            time.sleep(0.05)
+    assert tid is not None, recent
+    names, doc = _trace_names(tid)
+    for required in ("client.tx", "gateway.queue_wait", "gateway.order",
+                     "endorser.validate", "endorser.simulate",
+                     "endorser.sign", "orderer.broadcast",
+                     "committer.store_block", "bccsp.batch_verify",
+                     "ledger.mvcc", "gateway.commit_wait"):
+        assert required in names, f"{required} missing: {sorted(names)}"
+    assert doc["otherData"]["n_traces_merged"] >= 2   # block trace linked
+    # device verify span carries batch size + device wall time
+    bv = next(e for e in doc["traceEvents"]
+              if e.get("ph") == "X" and e["name"] == "bccsp.batch_verify")
+    assert bv["args"]["batch_size"] >= 1
+    assert bv["args"]["block_until_ready_s"] >= 0
+
+
+def test_live_trace_over_ops_http(net):
+    ops = net["peers"][0].ops
+    assert ops is not None
+    host, port = ops._httpd.server_address[:2]
+
+    def get(path):
+        with urllib.request.urlopen(
+                f"http://{host}:{port}{path}", timeout=5) as r:
+            return json.loads(r.read())
+
+    listing = get("/traces")
+    assert listing["recent"], "flight recorder empty over HTTP"
+    tid = listing["recent"][0]["trace_id"]
+    doc = get(f"/traces/{tid}")
+    assert doc["otherData"]["trace_id"] == tid
+    assert any(e["ph"] == "X" for e in doc["traceEvents"])
+
+    stats = get("/spans/stats")
+    assert stats["enabled"] is True
+    assert 0.0 <= stats["sample_rate"] <= 1.0
+    for stage in ("gateway.queue_wait", "bccsp.batch_verify"):
+        assert stage in stats["spans"], sorted(stats["spans"])
+        assert stats["spans"][stage]["count"] >= 1
+
+
+def test_live_concurrent_traces_stay_distinct(net):
+    """Thread safety: parallel traced submits each finalize their own
+    trace with their own txid — no span leaks across traces."""
+    tids, errors, lock = {}, [], threading.Lock()
+
+    def run(tag):
+        gw = _client(net)
+        try:
+            with tracing.tracer.start_span("test.tx",
+                                           attributes={"tag": tag}) as span:
+                code, _ = gw.submit_transaction(
+                    "assets", "create", [f"conc-{tag}".encode(), b"x"],
+                    commit_timeout_s=60.0)
+            with lock:
+                tids[tag] = span.context.trace_id
+            if code != int(ValidationCode.VALID):
+                raise AssertionError(f"{tag}: code {code}")
+        except Exception as exc:
+            with lock:
+                errors.append((tag, exc))
+        finally:
+            gw.close()
+
+    threads = [threading.Thread(target=run, args=(f"w{i}",))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    assert not errors, errors
+    assert len(set(tids.values())) == 4
+    for tag, tid in tids.items():
+        names, doc = _trace_names(tid)
+        assert "gateway.commit_wait" in names, (tag, sorted(names))
+        tags = {e["args"]["tag"] for e in doc["traceEvents"]
+                if e.get("ph") == "X" and "tag" in e.get("args", {})}
+        assert tags == {tag}                   # nothing bled across
+
+
+def test_live_sampling_zero_drops_new_traces(net):
+    """With sample_rate 0 the pipeline still works but the recorder
+    gains no new traces: the unsampled decision propagates end to end."""
+    def recorded_ids():
+        return {r["trace_id"]
+                for r in tracing.tracer.recorder.list()["recent"]}
+
+    time.sleep(0.5)           # let prior tests' fragments finalize
+    before = recorded_ids()
+    tracing.tracer.sample_rate = 0.0
+    try:
+        gw = _client(net)
+        try:
+            code, _ = gw.submit_transaction("assets", "create",
+                                            [b"unsampled1", b"y"],
+                                            commit_timeout_s=60.0)
+        finally:
+            gw.close()
+        assert code == int(ValidationCode.VALID)
+        time.sleep(0.5)       # let any stray fragments finalize
+        assert recorded_ids() <= before, "unsampled tx left a trace"
+    finally:
+        tracing.tracer.sample_rate = 1.0
