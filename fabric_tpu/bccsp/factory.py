@@ -26,6 +26,8 @@ class FactoryOpts:
     default: str = "JAXTPU"          # "SW" | "JAXTPU"
     require_low_s: bool = True
     use_mesh: bool = False           # shard batches over all visible devices
+    degrade: bool = False            # wrap in DegradingProvider (breaker
+    #                                  + SW fallback on device sickness)
 
 
 def enable_compile_cache() -> None:
@@ -63,6 +65,10 @@ def init_factories(opts: Optional[FactoryOpts] = None) -> Provider:
         _default = JaxTpuProvider(require_low_s=opts.require_low_s, mesh=mesh)
     else:
         raise ValueError(f"unknown BCCSP provider {opts.default!r}")
+    if opts.degrade:
+        from .degrade import DegradingProvider
+        _default = DegradingProvider(
+            _default, SoftwareProvider(require_low_s=opts.require_low_s))
     logger.info("BCCSP default provider: %s", _default.name)
     return _default
 

@@ -5,8 +5,12 @@ identities; RpcServer/RpcConnection: unary + streaming + one-way RPC on
 top — the transport under Broadcast/Deliver/cluster/gossip.
 """
 
+from . import faults
 from .secure import HandshakeError, SecureChannel, SecureServer, dial
-from .rpc import RpcConnection, RpcError, RpcServer, connect
+from .rpc import (RpcClosed, RpcConnection, RpcError, RpcServer,
+                  RpcTimeout, connect)
+from .faults import FaultPlan, FaultRule
 
 __all__ = ["SecureChannel", "SecureServer", "HandshakeError", "dial",
-           "RpcConnection", "RpcServer", "RpcError", "connect"]
+           "RpcConnection", "RpcServer", "RpcError", "RpcTimeout",
+           "RpcClosed", "connect", "faults", "FaultPlan", "FaultRule"]

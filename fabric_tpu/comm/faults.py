@@ -1,0 +1,329 @@
+"""Deterministic, seeded fault-injection plane for the comm layer.
+
+The robustness analogue of the tracing plane: a process-global
+`FaultPlan` that the RPC transport consults on every outbound frame and
+every dial.  A plan holds an ordered list of `FaultRule`s — matched by
+RPC method, remote endpoint, and frame kind — whose actions model the
+failure modes a real network serves up:
+
+  drop      the frame never leaves (the caller sees an RpcTimeout)
+  delay     the frame is held for a fixed latency before sending
+  dup       the frame is sent twice (duplicate delivery; downstream
+            dedup — gateway txid window, committer replay guard — must
+            absorb it)
+  reorder   the frame is parked and released AFTER the next frame on
+            the same channel (adjacent swap)
+  error     the injection site raises RpcError (a loud transport fault)
+
+plus connection-level faults: `sever(addr)` refuses new dials to an
+endpoint and closes the live channels already dialed to it, and
+`isolate(addrs)` does the same for a node group (the reachable half of
+a network partition — in-process nodes share one address space, so the
+partition is expressed as "this group is unreachable"; `heal()`
+restores it).
+
+Determinism: every probabilistic decision consumes one draw from ONE
+seeded PRNG under the plan lock, in frame-send order.  A test that
+replays the same workload single-threaded against the same seed sees
+the same fault sequence; concurrent topologies stay statistically
+reproducible (same fault mix and rates) which is what the convergence
+assertions need.
+
+Production cost: the hot path is a single module-attribute load
+(`faults._PLAN is None`) per frame — no plan, no work.  `install()` is
+for tests and chaos drills only.
+
+Observability: every fired fault bumps `fault_injected_total` in the
+ops-plane registry, emits a `fault.<action>` span event into the
+ambient trace (so /traces/<id> shows WHY a tx was slow under chaos),
+and is counted in the plan's own snapshot, exported by `GET /faults`.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import logging
+import random
+import threading
+import time
+import weakref
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+logger = logging.getLogger("fabric_tpu.comm.faults")
+
+# THE hot-path gate: transport code checks `faults._PLAN is not None`
+# (one attribute load) before doing anything else.
+_PLAN: Optional["FaultPlan"] = None
+_INSTALL_LOCK = threading.Lock()
+
+# Every dial-side channel registers here (a WeakSet.add, off the frame
+# hot path) so a plan installed LATER can still sever pre-existing
+# connections.
+_DIALED: "weakref.WeakSet" = weakref.WeakSet()
+_DIALED_LOCK = threading.Lock()
+
+ACTIONS = ("drop", "delay", "dup", "reorder", "error")
+
+
+def register_channel(ch) -> None:
+    with _DIALED_LOCK:
+        _DIALED.add(ch)
+
+
+def _addr_str(addr) -> str:
+    if isinstance(addr, str):
+        return addr
+    try:
+        host, port = addr[0], addr[1]
+        return f"{host}:{port}"
+    except Exception:
+        return str(addr)
+
+
+@dataclass
+class FaultRule:
+    """One match+action rule.  Probabilities are independent per action;
+    at most one action fires per frame (first match in ACTIONS order
+    wins, so a rule with drop=1.0 never also duplicates)."""
+
+    method: str = "*"            # fnmatch pattern on the RPC method
+    peer: Optional[str] = None   # fnmatch on "host:port" (None = any)
+    kind: str = "*"              # "req" | "cast" | "resp" | "stream" | "*"
+    drop: float = 0.0
+    delay: float = 0.0           # probability of delaying
+    delay_s: float = 0.01        # how long a delayed frame is held
+    dup: float = 0.0
+    reorder: float = 0.0
+    error: float = 0.0
+    max_fires: Optional[int] = None   # stop firing after N faults
+    fires: int = field(default=0, compare=False)
+
+    def matches(self, method: str, peer: str, kind: str) -> bool:
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if not fnmatch.fnmatchcase(kind, self.kind):
+            return False
+        if not fnmatch.fnmatchcase(method, self.method):
+            return False
+        if self.peer is not None and not fnmatch.fnmatchcase(
+                peer, self.peer):
+            return False
+        return True
+
+    def as_dict(self) -> dict:
+        return {"method": self.method, "peer": self.peer, "kind": self.kind,
+                "drop": self.drop, "delay": self.delay,
+                "delay_s": self.delay_s, "dup": self.dup,
+                "reorder": self.reorder, "error": self.error,
+                "max_fires": self.max_fires, "fires": self.fires}
+
+
+class FaultInjected(Exception):
+    """Raised at an injection site for `error` faults.  Transport code
+    re-raises it as RpcError so callers exercise their normal failure
+    handling — the type exists so logs can tell injected faults from
+    organic ones."""
+
+
+class FaultPlan:
+    """A seeded set of fault rules + connection-level faults.
+
+    Build one, add rules (chainable), then `faults.install(plan)`:
+
+        plan = (FaultPlan(seed=7)
+                .rule(method="broadcast*", drop=0.2, delay=0.3,
+                      delay_s=0.05, dup=0.2))
+        faults.install(plan)
+        ...
+        faults.uninstall()
+    """
+
+    def __init__(self, seed: int = 0, name: str = ""):
+        self.seed = int(seed)
+        self.name = name or f"plan-{seed}"
+        self._rand = random.Random(self.seed)
+        self._lock = threading.Lock()
+        self.rules: List[FaultRule] = []
+        self._severed: set = set()              # "host:port" strings
+        # per-channel parked frame for `reorder` (adjacent swap)
+        self._held: Dict[int, Callable[[], None]] = {}
+        self.fired: Dict[str, int] = {a: 0 for a in ACTIONS}
+        self.fired["sever_refused"] = 0
+        self.installed_at: Optional[float] = None
+
+    # -- building -----------------------------------------------------------
+
+    def rule(self, **kw) -> "FaultPlan":
+        self.rules.append(FaultRule(**kw))
+        return self
+
+    # -- connection-level faults --------------------------------------------
+
+    def sever(self, addr) -> "FaultPlan":
+        """Refuse new dials to `addr` and cut live channels dialed to it."""
+        a = _addr_str(addr)
+        with self._lock:
+            self._severed.add(a)
+        with _DIALED_LOCK:
+            victims = [ch for ch in _DIALED
+                       if getattr(ch, "remote_addr_str", None) == a]
+        for ch in victims:
+            try:
+                ch.close()
+            except Exception:
+                pass
+        logger.info("fault plan %s: severed %s (%d live channels cut)",
+                    self.name, a, len(victims))
+        return self
+
+    def isolate(self, addrs: Sequence) -> "FaultPlan":
+        """Sever a node group: the reachable expression of a partition."""
+        for a in addrs:
+            self.sever(a)
+        return self
+
+    def heal(self, addr=None) -> "FaultPlan":
+        """Clear severs (one endpoint, or all) and release parked frames."""
+        with self._lock:
+            if addr is None:
+                self._severed.clear()
+            else:
+                self._severed.discard(_addr_str(addr))
+            held = list(self._held.values())
+            self._held.clear()
+        for send in held:
+            try:
+                send()
+            except Exception:
+                pass
+        return self
+
+    def is_severed(self, addr) -> bool:
+        with self._lock:
+            return _addr_str(addr) in self._severed
+
+    # -- the frame hook ------------------------------------------------------
+
+    def apply(self, channel_key: int, method: str, peer, kind: str,
+              send: Callable[[], None]) -> None:
+        """Decide and apply faults for one outbound frame.  `send` is a
+        closure performing the actual transmission; it is called 0, 1 or
+        2 times depending on the decision."""
+        peer_s = _addr_str(peer) if peer is not None else ""
+        action = None
+        delay_s = 0.0
+        with self._lock:
+            for r in self.rules:
+                if not r.matches(method, peer_s, kind):
+                    continue
+                # one PRNG draw per candidate action, in fixed order
+                for a in ACTIONS:
+                    p = getattr(r, a if a != "delay" else "delay")
+                    if p > 0.0 and self._rand.random() < p:
+                        action = a
+                        delay_s = r.delay_s
+                        r.fires += 1
+                        break
+                if action is not None:
+                    break
+            if action is not None:
+                self.fired[action] += 1
+            # reorder bookkeeping happens under the lock
+            if action == "reorder":
+                prev = self._held.pop(channel_key, None)
+                self._held[channel_key] = send
+            elif self._held:
+                prev = self._held.pop(channel_key, None)
+            else:
+                prev = None
+        if action is not None:
+            self._observe(action, method, peer_s)
+        if action is None or action == "dup":
+            send()
+            if action == "dup":
+                send()
+        elif action == "drop":
+            pass                      # the frame dies here
+        elif action == "delay":
+            time.sleep(delay_s)
+            send()
+        elif action == "error":
+            if prev is not None:
+                prev()
+            raise FaultInjected(
+                f"injected transport error on {method!r} -> {peer_s}")
+        # action == "reorder": this frame stays parked; fall through
+        if prev is not None and action != "error":
+            prev()                    # released AFTER the newer frame
+
+    def _observe(self, action: str, method: str, peer: str) -> None:
+        try:
+            from fabric_tpu.ops_plane import registry, tracing
+            registry.counter(
+                "fault_injected_total",
+                "frames faulted by the injection plane").add(
+                    1, action=action, method=method)
+            # annotate the ambient trace: /traces/<id> shows why a tx
+            # crawled under chaos
+            tracing.event("fault." + action, method=method, peer=peer)
+        except Exception:
+            pass                      # observability never breaks the plane
+
+    # -- introspection (GET /faults) ----------------------------------------
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"name": self.name, "seed": self.seed,
+                    "installed_at": self.installed_at,
+                    "rules": [r.as_dict() for r in self.rules],
+                    "severed": sorted(self._severed),
+                    "held_frames": len(self._held),
+                    "fired": dict(self.fired)}
+
+
+# -- process-global install ---------------------------------------------------
+
+
+def install(plan: FaultPlan) -> FaultPlan:
+    """Make `plan` the process-global fault plan (tests/chaos only)."""
+    global _PLAN
+    with _INSTALL_LOCK:
+        plan.installed_at = time.time()
+        _PLAN = plan
+    logger.warning("fault plan %s INSTALLED (seed=%d, %d rules)",
+                   plan.name, plan.seed, len(plan.rules))
+    return plan
+
+
+def uninstall() -> None:
+    global _PLAN
+    with _INSTALL_LOCK:
+        plan, _PLAN = _PLAN, None
+    if plan is not None:
+        # release parked frames so no call wedges past the drill
+        plan.heal()
+        logger.warning("fault plan %s removed; fired=%s",
+                       plan.name, plan.fired)
+
+
+def active() -> Optional[FaultPlan]:
+    return _PLAN
+
+
+# -- ops-plane surface --------------------------------------------------------
+
+
+def register_routes(ops) -> None:
+    """Mount `GET /faults` on an OperationsServer: the active plan's
+    snapshot, or {"active": false} in production (no plan)."""
+
+    def _faults(path: str, body: bytes):
+        plan = _PLAN
+        if plan is None:
+            return 200, {"active": False}
+        out = plan.snapshot()
+        out["active"] = True
+        return 200, out
+
+    ops.register_route("GET", "/faults", _faults)

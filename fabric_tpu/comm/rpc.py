@@ -17,11 +17,13 @@ from __future__ import annotations
 import logging
 import time as _time
 import threading
+import weakref
 from typing import Callable, Dict, Optional
 
 from fabric_tpu.ops_plane import tracing
 from fabric_tpu.utils import serde
 
+from . import faults as _faults
 from .secure import SecureChannel, SecureServer, dial
 
 logger = logging.getLogger("fabric_tpu.comm.rpc")
@@ -29,6 +31,30 @@ logger = logging.getLogger("fabric_tpu.comm.rpc")
 
 class RpcError(Exception):
     pass
+
+
+class RpcTimeout(RpcError):
+    """No response within the deadline (frame lost, peer wedged, or the
+    reply is still in flight)."""
+
+
+class RpcClosed(RpcError):
+    """The underlying channel is gone — retry means re-dialing, not
+    waiting.  Replaces the old string-matched 'connection closed'."""
+
+
+def _send_frame(ch: SecureChannel, frame: dict, method: str,
+                kind: str) -> None:
+    """All outbound frames funnel through here so the fault plane sees
+    them.  Production cost: one module-attribute load when no plan is
+    installed."""
+    data = serde.encode(frame)
+    plan = _faults._PLAN
+    if plan is None:
+        ch.send(data)
+    else:
+        plan.apply(id(ch), method, getattr(ch, "remote_addr_str", None),
+                   kind, lambda: ch.send(data))
 
 
 class RpcConnection:
@@ -57,7 +83,7 @@ class RpcConnection:
                 self._closed = True
                 waiters = list(self._waiters.values())
             for w in waiters:
-                w.push({"kind": "resp", "ok": False,
+                w.push({"kind": "resp", "ok": False, "closed": True,
                         "error": "connection closed"})
 
     def call(self, method: str, body: dict, timeout: float = 30.0) -> dict:
@@ -65,6 +91,8 @@ class RpcConnection:
         msg = w.next(timeout)
         self._finish(w)
         if msg.get("kind") == "resp" and not msg.get("ok", False):
+            if msg.get("closed"):
+                raise RpcClosed(msg.get("error", "connection closed"))
             raise RpcError(msg.get("error", "remote error"))
         return msg.get("body", {})
 
@@ -79,6 +107,9 @@ class RpcConnection:
                 if msg.get("kind") == "resp":
                     finished = True
                     if not msg.get("ok", False):
+                        if msg.get("closed"):
+                            raise RpcClosed(
+                                msg.get("error", "connection closed"))
                         raise RpcError(msg.get("error", "remote error"))
                     return
                 yield msg.get("body", {})
@@ -99,12 +130,17 @@ class RpcConnection:
         tp = tracing.tracer.traceparent()
         if tp:
             frame["tp"] = tp
-        self.channel.send(serde.encode(frame))
+        try:
+            _send_frame(self.channel, frame, method, "cast")
+        except _faults.FaultInjected as exc:
+            raise RpcError(str(exc)) from None
+        except OSError as exc:
+            raise RpcClosed(f"connection closed: {exc}") from None
 
     def _start(self, method, body) -> "_Waiter":
         with self._lock:
             if self._closed:
-                raise RpcError("connection closed")
+                raise RpcClosed("connection closed")
             rid = self._next_id
             self._next_id += 1
             w = _Waiter(rid)
@@ -113,7 +149,14 @@ class RpcConnection:
         tp = tracing.tracer.traceparent()
         if tp:
             frame["tp"] = tp
-        self.channel.send(serde.encode(frame))
+        try:
+            _send_frame(self.channel, frame, method, "req")
+        except _faults.FaultInjected as exc:
+            self._finish(w)
+            raise RpcError(str(exc)) from None
+        except OSError as exc:
+            self._finish(w)
+            raise RpcClosed(f"connection closed: {exc}") from None
         return w
 
     def _finish(self, w: "_Waiter") -> None:
@@ -138,7 +181,7 @@ class _Waiter:
     def next(self, timeout: float):
         with self._cond:
             if not self._cond.wait_for(lambda: self._queue, timeout=timeout):
-                raise RpcError("rpc timeout")
+                raise RpcTimeout("rpc timeout")
             return self._queue.pop(0)
 
 
@@ -156,6 +199,10 @@ class RpcServer:
         self._cast: Dict[str, Callable] = {}
         self._cancelled: dict = {}         # (channel id, rid) -> True
         self._cancel_lock = threading.Lock()
+        # accepted channels, so stop() can tear down live connections —
+        # without this a stopped server's port stays claimed by
+        # ESTABLISHED sockets and a restart on the same port fails
+        self._channels: "weakref.WeakSet" = weakref.WeakSet()
         self.server = SecureServer(host, port, signer, msps, self._on_channel)
 
     @property
@@ -177,8 +224,14 @@ class RpcServer:
 
     def stop(self) -> None:
         self.server.stop()
+        for ch in list(self._channels):
+            try:
+                ch.close()
+            except OSError:
+                pass
 
     def _on_channel(self, ch: SecureChannel) -> None:
+        self._channels.add(ch)
         threading.Thread(target=self._conn_loop, args=(ch,),
                          daemon=True).start()
 
@@ -231,17 +284,18 @@ class RpcServer:
                     with self._cancel_lock:
                         if self._cancelled.pop(key, False):
                             return
-                    ch.send(serde.encode({"kind": "stream", "id": rid,
-                                          "body": item, "done": False}))
-                ch.send(serde.encode({"kind": "resp", "id": rid, "ok": True,
-                                      "body": {}}))
+                    _send_frame(ch, {"kind": "stream", "id": rid,
+                                     "body": item, "done": False},
+                                method, "stream")
+                _send_frame(ch, {"kind": "resp", "id": rid, "ok": True,
+                                 "body": {}}, method, "resp")
                 return
             fn = self._unary.get(method)
             if fn is None:
                 raise RpcError(f"unknown method {method!r}")
             out = fn(body, ch.peer_identity)
-            ch.send(serde.encode({"kind": "resp", "id": rid, "ok": True,
-                                  "body": out or {}}))
+            _send_frame(ch, {"kind": "resp", "id": rid, "ok": True,
+                             "body": out or {}}, method, "resp")
         except Exception as exc:
             ok = False
             if span.recording:

@@ -42,6 +42,8 @@ from fabric_tpu.crypto import (
 
 from fabric_tpu.utils import serde
 
+from . import faults as _faults
+
 _FRAME = struct.Struct("<I")
 MAX_FRAME = 64 * 1024 * 1024
 
@@ -87,6 +89,9 @@ class SecureChannel:
         self._send_ctr = 0
         self._recv_ctr = 0
         self._wlock = threading.Lock()
+        # "host:port" this channel was dialed to (None on the accept side);
+        # lets the fault plane sever by endpoint
+        self.remote_addr_str: Optional[str] = None
 
     def send(self, payload: bytes) -> None:
         with self._wlock:
@@ -155,10 +160,17 @@ def _handshake(sock: socket.socket, signer, msps: Dict,
 
 
 def dial(addr, signer, msps: Dict, timeout: float = 10.0) -> SecureChannel:
+    plan = _faults._PLAN
+    if plan is not None and plan.is_severed(addr):
+        plan.fired["sever_refused"] += 1
+        raise ConnectionRefusedError(
+            f"fault plane: endpoint {_faults._addr_str(addr)} is severed")
     sock = socket.create_connection(addr, timeout=timeout)
     sock.settimeout(timeout)
     ch = _handshake(sock, signer, msps, initiator=True)
     sock.settimeout(None)
+    ch.remote_addr_str = _faults._addr_str(addr)
+    _faults.register_channel(ch)
     return ch
 
 

@@ -75,6 +75,9 @@ class Committer:
         from fabric_tpu.protocol.txflags import TxFlags, ValidationCode
         from fabric_tpu.protocol.types import META_TXFLAGS
 
+        replayed = self._check_replay(block)
+        if replayed is not None:
+            return replayed
         vr = self.validator.validate(block)
         # Commit-time config validation happens BEFORE the commit: a config
         # tx that fails (wrong sequence, Admins unsatisfied) must be
@@ -200,6 +203,40 @@ class Committer:
                 logger.exception("config application failed for block %d",
                                  block.header.number)
         return BlockCommitResult(vr, stats, final)
+
+    def _check_replay(self, block: Block) -> Optional[BlockCommitResult]:
+        """Idempotent re-commit: a block we already hold (deliver retry
+        after a severed stream, duplicated gossip push, orderer resend
+        after crash recovery) is acknowledged without re-validating,
+        re-committing, or re-notifying listeners — IF it is the same
+        block.  The same number with a different header hash is a fork
+        and stays a hard error."""
+        num = int(block.header.number)
+        if num >= self.ledger.height:
+            return None
+        from fabric_tpu.protocol import block_header_hash
+        from fabric_tpu.protocol.txflags import TxFlags
+        from fabric_tpu.protocol.types import META_TXFLAGS
+        stored = self.ledger.blockstore.get_by_number(num)
+        if block_header_hash(stored.header) != block_header_hash(
+                block.header):
+            raise ValueError(
+                f"replayed block {num} does not match the committed "
+                f"block (divergent header hash)")
+        jlog(logger, "committer.replayed_block",
+             channel=self.validator.channel_id, block=num,
+             height=self.ledger.height)
+        try:
+            from fabric_tpu.ops_plane import registry
+            registry.counter(
+                "committer_replayed_blocks_total",
+                "duplicate blocks acknowledged idempotently").add(
+                    1, channel=self.validator.channel_id)
+        except Exception:
+            pass
+        tracing.event("committer.replay", block=num)
+        final = TxFlags.from_bytes(stored.metadata.items[META_TXFLAGS])
+        return BlockCommitResult(None, None, final)
 
     @staticmethod
     def _record_phase_spans(t0: float, stats) -> None:

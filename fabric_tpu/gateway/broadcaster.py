@@ -1,14 +1,32 @@
-"""Batched orderer broadcast with backoff + failover.
+"""Batched orderer broadcast behind per-orderer circuit breakers.
 
 The transmit half of the gateway: coalesced envelope batches go to one
-orderer as a single `broadcast_batch` RPC; connection failures and
-SERVICE_UNAVAILABLE responses (no raft leader, halted chain) rotate to
-the next orderer under capped exponential backoff — the same policy
-the deliver plane uses in gossip/blocksprovider.py (failures counter,
-min(max, base * 2^failures)).  Per-envelope outcomes come back
-independently: a 4xx (bad envelope, unknown channel, filter veto) is
-final for that envelope only, while 503s requeue for the next attempt
-until the deadline lapses.
+orderer as a single `broadcast_batch` RPC.  Where PR 1 rotated blindly
+through the orderer list under one shared backoff, each orderer now has
+its own breaker + health score:
+
+  CLOSED      normal traffic; consecutive failures are counted
+  OPEN        `failure_threshold` consecutive failures tripped it; no
+              traffic until `open_until` (exponential per-trip cooldown)
+  HALF_OPEN   cooldown lapsed; ONE probe batch is allowed through —
+              success closes the breaker, failure re-opens it with a
+              longer cooldown
+
+Selection is sticky on the current orderer while its breaker is CLOSED
+(keeps one warm connection, preserves batch affinity), and otherwise
+prefers the healthiest candidate: CLOSED beats HALF_OPEN beats OPEN,
+ties broken by latency EWMA then failure history.  When every breaker
+is OPEN the earliest-expiring one is force-probed — a fully-failed
+orderer set degrades to slow retries, never to a wedge.
+
+Failure classification uses the typed RPC errors (`RpcClosed` → the
+connection died, re-dial; `RpcTimeout` → frame lost or orderer wedged)
+instead of the old string matching.  Every breaker transition emits a
+metric, a jlog line, and a span event on the ambient trace.
+
+Per-envelope outcomes stay independent: a 4xx (bad envelope, unknown
+channel, filter veto) is final for that envelope only, while 503s
+requeue for the next attempt until the deadline lapses.
 """
 
 from __future__ import annotations
@@ -18,15 +36,55 @@ import threading
 import time
 from typing import List, Optional, Sequence, Tuple
 
-from fabric_tpu.comm import connect
+from fabric_tpu.comm import RpcClosed, RpcTimeout, connect
+from fabric_tpu.ops_plane import tracing
+from fabric_tpu.ops_plane.logging import jlog
 
 logger = logging.getLogger("fabric_tpu.gateway")
+
+CLOSED, OPEN, HALF_OPEN = "CLOSED", "OPEN", "HALF_OPEN"
+
+
+class _OrdererState:
+    """Breaker + health score for one orderer endpoint."""
+
+    __slots__ = ("addr", "state", "consec_fails", "trips", "open_until",
+                 "ewma_s", "ok_total", "fail_total")
+
+    def __init__(self, addr):
+        self.addr = tuple(addr)
+        self.state = CLOSED
+        self.consec_fails = 0
+        self.trips = 0             # lifetime breaker openings
+        self.open_until = 0.0
+        self.ewma_s = 0.0          # smoothed broadcast latency
+        self.ok_total = 0
+        self.fail_total = 0
+
+    def usable(self, now: float) -> bool:
+        """May traffic be sent to this orderer right now?"""
+        if self.state == CLOSED:
+            return True
+        return now >= self.open_until        # OPEN past cooldown => probe
+
+    def score(self) -> Tuple:
+        """Lower is better; total order over candidates."""
+        rank = {CLOSED: 0, HALF_OPEN: 1, OPEN: 2}[self.state]
+        return (rank, self.ewma_s, self.consec_fails, self.fail_total)
+
+    def as_dict(self) -> dict:
+        return {"addr": "%s:%s" % self.addr, "state": self.state,
+                "consec_fails": self.consec_fails, "trips": self.trips,
+                "ewma_ms": round(self.ewma_s * 1e3, 3),
+                "ok_total": self.ok_total, "fail_total": self.fail_total}
 
 
 class BatchBroadcaster:
     def __init__(self, orderers: Sequence[Tuple[str, int]], signer, msps,
                  backoff_base_s: float = 0.05, backoff_max_s: float = 2.0,
-                 deadline_s: float = 10.0, rpc_timeout_s: float = 10.0):
+                 deadline_s: float = 10.0, rpc_timeout_s: float = 10.0,
+                 failure_threshold: int = 3,
+                 cooldown_base_s: float = 0.25, cooldown_max_s: float = 8.0):
         if not orderers:
             raise ValueError("gateway needs at least one orderer")
         self.orderers = [tuple(o) for o in orderers]
@@ -36,10 +94,96 @@ class BatchBroadcaster:
         self.backoff_max_s = backoff_max_s
         self.deadline_s = deadline_s
         self.rpc_timeout_s = rpc_timeout_s
+        self.failure_threshold = int(failure_threshold)
+        self.cooldown_base_s = cooldown_base_s
+        self.cooldown_max_s = cooldown_max_s
         self._lock = threading.Lock()
+        self._states = [_OrdererState(a) for a in self.orderers]
         self._idx = 0          # current orderer (sticky while healthy)
         self._conn = None
-        self._failures = 0
+        self._failures = 0     # consecutive rotate count (drives backoff)
+
+    # breaker -----------------------------------------------------------
+
+    def _set_state(self, st: _OrdererState, new: str, reason: str) -> None:
+        """Caller holds self._lock.  Observability is best-effort."""
+        old, st.state = st.state, new
+        if old == new:
+            return
+        addr = "%s:%s" % st.addr
+        try:
+            from fabric_tpu.ops_plane import registry
+            registry.counter(
+                "gateway_breaker_transitions_total",
+                "orderer circuit-breaker state changes").add(
+                    1, orderer=addr, to=new)
+            registry.gauge(
+                "gateway_orderer_breaker_open",
+                "1 while the orderer's breaker is open").set(
+                    1.0 if new == OPEN else 0.0, orderer=addr)
+            jlog(logger, "gateway.breaker", orderer=addr,
+                 level=logging.WARNING if new == OPEN else logging.INFO,
+                 old=old, new=new, reason=reason, trips=st.trips)
+            tracing.event("breaker." + new.lower(), orderer=addr,
+                          reason=reason)
+        except Exception:
+            pass
+
+    def _on_success(self, idx: int, latency_s: float) -> None:
+        with self._lock:
+            st = self._states[idx]
+            st.ok_total += 1
+            st.consec_fails = 0
+            st.ewma_s = latency_s if st.ewma_s == 0.0 else \
+                0.8 * st.ewma_s + 0.2 * latency_s
+            self._set_state(st, CLOSED, "success")
+            self._failures = 0
+
+    def _on_failure(self, idx: int, reason: str) -> None:
+        now = time.monotonic()
+        with self._lock:
+            st = self._states[idx]
+            st.fail_total += 1
+            st.consec_fails += 1
+            if st.state == HALF_OPEN or \
+                    st.consec_fails >= self.failure_threshold:
+                st.trips += 1
+                st.open_until = now + min(
+                    self.cooldown_max_s,
+                    self.cooldown_base_s * (2 ** min(st.trips - 1, 16)))
+                self._set_state(st, OPEN, reason)
+
+    def _select(self) -> int:
+        """Pick the orderer for the next attempt (caller holds lock)."""
+        now = time.monotonic()
+        cur = self._states[self._idx]
+        if cur.state == CLOSED:
+            return self._idx
+        candidates = []
+        for i, st in enumerate(self._states):
+            if st.state == OPEN and st.usable(now):
+                # cooldown lapsed: promote to HALF_OPEN, allow one probe
+                self._set_state(st, HALF_OPEN, "cooldown_elapsed")
+            if st.usable(now) or st.state == HALF_OPEN:
+                candidates.append(i)
+        if candidates:
+            return min(candidates, key=lambda i: self._states[i].score())
+        # everything OPEN inside cooldown: force-probe the one expiring
+        # first so a total outage recovers without operator action
+        return min(range(len(self._states)),
+                   key=lambda i: self._states[i].open_until)
+
+    # introspection ------------------------------------------------------
+
+    def healthy(self) -> bool:
+        """True while at least one orderer's breaker is not OPEN — the
+        `/healthz` "orderer reachable" signal."""
+        with self._lock:
+            return any(st.state != OPEN for st in self._states)
+
+    def states(self) -> List[dict]:
+        with self._lock:
+            return [st.as_dict() for st in self._states]
 
     # connection management --------------------------------------------
 
@@ -49,12 +193,20 @@ class BatchBroadcaster:
 
     def _connection(self):
         with self._lock:
+            target = self._select()
+            if self._conn is not None and target == self._idx:
+                return self._idx, self._conn
             if self._conn is not None:
-                return self._conn
-            addr = self.orderers[self._idx % len(self.orderers)]
+                try:
+                    self._conn.close()
+                except Exception:
+                    pass
+                self._conn = None
+            self._idx = target
+            addr = self.orderers[self._idx]
             self._conn = connect(addr, self.signer, self.msps,
                                  timeout=min(self.rpc_timeout_s, 5.0))
-            return self._conn
+            return self._idx, self._conn
 
     def _rotate(self, reason: str) -> None:
         with self._lock:
@@ -64,6 +216,8 @@ class BatchBroadcaster:
                 except Exception:
                     pass
                 self._conn = None
+            # legacy rotation: advance off the failed orderer so the
+            # next _connection() re-selects; _select may override
             self._idx = (self._idx + 1) % len(self.orderers)
             self._failures += 1
         try:
@@ -102,19 +256,40 @@ class BatchBroadcaster:
                                        else self.deadline_s)
         while pending:
             try:
-                conn = self._connection()
+                # _connection sets self._idx to the dial target before it
+                # can raise, so failure paths charge the right orderer
+                idx, conn = self._connection()
                 body = {"envelopes": [e.serialize() for _, e in pending]}
                 if tps and any(tps):
                     body["tps"] = [tps[i] if i < len(tps) else ""
                                    for i, _ in pending]
+                t0 = time.monotonic()
                 out = conn.call(
                     "broadcast_batch", body,
                     timeout=self.rpc_timeout_s)
+                latency = time.monotonic() - t0
                 statuses = [int(s) for s in out["statuses"]]
                 infos = [str(s) for s in out.get(
                     "infos", [""] * len(statuses))]
+            except RpcClosed as exc:
+                logger.debug("broadcast: connection closed: %s", exc)
+                self._on_failure(self._idx, "closed")
+                self._rotate("closed")
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(self._backoff())
+                continue
+            except RpcTimeout as exc:
+                logger.debug("broadcast: rpc timeout: %s", exc)
+                self._on_failure(self._idx, "timeout")
+                self._rotate("timeout")
+                if time.monotonic() >= deadline:
+                    break
+                time.sleep(self._backoff())
+                continue
             except Exception as exc:
                 logger.debug("broadcast to orderer failed: %s", exc)
+                self._on_failure(self._idx, "connection")
                 self._rotate("connection")
                 if time.monotonic() >= deadline:
                     break
@@ -128,10 +303,12 @@ class BatchBroadcaster:
                 else:
                     results[i] = (st, info)
             if not retry:
-                with self._lock:
-                    self._failures = 0
+                self._on_success(idx, latency)
                 break
             pending = retry
+            # the orderer answered but can't order (no leader / halted):
+            # transport is fine, service is not — count against health
+            self._on_failure(idx, "unavailable")
             self._rotate("unavailable")
             if time.monotonic() >= deadline:
                 break

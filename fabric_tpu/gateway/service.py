@@ -68,7 +68,8 @@ class GatewayService:
             node.orderers, node.signer, node.msps,
             backoff_base_s=float(cfg.get("backoff_base_s", 0.05)),
             backoff_max_s=float(cfg.get("backoff_max_s", 2.0)),
-            deadline_s=float(cfg.get("broadcast_deadline_s", 10.0)))
+            deadline_s=float(cfg.get("broadcast_deadline_s", 10.0)),
+            rpc_timeout_s=float(cfg.get("rpc_timeout_s", 10.0)))
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: List[_Pending] = []

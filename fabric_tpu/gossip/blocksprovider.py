@@ -67,7 +67,18 @@ class BlocksProvider:
             logger.warning("[%s] deliver failed (%d): %s",
                            self.channel_id, self._failures, e)
             return 0
+        except Exception as e:
+            # transport-level death (RpcClosed/RpcTimeout/ConnectionError
+            # — a severed channel or partitioned orderer), not a deliver
+            # protocol error: same retry treatment, the loop()'s backoff
+            # + re-pull IS the catch-up path once the partition heals
+            self._failures += 1
+            logger.warning("[%s] deliver transport failed (%d): %r",
+                           self.channel_id, self._failures, e)
+            return 0
         if not blocks:
+            if self._failures:
+                self._mark_healed(0)   # reachable again, already at tip
             return 0
         if self.mcs is not None:
             verdicts = self.mcs.verify_window(blocks)  # ONE TPU dispatch
@@ -84,8 +95,39 @@ class BlocksProvider:
             self.state.add_block(block)
             accepted += 1
         if accepted:
+            if self._failures:
+                self._mark_healed(accepted)
             self._failures = 0
         return accepted
+
+    def _mark_healed(self, accepted: int) -> None:
+        """First successful deliver contact after a failure streak."""
+        from fabric_tpu.ops_plane.logging import jlog
+        jlog(logger, "deliver.healed", channel=self.channel_id,
+             failures=self._failures, accepted=accepted,
+             height=self.state.committer.height)
+        self._failures = 0
+        try:
+            from fabric_tpu.ops_plane import registry
+            registry.counter(
+                "gossip_deliver_recoveries_total",
+                "deliver reconnects after a failure streak").add(
+                    1, channel=self.channel_id)
+        except Exception:
+            pass
+
+    def catch_up(self, max_windows: int = 1000) -> int:
+        """Drain to the orderer tip NOW: pull windows until one comes
+        back empty.  The chaos harness calls this after healing a
+        partition instead of waiting out the poll/backoff cadence; the
+        steady-state loop() converges the same way, just slower."""
+        total = 0
+        for _ in range(max_windows):
+            got = self.pull_window()
+            total += got
+            if got == 0:
+                break
+        return total
 
     def backoff_s(self) -> float:
         """Capped exponential backoff (blocksprovider.go retry loop)."""

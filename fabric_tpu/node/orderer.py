@@ -150,6 +150,10 @@ class OrdererNode:
             register_routes(self.ops, enabled=bool(cfg.get("profiling")))
             # /traces, /traces/<id> (Chrome trace JSON), /spans/stats
             _tracing.register_routes(self.ops)
+            # GET /faults: active fault plan ({"active": false} outside
+            # chaos drills)
+            from fabric_tpu.comm import faults as _faults
+            _faults.register_routes(self.ops)
             self.ops.register_route("GET", "/participation/v1/channels",
                                     self._rest_channels)
             # the ops server is PLAIN HTTP with no client auth, so the

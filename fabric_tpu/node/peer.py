@@ -434,7 +434,8 @@ class PeerNode:
         self.data_dir = data_dir
         self.channel_id = cfg.get("channel_id", "ch")
         self.provider = init_factories(
-            FactoryOpts(default=cfg.get("bccsp", "SW")))
+            FactoryOpts(default=cfg.get("bccsp", "SW"),
+                        degrade=bool(cfg.get("bccsp_degrade", False))))
         self.signer = load_signing_identity(
             cfg["mspid"], cfg["cert_pem"].encode(), cfg["key_pem"].encode())
         self.mspid = cfg["mspid"]
@@ -559,12 +560,38 @@ class PeerNode:
                                         int(cfg["ops_port"]))
             self.ops.register_checker(
                 "deliver", lambda: self._deliver_healthy)
+            self.ops.register_checker("orderer_reachable",
+                                      self._check_orderers)
+            self.ops.register_checker("bccsp", self._check_bccsp)
             # /debug/profile (jax.profiler) + /debug/pprof (host), the
             # peer.profile.enabled slot (internal/peer/node/start.go:813)
             from fabric_tpu.ops_plane.profiling import register_routes
             register_routes(self.ops, enabled=bool(cfg.get("profiling")))
             # /traces, /traces/<id> (Chrome trace JSON), /spans/stats
             _tracing.register_routes(self.ops)
+            # GET /faults: the active fault plan ({"active": false} in
+            # production — the plan only exists during chaos drills)
+            from fabric_tpu.comm import faults as _faults
+            _faults.register_routes(self.ops)
+
+    def _check_orderers(self):
+        """healthz: at least one orderer breaker not OPEN (or no
+        broadcast plane configured at all)."""
+        if self.gateway is None:
+            return True
+        bc = getattr(self.gateway, "broadcaster", None)
+        if bc is None or bc.healthy():
+            return True
+        raise RuntimeError("all orderer breakers open: %s" % [
+            s["addr"] for s in bc.states()])
+
+    def _check_bccsp(self):
+        """healthz: which crypto backend is live; FAILs (with the
+        backend named in the reason) while degraded to SW."""
+        backend = getattr(self.provider, "backend", self.provider.name)
+        if getattr(self.provider, "degraded", False):
+            raise RuntimeError(f"bccsp backend = {backend}")
+        return True
 
     # -- channel lifecycle ---------------------------------------------------
 

@@ -85,6 +85,9 @@ class _NoopSpan:
     def add_link(self, trace_id):
         return self
 
+    def add_event(self, name, **attributes):
+        return self
+
     def end(self, status: str = "OK", end_time: Optional[float] = None):
         pass
 
@@ -132,6 +135,18 @@ class Span:
         """Record a pointer to another trace (request <-> block stitch)."""
         if trace_id:
             self.attributes.setdefault("links", []).append(trace_id)
+        return self
+
+    def add_event(self, name: str, **attributes):
+        """Timestamped annotation INSIDE this span — what happened at
+        +Nms into a long operation (a fault fired, a breaker tripped).
+        Exported with the span under attributes["events"]."""
+        ev = {"name": name,
+              "t_offset_ms": round(
+                  (time.perf_counter() - self.start) * 1e3, 3)}
+        if attributes:
+            ev.update(attributes)
+        self.attributes.setdefault("events", []).append(ev)
         return self
 
     def end(self, status: str = "OK", end_time: Optional[float] = None):
@@ -369,6 +384,21 @@ class Tracer:
         span.start = start
         span.end(end_time=end)
 
+    def event(self, name: str, **attributes) -> None:
+        """Instant annotation on the AMBIENT trace: a zero-duration
+        child span of whatever is active on this thread.  For code that
+        has no span object in hand (the fault plane firing deep inside
+        the transport) but should still show up on /traces/<id>.
+        No ambient sampled context => free no-op."""
+        if not self.enabled:
+            return
+        ctx = getattr(self._tls, "ctx", None)
+        if ctx is None or not ctx.sampled:
+            return
+        now = time.perf_counter()
+        self.record_span(name, now, now,
+                         attributes=attributes or None, parent=ctx)
+
     # -- lifecycle plumbing -------------------------------------------------
 
     def _register_root(self, span: Span) -> None:
@@ -519,6 +549,11 @@ tracer = Tracer()                # the process default
 def configure(cfg: Optional[dict] = None, *,
               default_enabled: bool = True) -> Tracer:
     return tracer.configure(cfg, default_enabled=default_enabled)
+
+
+def event(name: str, **attributes) -> None:
+    """Module-level shorthand for `tracer.event` (ambient annotation)."""
+    tracer.event(name, **attributes)
 
 
 def register_routes(ops, t: Optional[Tracer] = None) -> None:

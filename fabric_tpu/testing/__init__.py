@@ -1,0 +1,7 @@
+"""Test-support plane: the chaos harness (node lifecycle + fault-plan
+drills).  Lives in the package, not tests/, so operators can drive
+drills from scripts and the smoke gate."""
+
+from .chaos import ChaosNet
+
+__all__ = ["ChaosNet"]

@@ -10,6 +10,9 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 export JAX_PLATFORMS=cpu
+# smoke_*.py scripts run as `python tests/foo.py` — put the repo root on
+# the import path so fabric_tpu resolves without an install
+export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 unset PALLAS_AXON_POOL_IPS 2>/dev/null || true
 
 echo "== pytest collection (must be error-free) =="
@@ -27,6 +30,9 @@ fi
 
 echo "== live trace endpoints (/traces, /spans/stats) =="
 python tests/smoke_traces.py
+
+echo "== seeded chaos probe (fault plane + convergence) =="
+python tests/smoke_chaos.py
 
 echo "== non-slow test subset =="
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider

@@ -1,0 +1,118 @@
+"""Smoke probe for the fault-injection plane (called by smoke.sh).
+
+Boots a minimal ChaosNet (1 raft orderer, Org1/Org2 peers, SW
+provider), installs a seeded FaultPlan with drop + delay + dup active
+on the gateway/broadcast paths, pushes three transactions through the
+gateway under fire, then asserts:
+
+  - every tx commits VALID despite the faults,
+  - the plan actually fired (deterministically, seed-driven),
+  - GET /faults served the plan while installed and reports
+    {"active": false} after uninstall,
+  - both peers converge to the same height and commit hash.
+
+Named smoke_* (not test_*) on purpose: this is a script for the shell
+gate, not a pytest module.
+"""
+
+import json
+import sys
+import tempfile
+import time
+import urllib.error
+import urllib.request
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.comm import FaultPlan, faults
+from fabric_tpu.config import BatchConfig
+from fabric_tpu.protocol.txflags import ValidationCode
+from fabric_tpu.testing import ChaosNet
+
+
+def main() -> int:
+    init_factories(FactoryOpts(default="SW"))
+    with tempfile.TemporaryDirectory() as base:
+        net = ChaosNet(
+            base, n_orderers=1, peer_orgs=["Org1", "Org2"],
+            peers_per_org=1,
+            batch=BatchConfig(max_message_count=4, timeout_s=0.05),
+            gateway_cfg={"linger_s": 0.002, "max_batch": 8,
+                         "broadcast_deadline_s": 20.0,
+                         "rpc_timeout_s": 2.0},
+            peer_overrides={"ops_port": 0})
+        net.start()
+        try:
+            plan = faults.install(
+                FaultPlan(seed=7, name="smoke")
+                .rule(method="broadcast_batch", kind="req",
+                      drop=0.3, max_fires=2)
+                .rule(method="broadcast_batch", kind="*",
+                      delay=0.4, delay_s=0.01, max_fires=10)
+                .rule(method="gateway.submit", kind="req",
+                      dup=0.5, max_fires=3))
+
+            host, port = net.peers()[0].ops.addr
+
+            def get(path):
+                with urllib.request.urlopen(
+                        f"http://{host}:{port}{path}", timeout=5) as r:
+                    return json.loads(r.read())
+
+            live = get("/faults")
+            if not live.get("active") or live.get("name") != "smoke":
+                print(f"FAIL: /faults while installed: {live}",
+                      file=sys.stderr)
+                return 1
+
+            gw = net.client("Org1")
+            try:
+                for i in range(3):
+                    code, _ = gw.submit_transaction(
+                        "assets", "create", [b"chaos%d" % i, b"v"],
+                        commit_timeout_s=60.0)
+                    if code != int(ValidationCode.VALID):
+                        print(f"FAIL: tx {i} code {code}", file=sys.stderr)
+                        return 1
+            finally:
+                gw.close()
+
+            fired = dict(plan.fired)
+            faults.uninstall()
+            if not any(fired[k] for k in ("drop", "delay", "dup")):
+                print(f"FAIL: plan never fired: {fired}", file=sys.stderr)
+                return 1
+            after = get("/faults")
+            if after != {"active": False}:
+                print(f"FAIL: /faults after uninstall: {after}",
+                      file=sys.stderr)
+                return 1
+            if not net.wait_converged(timeout_s=30.0, min_height=1):
+                print(f"FAIL: no convergence: {net.heights()} "
+                      f"{net.commit_hashes()}", file=sys.stderr)
+                return 1
+            # healed cluster reports clean health
+            deadline = time.time() + 20
+            hz = None
+            while time.time() < deadline:
+                try:
+                    hz = get("/healthz")
+                    if hz.get("status") == "OK":
+                        break
+                except urllib.error.HTTPError as e:
+                    hz = json.loads(e.read().decode())
+                time.sleep(0.5)
+            if not hz or hz.get("status") != "OK":
+                print(f"FAIL: /healthz not clean after heal: {hz}",
+                      file=sys.stderr)
+                return 1
+            print(f"OK: 3 txs VALID under faults {fired}, "
+                  f"peers converged at height "
+                  f"{next(iter(net.heights().values()))}")
+            return 0
+        finally:
+            faults.uninstall()
+            net.stop_all()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

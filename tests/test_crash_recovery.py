@@ -1,0 +1,231 @@
+"""Crash recovery: every durable store reopens cleanly from the exact
+byte patterns a kill can leave behind.
+
+  - BlockStore._recover: torn tail record (short payload) and garbage
+    tail record -> dropped AND physically truncated; committed prefix
+    intact
+  - raft WAL.replay: truncated final record / undecodable final record
+    -> replay stops at the last durable record
+  - KVLedger._recover: crash BETWEEN block-store append and state
+    commit -> reopened ledger replays the tip block into state/history
+    and restores the commit-hash chain
+"""
+
+import os
+import struct
+
+import pytest
+
+from fabric_tpu.ledger import KVLedger, LedgerConfig
+from fabric_tpu.ledger.blkstorage import BlockStore
+from fabric_tpu.msp import CachedMSP
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.orderer.raft import WAL
+from fabric_tpu.protocol import (Block, BlockHeader, KVWrite, NsRwSet,
+                                 TxRwSet, block_data_hash,
+                                 block_header_hash, build)
+
+_LEN = struct.Struct("<Q")     # block-store record length prefix
+_REC = struct.Struct("<I")     # WAL record length prefix
+
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    return init_factories(FactoryOpts(default="SW"))
+
+
+# ---------------------------------------------------------------------------
+# block store
+# ---------------------------------------------------------------------------
+
+def _raw_block(num: int, prev: bytes) -> Block:
+    data = [b"opaque-envelope-%d" % num]
+    return Block(BlockHeader(num, prev, block_data_hash(data)), data)
+
+
+def _fill_store(root: str, n: int = 3) -> bytes:
+    bs = BlockStore(root)
+    prev = b"\x00" * 32
+    for i in range(n):
+        blk = _raw_block(i, prev)
+        bs.add_block(blk)
+        prev = block_header_hash(blk.header)
+    return bs.chain_info().current_hash
+
+
+def _seg0(root: str) -> str:
+    return os.path.join(root, "blocks_000000.bin")
+
+
+def test_blockstore_recovers_torn_tail(tmp_path):
+    root = str(tmp_path / "blocks")
+    tip = _fill_store(root, n=3)
+    good_size = os.path.getsize(_seg0(root))
+
+    # the kill hit mid-append: length prefix promises more bytes than
+    # the page cache ever flushed
+    with open(_seg0(root), "ab") as f:
+        f.write(_LEN.pack(5000) + b"only-a-few-bytes")
+
+    bs = BlockStore(root)
+    assert bs.height == 3
+    assert bs.chain_info().current_hash == tip
+    assert bs.get_by_number(2).header.number == 2
+    # the torn record was physically truncated, not just skipped, so
+    # the NEXT append lands at a clean offset
+    assert os.path.getsize(_seg0(root)) == good_size
+    blk = _raw_block(3, tip)
+    bs.add_block(blk)
+    bs2 = BlockStore(root)
+    assert bs2.height == 4
+
+
+def test_blockstore_recovers_garbage_tail(tmp_path):
+    root = str(tmp_path / "blocks")
+    tip = _fill_store(root, n=2)
+    good_size = os.path.getsize(_seg0(root))
+
+    # a fully-written record whose payload never decodes (disk scribble)
+    junk = b"\xff\x00\xfe\x01" * 12
+    with open(_seg0(root), "ab") as f:
+        f.write(_LEN.pack(len(junk)) + junk)
+
+    bs = BlockStore(root)
+    assert bs.height == 2
+    assert bs.chain_info().current_hash == tip
+    assert os.path.getsize(_seg0(root)) == good_size
+
+
+# ---------------------------------------------------------------------------
+# raft WAL
+# ---------------------------------------------------------------------------
+
+def _ent(i: int) -> dict:
+    return {"kind": "ent", "term": 1, "index": i, "data": b"cmd-%d" % i}
+
+
+def test_wal_replay_drops_truncated_final_record(tmp_path):
+    path = str(tmp_path / "wal" / "log")
+    w = WAL(path)
+    for i in range(1, 4):
+        w.append(_ent(i))
+    w.sync()
+    w.close()
+
+    with open(path, "ab") as f:
+        f.write(_REC.pack(4096) + b"partial")
+
+    recs = WAL.replay(path)
+    assert [r["index"] for r in recs] == [1, 2, 3]
+
+
+def test_wal_replay_drops_garbage_final_record(tmp_path):
+    path = str(tmp_path / "wal" / "log")
+    w = WAL(path)
+    for i in range(1, 3):
+        w.append(_ent(i))
+    w.sync()
+    w.close()
+
+    junk = b"\xff" * 24
+    with open(path, "ab") as f:
+        f.write(_REC.pack(len(junk)) + junk)
+
+    recs = WAL.replay(path)
+    assert [r["index"] for r in recs] == [1, 2]
+
+    # and a WAL reopened for append keeps working after the bad tail:
+    # rewrite() (the compaction path) drops the junk with the records
+    w2 = WAL(path)
+    w2.rewrite(recs + [_ent(3)])
+    w2.close()
+    assert [r["index"] for r in WAL.replay(path)] == [1, 2, 3]
+
+
+# ---------------------------------------------------------------------------
+# kv ledger: kill between block append and state commit
+# ---------------------------------------------------------------------------
+
+def _ledger_world(root):
+    from fabric_tpu.committer import Committer, PolicyRegistry, TxValidator
+    from fabric_tpu.policy import parse_policy
+    org1, org2 = DevOrg("Org1"), DevOrg("Org2")
+    msps = {o.mspid: CachedMSP(o.msp()) for o in (org1, org2)}
+    policies = PolicyRegistry()
+    policies.set_policy("cc", parse_policy(
+        "AND('Org1.member', 'Org2.member')"))
+    ledger = KVLedger("ch", LedgerConfig(root=root))
+    from fabric_tpu.bccsp.factory import get_default
+    validator = TxValidator("ch", msps, get_default(), policies)
+    return org1, org2, Committer(ledger, validator)
+
+
+def _commit_one(org1, org2, committer, key):
+    rwset = TxRwSet((NsRwSet("cc", writes=(KVWrite(key, b"v-" + key.encode()),)),))
+    env = build.endorser_tx("ch", "cc", "1.0", rwset,
+                            org1.new_identity("client"),
+                            [org1.new_identity("e1"),
+                             org2.new_identity("e2")])
+    lg = committer.ledger
+    prev = (lg.blockstore.chain_info().current_hash
+            if lg.height else b"\x00" * 32)
+    return committer.store_block(build.new_block(lg.height, prev, [env]))
+
+
+def test_kvledger_recovers_kill_mid_commit(tmp_path):
+    root = str(tmp_path / "ledger")
+    org1, org2, committer = _ledger_world(root)
+    _commit_one(org1, org2, committer, "k0")
+    ledger = committer.ledger
+
+    # crash AFTER the block-store fsync, BEFORE the state commit: the
+    # next commit's statedb.apply_updates never runs
+    real_apply = ledger.statedb.apply_updates
+
+    def die(batch, height):
+        raise RuntimeError("kill -9 (injected mid-commit)")
+
+    ledger.statedb.apply_updates = die
+    with pytest.raises(RuntimeError, match="injected mid-commit"):
+        _commit_one(org1, org2, committer, "k1")
+    ledger.statedb.apply_updates = real_apply
+
+    # on-disk truth now: block 1 durable, state/history one block behind
+    assert ledger.blockstore.height == 2
+    assert ledger.get_state("cc", "k1") is None
+    pre_crash_hash = ledger.commit_hash
+
+    # "restart": a fresh KVLedger over the same directory replays the
+    # tip block into the derived DBs (recovery.go savepoint replay)
+    reopened = KVLedger("ch", LedgerConfig(root=root))
+    assert reopened.height == 2
+    assert reopened.get_state("cc", "k0") == b"v-k0"
+    assert reopened.get_state("cc", "k1") == b"v-k1"
+    assert reopened.commit_hash == pre_crash_hash
+    hist = reopened.get_history("cc", "k1")
+    assert len(hist) == 1
+
+    # and the recovered ledger keeps committing normally
+    org1b, org2b, committer2 = _ledger_world(root)
+    res = _commit_one(org1b, org2b, committer2, "k2")
+    assert res.final_flags.valid_count() == 1
+    assert committer2.ledger.height == 3
+
+
+def test_kvledger_recovers_statedb_rebuild(tmp_path):
+    """Losing the whole state dir (savepoint included) replays every
+    block from the store — rebuild_dbs.go semantics."""
+    import shutil
+    root = str(tmp_path / "ledger")
+    org1, org2, committer = _ledger_world(root)
+    for key in ("a", "b", "c"):
+        _commit_one(org1, org2, committer, key)
+    tip_hash = committer.ledger.commit_hash
+
+    shutil.rmtree(os.path.join(root, "ch", "state"))
+    reopened = KVLedger("ch", LedgerConfig(root=root))
+    assert reopened.height == 3
+    for key in ("a", "b", "c"):
+        assert reopened.get_state("cc", key) == b"v-" + key.encode()
+    assert reopened.commit_hash == tip_hash

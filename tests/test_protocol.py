@@ -66,8 +66,9 @@ def test_block_hash_chain(org):
     assert b1.header.previous_hash == block_header_hash(b0.header)
     rt = Block.deserialize(b1.serialize())
     assert rt.header == b1.header and rt.data == b1.data
-    # tamper detection
-    b1.data[0] = b1.data[0][:-1] + b"x"
+    # tamper detection (XOR so the byte is guaranteed to change — a
+    # fixed replacement byte collides with the real one 1 run in 256)
+    b1.data[0] = b1.data[0][:-1] + bytes([b1.data[0][-1] ^ 0xFF])
     assert block_data_hash(b1.data) != b1.header.data_hash
 
 
