@@ -304,6 +304,9 @@ def bench_window(provider, n_tx: int, endorsers: int = 3,
         n_blocks = int(os.environ.get("BENCH_WINDOW_BLOCKS", "320"))
     if passes <= 0:
         passes = int(os.environ.get("BENCH_WINDOW_PASSES", "2"))
+    # pipeline depth: how many blocks may be in flight (collect of block
+    # N+depth-1 overlapping verify of block N).  2 = double-buffer.
+    depth = max(1, int(os.environ.get("BENCH_WINDOW_DEPTH", "2")))
     msps, registry, blocks = _bench_world(n_tx, endorsers,
                                           n_blocks=distinct)
     validator = TxValidator("bench", msps, provider, registry)
@@ -326,7 +329,7 @@ def bench_window(provider, n_tx: int, endorsers: int = 3,
                     tb0 = time.perf_counter()
                     state = validator.validate_begin(blk)
                     pending.append((tb0, state))
-                    if len(pending) >= 2:    # depth-2 pipeline
+                    if len(pending) >= depth:
                         tb, st = pending.pop(0)
                         validator.validate_finish(st)
                         now = time.perf_counter()
@@ -348,6 +351,7 @@ def bench_window(provider, n_tx: int, endorsers: int = 3,
 
     rate = sigs_per_block / statistics.median(intervals)
     det = {"window_blocks": n_blocks, "window_passes": passes,
+           "window_depth": depth,
            "window_intervals_pooled": len(intervals)}
     for key in ("collect", "dispatch_wait", "gate", "verify"):
         xs = acc.get(key, [])

@@ -28,24 +28,61 @@ class FactoryOpts:
     use_mesh: bool = False           # shard batches over all visible devices
     degrade: bool = False            # wrap in DegradingProvider (breaker
     #                                  + SW fallback on device sickness)
+    compile_cache_dir: Optional[str] = None   # persistent XLA cache dir
+    #                                  (node config "compile_cache_dir" /
+    #                                  FABRIC_TPU_<ROLE>_COMPILE_CACHE_DIR)
 
 
-def enable_compile_cache() -> None:
+def default_cache_dir() -> str:
+    import os
+    return os.environ.get("JAX_COMPILATION_CACHE_DIR",
+                          os.path.expanduser("~/.cache/fabric_tpu_xla"))
+
+
+def enable_compile_cache(cache_dir: Optional[str] = None) -> None:
     """Point jax at the persistent compilation cache so node cold-starts
     reuse every previously-compiled kernel (round-2 flagged 200s+ cold
     compiles; the cache survives across processes on one host).  Must go
     through jax.config — the env var alone is too late on images whose
-    sitecustomize imports jax at interpreter start."""
-    import os
+    sitecustomize imports jax at interpreter start.
+
+    Precedence: explicit `cache_dir` (node config / warmup --cache-dir)
+    > JAX_COMPILATION_CACHE_DIR > ~/.cache/fabric_tpu_xla.  Prebake with
+    `python -m fabric_tpu.node.warmup --cache-dir <dir>` at provisioning
+    time, then start nodes against the same dir."""
     try:
         import jax
-        cache_dir = os.environ.get(
-            "JAX_COMPILATION_CACHE_DIR",
-            os.path.expanduser("~/.cache/fabric_tpu_xla"))
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_compilation_cache_dir",
+                          cache_dir or default_cache_dir())
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     except Exception:
         logger.debug("persistent compile cache unavailable", exc_info=True)
+
+
+# written by node.warmup when a prebake COMPLETES; its presence is what
+# makes a cache dir count as a warmup artifact
+WARMUP_MANIFEST = "fabric_tpu_warmup.json"
+
+
+def compile_cache_is_warm(cache_dir: Optional[str] = None,
+                          min_entries: int = 4) -> bool:
+    """True when the cache dir holds a COMPLETED warmup artifact: the
+    manifest `node.warmup` writes after prebaking, plus at least
+    `min_entries` compiled kernels.  Incidental cache entries left by an
+    ordinary test run do NOT count — the slow-marked kernel test
+    modules rejoin the quick gate off this check, so it must flip only
+    on an explicit prebake, never as a side effect of running tests.
+    Also used by ops checks."""
+    import os
+    d = cache_dir or default_cache_dir()
+    if not os.path.isfile(os.path.join(d, WARMUP_MANIFEST)):
+        return False
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return False
+    return sum(1 for n in names if not n.startswith(".")
+               and n != WARMUP_MANIFEST) >= min_entries
 
 
 def init_factories(opts: Optional[FactoryOpts] = None) -> Provider:
@@ -56,7 +93,7 @@ def init_factories(opts: Optional[FactoryOpts] = None) -> Provider:
     if kind == "SW":
         _default = SoftwareProvider(require_low_s=opts.require_low_s)
     elif kind == "JAXTPU":
-        enable_compile_cache()
+        enable_compile_cache(opts.compile_cache_dir)
         from .jaxtpu import JaxTpuProvider
         mesh = None
         if opts.use_mesh:

@@ -135,8 +135,10 @@ class TxValidator:
         # key-level endorsement: committed validation-parameter lookup
         # ((ns, key) -> policy bytes), usually sbe.statedb_lookup(statedb)
         self.sbe_lookup = sbe_lookup
-        # blkstorage-backed duplicate-txid oracle (validator.go dedup vs ledger)
-        self.ledger_has_txid = ledger_has_txid or (lambda txid: False)
+        # blkstorage-backed duplicate-txid oracle (validator.go dedup vs
+        # ledger).  The module-level sentinel (not a fresh lambda) lets
+        # the deep C path detect "unwired" and skip a per-tx Python call.
+        self.ledger_has_txid = ledger_has_txid or _false_oracle
         # (block_number, txid-map) of blocks begun whose txids the
         # ledger oracle cannot see yet: a pipelined driver
         # (validate_begin N+1 before block N commits) must still flag a
@@ -171,6 +173,27 @@ class TxValidator:
     def _deserialize(self, ident_bytes: bytes) -> Optional[Identity]:
         from fabric_tpu.msp import deserialize_from_msps
         return deserialize_from_msps(self.msps, ident_bytes)
+
+    def _resolve_creator(self, ident_bytes: bytes):
+        """Creator memo value: (identity, p256_pub_wire|None), or None
+        for identities the MSP rejects (deserialize + chain-validate —
+        the (0, creator) memo of the Python tail, resolved once per
+        unique creator on the deep path)."""
+        creator = self._deserialize(ident_bytes)
+        if creator is not None and not _msp_validates(self.msps, creator):
+            creator = None
+        return None if creator is None else (
+            creator, creator._pub_wire
+            if getattr(creator, "scheme", None) == SCHEME_P256 else None)
+
+    def _resolve_endorser(self, ident_bytes: bytes):
+        """Endorser memo value — deserialize only, NO chain validation
+        (the (1, endorser) memo: an unrecognized endorser merely weakens
+        the policy, policy.go:390-393)."""
+        ident = self._deserialize(ident_bytes)
+        return None if ident is None else (
+            ident, ident._pub_wire
+            if getattr(ident, "scheme", None) == SCHEME_P256 else None)
 
     def _collect_tx_fast(self, tx_num: int, rec, flags: TxFlags,
                          seen_txids: Dict[str, int],
@@ -408,6 +431,26 @@ class TxValidator:
 
     def _begin_inner(self, block: Block) -> dict:
         n = len(block.data)
+        # duplicate-txid oracle widened by the in-flight window: a txid
+        # in an earlier block the ledger cannot see yet is a duplicate
+        # here.  Prune entries the ledger now covers (committed) and
+        # entries at/above this block's number (replay of the window).
+        num = block.header.number
+        self._inflight_txids = [
+            (bn, m) for bn, m in self._inflight_txids
+            if m and bn < num
+            and not self.ledger_has_txid(next(iter(m)))]
+        carry = [m for _, m in self._inflight_txids]
+
+        use_fast = (_fastcollect is not None
+                    and not getattr(self, "force_python_collect", False))
+        if (use_fast and self.sbe_lookup is None
+                and hasattr(_fastcollect, "digest")):
+            # deep native tail: SBE needs the classic tail's per-tx
+            # written-keys bookkeeping, so key-level endorsement keeps
+            # the C-walker + Python-tail path
+            return self._begin_deep(block, num, carry)
+
         flags = TxFlags(n)
 
         t0 = time.perf_counter()
@@ -452,23 +495,11 @@ class TxValidator:
                 resolvers.append((result, new))
                 flushed = len(keys)
 
-        use_fast = (_fastcollect is not None
-                    and not getattr(self, "force_python_collect", False))
         if use_fast:
             recs = _fastcollect.collect(block.data, self.channel_id)
         else:
             from fabric_tpu.committer import collect_py
             recs = collect_py.collect(block.data, self.channel_id)
-        # duplicate-txid oracle widened by the in-flight window: a txid
-        # in an earlier block the ledger cannot see yet is a duplicate
-        # here.  Prune entries the ledger now covers (committed) and
-        # entries at/above this block's number (replay of the window).
-        num = block.header.number
-        self._inflight_txids = [
-            (n, m) for n, m in self._inflight_txids
-            if m and n < num
-            and not self.ledger_has_txid(next(iter(m)))]
-        carry = [m for _, m in self._inflight_txids]
         has_txid = (self.ledger_has_txid if not carry else (
             lambda t: any(t in s for s in carry)
             or self.ledger_has_txid(t)))
@@ -493,7 +524,124 @@ class TxValidator:
                 "msps": self._msps_snapshot, "seen_txids": seen_txids,
                 "collect_s": collect_s}
 
+    def _begin_deep(self, block: Block, num: int, carry: list) -> dict:
+        """Deep native pass 1: the C walker consumes its own tuples
+        (fastcollect digest/assemble) — txid dedup, creator/endorser
+        memo slot assignment, and flat dispatch-ordered VerifyItem
+        interning all run without per-tx Python bytecode.  Python's
+        per-block work shrinks to resolving each UNIQUE identity once
+        and launching the async device dispatches, which is what lets
+        collect-under-verify overlap approach the device-bound limit in
+        the streamed window.  Flag parity with the classic tail and the
+        pure-Python mirror is enforced differentially
+        (tests/test_committer.py)."""
+        n = len(block.data)
+        t0 = time.perf_counter()
+        oracle = self.ledger_has_txid
+        if oracle is _false_oracle:
+            oracle = None          # unwired: skip the per-tx call in C
+        codes, seen_txids, works, creators, endorsers = _fastcollect.digest(
+            block.data, self.channel_id, carry, oracle)
+        # one MSP resolution per unique identity (the whole-block analogue
+        # of the classic tail's (0,creator)/(1,endorser) memo dicts)
+        c_ents = [self._resolve_creator(b) for b in creators]
+        e_ents = [self._resolve_endorser(b) for b in endorsers]
+
+        index: Dict[VerifyItem, int] = {}   # item -> dispatch position
+        plans: list = []
+        pol_cache: dict = {}
+        resolvers: List[Tuple[object, int, int]] = []
+        flushed = 0
+        n_refs = 0
+
+        def flush():
+            nonlocal flushed
+            keys = list(index.keys())
+            new = keys[flushed:]
+            if new:
+                resolve = self.provider.batch_verify_async(new)
+                # eager background resolution — same rationale as the
+                # classic path's flush(): keep the result fetch ahead of
+                # any later dispatch on relayed transports
+                holder: dict = {}
+
+                def run(resolve=resolve, holder=holder):
+                    try:
+                        holder["out"] = resolve()
+                    except BaseException as exc:   # re-raised at join
+                        holder["err"] = exc
+
+                th = threading.Thread(target=run, daemon=True)
+                th.start()
+
+                def result(th=th, holder=holder):
+                    th.join()
+                    if "err" in holder:
+                        raise holder["err"]
+                    return holder["out"]
+
+                resolvers.append((result, flushed, len(new)))
+                flushed = len(keys)
+
+        chunk = self.overlap_chunk
+        policy_for = self.policies.policy_for
+        for start in range(0, len(works), chunk):
+            n_refs += _fastcollect.assemble(
+                works[start:start + chunk], c_ents, e_ents, endorsers,
+                codes, index, plans, VerifyItem, SCHEME_P256,
+                policy_for, pol_cache)
+            flush()
+        self._inflight_txids.append((num, seen_txids))
+        collect_s = time.perf_counter() - t0
+        tracing.tracer.record_span(
+            "validator.collect", t0, t0 + collect_s,
+            attributes={"block": int(num), "txs": n,
+                        "unique_items": len(index)})
+        return {"deep": True, "block": block, "codes": codes,
+                "plans": plans, "items": index, "resolvers": resolvers,
+                "msps": self._msps_snapshot, "seen_txids": seen_txids,
+                "collect_s": collect_s, "n_refs": n_refs}
+
+    def _finish_deep(self, state: dict) -> ValidationResult:
+        block = state["block"]
+        codes = state["codes"]
+        index = state["items"]
+        collect_s = state["collect_s"]
+
+        t0 = time.perf_counter()
+        verdict = np.zeros(len(index), dtype=np.uint8)
+        for resolve, start, count in state["resolvers"]:
+            out = resolve()
+            verdict[start:start + count] = np.asarray(out, dtype=bool)
+        dispatch_s = time.perf_counter() - t0
+        tracing.tracer.record_span(
+            "validator.dispatch_wait", t0, t0 + dispatch_s,
+            attributes={"block": int(block.header.number),
+                        "unique_items": len(index)})
+
+        t0 = time.perf_counter()
+        _fastcollect.gate(state["plans"], verdict, codes,
+                          self.validation_plugin, self.evaluator, {})
+        flags = TxFlags.from_bytes(bytes(codes))
+        gate_s = time.perf_counter() - t0
+        tracing.tracer.record_span(
+            "validator.gate", t0, t0 + gate_s,
+            attributes={"block": int(block.header.number),
+                        "txs": len(state["plans"])})
+
+        block.metadata.items[META_TXFLAGS] = flags.to_bytes()
+        logger.info(
+            "[%s] validated block %d: %d/%d valid | collect=%.1fms "
+            "dispatch=%.1fms (%d uniq sigs) gate=%.1fms",
+            self.channel_id, block.header.number, flags.valid_count(),
+            len(block.data), collect_s * 1e3, dispatch_s * 1e3,
+            len(index), gate_s * 1e3)
+        return ValidationResult(flags, collect_s, dispatch_s, gate_s,
+                                state["n_refs"], len(index))
+
     def _finish_inner(self, state: dict) -> ValidationResult:
+        if state.get("deep"):
+            return self._finish_deep(state)
         block = state["block"]
         flags = state["flags"]
         items = state["items"]
@@ -545,6 +693,11 @@ class TxValidator:
             gate_s * 1e3)
         return ValidationResult(flags, collect_s, dispatch_s, gate_s,
                                 n_refs, len(keys))
+
+
+def _false_oracle(_txid: str) -> bool:
+    """Default ledger-txid oracle for an unwired validator."""
+    return False
 
 
 def _msp_validates(msps: Dict[str, object], ident: Identity) -> bool:

@@ -24,6 +24,7 @@ from fabric_tpu.orderer.deliver import (
     NotReadyError,
     SeekInfo,
 )
+from fabric_tpu.ops_plane import tracing
 
 logger = logging.getLogger("fabric_tpu.gossip.blocksprovider")
 
@@ -50,55 +51,74 @@ class BlocksProvider:
 
     def pull_window(self) -> int:
         """Fetch + batch-verify + hand over up to `window` blocks.
-        Returns how many blocks were accepted."""
+        Returns how many blocks were accepted.
+
+        The whole pull runs under a `gossip.pull_window` root span, so
+        the deliver req frame carries a traceparent (comm/rpc.py attaches
+        "tp" from the ambient context) and the orderer's `orderer.deliver`
+        span lands in the SAME trace — one /traces/<id> export covers
+        seek, stream, window sig-verify and handover.  These traces are
+        high-frequency (one per poll); cap them with the recorder's
+        per-root retention policy (tracing config `retention`)."""
         height = self.state.committer.height
-        blocks: List = []
-        try:
-            for block in self.deliver.deliver(
-                    self.channel_id,
-                    SeekInfo(start=height, stop=height + self.window - 1,
-                             behavior=BEHAVIOR_FAIL_IF_NOT_READY),
-                    signed=self.signed):
-                blocks.append(block)
-        except NotReadyError:
-            pass  # reached the orderer tip mid-window: fine
-        except DeliverError as e:
-            self._failures += 1
-            logger.warning("[%s] deliver failed (%d): %s",
-                           self.channel_id, self._failures, e)
-            return 0
-        except Exception as e:
-            # transport-level death (RpcClosed/RpcTimeout/ConnectionError
-            # — a severed channel or partitioned orderer), not a deliver
-            # protocol error: same retry treatment, the loop()'s backoff
-            # + re-pull IS the catch-up path once the partition heals
-            self._failures += 1
-            logger.warning("[%s] deliver transport failed (%d): %r",
-                           self.channel_id, self._failures, e)
-            return 0
-        if not blocks:
-            if self._failures:
-                self._mark_healed(0)   # reachable again, already at tip
-            return 0
-        if self.mcs is not None:
-            verdicts = self.mcs.verify_window(blocks)  # ONE TPU dispatch
-        else:
-            verdicts = [True] * len(blocks)
-        accepted = 0
-        for block, ok in zip(blocks, verdicts):
-            if not ok:
+        with tracing.tracer.start_span(
+                "gossip.pull_window",
+                attributes={"channel": self.channel_id, "height": height,
+                            "window": self.window}) as span:
+            blocks: List = []
+            try:
+                for block in self.deliver.deliver(
+                        self.channel_id,
+                        SeekInfo(start=height, stop=height + self.window - 1,
+                                 behavior=BEHAVIOR_FAIL_IF_NOT_READY),
+                        signed=self.signed):
+                    blocks.append(block)
+            except NotReadyError:
+                pass  # reached the orderer tip mid-window: fine
+            except DeliverError as e:
                 self._failures += 1
-                logger.error("[%s] block %d failed orderer-sig verify; "
-                             "dropping rest of window", self.channel_id,
-                             block.header.number)
-                break  # later blocks chain off the bad one
-            self.state.add_block(block)
-            accepted += 1
-        if accepted:
-            if self._failures:
-                self._mark_healed(accepted)
-            self._failures = 0
-        return accepted
+                logger.warning("[%s] deliver failed (%d): %s",
+                               self.channel_id, self._failures, e)
+                span.set_attribute("error", str(e))
+                return 0
+            except Exception as e:
+                # transport-level death (RpcClosed/RpcTimeout/ConnectionError
+                # — a severed channel or partitioned orderer), not a deliver
+                # protocol error: same retry treatment, the loop()'s backoff
+                # + re-pull IS the catch-up path once the partition heals
+                self._failures += 1
+                logger.warning("[%s] deliver transport failed (%d): %r",
+                               self.channel_id, self._failures, e)
+                span.set_attribute("error", repr(e))
+                return 0
+            if not blocks:
+                if self._failures:
+                    self._mark_healed(0)   # reachable again, already at tip
+                return 0
+            if self.mcs is not None:
+                with tracing.tracer.start_span(
+                        "gossip.verify_window",
+                        attributes={"blocks": len(blocks)}):
+                    verdicts = self.mcs.verify_window(blocks)  # ONE dispatch
+            else:
+                verdicts = [True] * len(blocks)
+            accepted = 0
+            for block, ok in zip(blocks, verdicts):
+                if not ok:
+                    self._failures += 1
+                    logger.error("[%s] block %d failed orderer-sig verify; "
+                                 "dropping rest of window", self.channel_id,
+                                 block.header.number)
+                    break  # later blocks chain off the bad one
+                self.state.add_block(block)
+                accepted += 1
+            span.set_attribute("blocks", len(blocks))
+            span.set_attribute("accepted", accepted)
+            if accepted:
+                if self._failures:
+                    self._mark_healed(accepted)
+                self._failures = 0
+            return accepted
 
     def _mark_healed(self, accepted: int) -> None:
         """First successful deliver contact after a failure streak."""

@@ -10,8 +10,22 @@
  * materializing any intermediate Python objects.
  *
  * Exported:  collect(envs: sequence[bytes], channel_id: str) -> list
+ *            digest(envs, channel_id, carry, oracle) -> digested pass 1
+ *            assemble(works, ...) -> per-tx gate plans + flat item table
+ *            gate(plans, verdict, codes, ...) -> fold verdicts into flags
  *
- * Per envelope the result element is either
+ * collect() is the span-splicing walker shared by the legacy consumer
+ * tail (txvalidator._collect_tx_fast, still used under SBE); digest/
+ * assemble/gate are the fully-native tail: txid dedup against a C-side
+ * seen-set (plus the pipelined carry window and the ledger oracle),
+ * creator/endorser memo SLOT assignment, flat dispatch-ordered
+ * VerifyItem interning, and a verdict-bitmap gate that never runs a
+ * per-tx Python loop.  The no-compiler mirror for ALL of it is
+ * committer/collect_py.py + the Python tail/gate in txvalidator.py —
+ * the two paths must produce bit-identical TxFlags (state-fork
+ * invariant, tested differentially in tests/test_committer.py).
+ *
+ * Per envelope the collect() result element is either
  *   int code — an early validation failure:
  *     1=NIL_ENVELOPE 2=BAD_PAYLOAD 3=TARGET_CHAIN_NOT_FOUND
  *     4=BAD_PROPOSAL_TXID 5=UNKNOWN_TX_TYPE 6=NIL_TXACTION
@@ -1056,6 +1070,725 @@ static PyObject *py_collect(PyObject *self, PyObject *args)
 }
 
 /* ------------------------------------------------------------------ */
+/* Digested pass-1 tail + verdict gate (the deep native path).
+ *
+ * digest()   walks every envelope (same collect_env walker as collect())
+ *            but CONSUMES the per-tx tuples in C: txid dedup against a
+ *            C-side seen dict (plus the pipelined carry window and the
+ *            ledger oracle), the config-multi check, and first-seen-order
+ *            SLOT assignment for unique creator/endorser identity bytes.
+ *            Python only resolves each unique identity once (MSP
+ *            deserialize + chain validation) instead of running a ~10k
+ *            iteration bytecode loop per block.
+ * assemble() turns digested works + resolved identity slots into the
+ *            flat dispatch-ordered VerifyItem table (interning with a
+ *            cheap plain-tuple probe — tuples hash/compare equal to the
+ *            VerifyItem NamedTuple, so only FIRST occurrences pay the
+ *            namedtuple construction) and per-tx gate plans.
+ * gate()     folds the device verdict bitmap into final ValidationCodes
+ *            with the same memoized policy-evaluation semantics as
+ *            txvalidator._gate_tx/_memoized_plugin, no per-tx Python.
+ *
+ * The Python tail (_collect_tx_fast/_gate_tx) stays as the line-for-line
+ * mirror and the SBE path; both must produce bit-identical TxFlags
+ * (state-fork invariant).  ValidationCode values are mirrored from
+ * protocol/txflags.py below — guarded by the differential tests.
+ */
+
+#define VC_VALID            0
+#define VC_BAD_CREATOR      4
+#define VC_INVALID_CONFIG   6
+#define VC_DUPLICATE        9
+#define VC_POLICY_FAIL     10
+#define VC_INVALID_CC      25
+#define VC_NOT_VALIDATED  254
+
+/* fastcollect E_* structural code -> ValidationCode (txvalidator._FC_CODES):
+ * NIL_ENVELOPE=1 BAD_PAYLOAD=2 TARGET_CHAIN_NOT_FOUND=14
+ * BAD_PROPOSAL_TXID=8 UNKNOWN_TX_TYPE=13 NIL_TXACTION=16 */
+static const uint8_t FC2VC[7] = {0, 1, 2, 14, 8, 13, 16};
+
+static PyObject *s_verify_item;     /* interned "verify_item" */
+
+/* 1 = duplicate, 0 = fresh, -1 = error.  Order matches the Python tail:
+ * own block's seen dict, then the in-flight carry maps, then the ledger
+ * oracle (None when the validator is unwired — skips the call). */
+static int txid_is_dup(PyObject *txid, PyObject *seen, PyObject *carry,
+                       Py_ssize_t ncarry, PyObject *oracle)
+{
+    int r = PyDict_Contains(seen, txid);
+    if (r != 0)
+        return r;
+    for (Py_ssize_t i = 0; i < ncarry; i++) {
+        r = PyDict_Contains(PyList_GET_ITEM(carry, i), txid);
+        if (r != 0)
+            return r;
+    }
+    if (oracle != Py_None) {
+        PyObject *res = PyObject_CallFunctionObjArgs(oracle, txid, NULL);
+        if (!res)
+            return -1;
+        r = PyObject_IsTrue(res);
+        Py_DECREF(res);
+        return r;
+    }
+    return 0;
+}
+
+/* first-seen-order slot assignment: map[key] -> slot, appending key to
+ * list on first sight.  Returns slot, or -1 with an exception set. */
+static Py_ssize_t slot_of(PyObject *map, PyObject *list, PyObject *key)
+{
+    PyObject *v = PyDict_GetItemWithError(map, key);
+    if (v)
+        return PyLong_AsSsize_t(v);
+    if (PyErr_Occurred())
+        return -1;
+    Py_ssize_t slot = PyList_GET_SIZE(list);
+    PyObject *iv = PyLong_FromSsize_t(slot);
+    if (!iv)
+        return -1;
+    int rc = PyDict_SetItem(map, key, iv);
+    Py_DECREF(iv);
+    if (rc < 0 || PyList_Append(list, key) < 0)
+        return -1;
+    return slot;
+}
+
+/* walker actions [(cc_id, endorsed, ends, ns_writes, meta), ...] ->
+ * digested [(cc_id, endorsed, [(eslot, esig, edigest)...], ns_names)].
+ * Endorsements dedup by endorser bytes per action (policy.go:385-387,
+ * first kept) BEFORE slot assignment — exactly the Python tail's
+ * seen_idents order.  ns_names = sorted({cc_id} | write ns | meta base)
+ * (the non-SBE namespace set; the deep path is only taken without SBE). */
+static PyObject *digest_actions(PyObject *acts, PyObject *emap,
+                                PyObject *endorsers)
+{
+    Py_ssize_t na = PyList_GET_SIZE(acts);
+    PyObject *out = PyList_New(na);
+    if (!out)
+        return NULL;
+    for (Py_ssize_t a = 0; a < na; a++) {
+        PyObject *act = PyList_GET_ITEM(acts, a);
+        PyObject *cc = PyTuple_GET_ITEM(act, 0);
+        PyObject *endorsed = PyTuple_GET_ITEM(act, 1);
+        PyObject *ends = PyTuple_GET_ITEM(act, 2);
+        PyObject *ns_writes = PyTuple_GET_ITEM(act, 3);
+        PyObject *meta = PyTuple_GET_ITEM(act, 4);
+        PyObject *ns_set = NULL, *ns_names = NULL, *eseen = NULL,
+                 *ends2 = NULL, *act2 = NULL;
+        ns_set = PyDict_New();
+        if (!ns_set)
+            goto fail;
+        if (PyDict_SetItem(ns_set, cc, Py_None) < 0)
+            goto fail;
+        for (Py_ssize_t w = 0; w < PyList_GET_SIZE(ns_writes); w++)
+            if (PyDict_SetItem(ns_set,
+                    PyTuple_GET_ITEM(PyList_GET_ITEM(ns_writes, w), 0),
+                    Py_None) < 0)
+                goto fail;
+        for (Py_ssize_t m = 0; m < PyList_GET_SIZE(meta); m++)
+            if (PyDict_SetItem(ns_set,
+                    PyTuple_GET_ITEM(PyList_GET_ITEM(meta, m), 0),
+                    Py_None) < 0)
+                goto fail;
+        ns_names = PyDict_Keys(ns_set);
+        Py_CLEAR(ns_set);
+        if (!ns_names || PyList_Sort(ns_names) < 0)
+            goto fail;
+        eseen = PyDict_New();
+        ends2 = PyList_New(0);
+        if (!eseen || !ends2)
+            goto fail;
+        for (Py_ssize_t e = 0; e < PyList_GET_SIZE(ends); e++) {
+            PyObject *end3 = PyList_GET_ITEM(ends, e);
+            PyObject *edr = PyTuple_GET_ITEM(end3, 0);
+            int dup = PyDict_Contains(eseen, edr);
+            if (dup < 0)
+                goto fail;
+            if (dup)
+                continue;
+            if (PyDict_SetItem(eseen, edr, Py_None) < 0)
+                goto fail;
+            Py_ssize_t slot = slot_of(emap, endorsers, edr);
+            if (slot < 0)
+                goto fail;
+            PyObject *slo = PyLong_FromSsize_t(slot);
+            if (!slo)
+                goto fail;
+            PyObject *t = PyTuple_New(3);
+            if (!t) { Py_DECREF(slo); goto fail; }
+            PyTuple_SET_ITEM(t, 0, slo);
+            Py_INCREF(PyTuple_GET_ITEM(end3, 1));
+            PyTuple_SET_ITEM(t, 1, PyTuple_GET_ITEM(end3, 1));
+            Py_INCREF(PyTuple_GET_ITEM(end3, 2));
+            PyTuple_SET_ITEM(t, 2, PyTuple_GET_ITEM(end3, 2));
+            int rc = PyList_Append(ends2, t);
+            Py_DECREF(t);
+            if (rc < 0)
+                goto fail;
+        }
+        Py_CLEAR(eseen);
+        act2 = PyTuple_New(4);
+        if (!act2)
+            goto fail;
+        Py_INCREF(cc);
+        PyTuple_SET_ITEM(act2, 0, cc);
+        Py_INCREF(endorsed);
+        PyTuple_SET_ITEM(act2, 1, endorsed);
+        PyTuple_SET_ITEM(act2, 2, ends2);
+        PyTuple_SET_ITEM(act2, 3, ns_names);
+        ends2 = ns_names = NULL;            /* ownership moved */
+        PyList_SET_ITEM(out, a, act2);
+        continue;
+    fail:
+        Py_XDECREF(ns_set);
+        Py_XDECREF(ns_names);
+        Py_XDECREF(eseen);
+        Py_XDECREF(ends2);
+        Py_DECREF(out);
+        return NULL;
+    }
+    return out;
+}
+
+/* digest(envs, channel_id, carry, oracle)
+ *   -> (codes: bytearray, seen: {txid: tx_num}, works, creators, endorsers)
+ *
+ * codes[i] is the FINAL ValidationCode for structurally-dead txs and
+ * VC_NOT_VALIDATED (254) for live works.  works[j] =
+ * (tx_num, txtype, creator_slot, payload, pdigest, signature, acts|None);
+ * creators/endorsers are first-seen-ordered unique identity bytes whose
+ * MSP resolution the Python caller performs once per slot. */
+static PyObject *py_digest(PyObject *self, PyObject *args)
+{
+    PyObject *envs, *carry_in, *oracle;
+    const char *chan;
+    Py_ssize_t chan_n;
+    if (!PyArg_ParseTuple(args, "Os#OO", &envs, &chan, &chan_n,
+                          &carry_in, &oracle))
+        return NULL;
+    PyObject *seq = NULL, *carry = NULL, *codes = NULL, *seen = NULL,
+             *works = NULL, *creators = NULL, *endorsers = NULL,
+             *cmap = NULL, *emap = NULL, *ret = NULL;
+    seq = PySequence_Fast(envs, "digest() needs a sequence");
+    if (!seq)
+        return NULL;
+    carry = PySequence_List(carry_in);
+    if (!carry)
+        goto done;
+    Py_ssize_t ncarry = PyList_GET_SIZE(carry);
+    for (Py_ssize_t i = 0; i < ncarry; i++)
+        if (!PyDict_Check(PyList_GET_ITEM(carry, i))) {
+            PyErr_SetString(PyExc_TypeError,
+                            "digest() carry entries must be dicts");
+            goto done;
+        }
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    codes = PyByteArray_FromStringAndSize(NULL, n);
+    seen = PyDict_New();
+    works = PyList_New(0);
+    creators = PyList_New(0);
+    endorsers = PyList_New(0);
+    cmap = PyDict_New();
+    emap = PyDict_New();
+    if (!codes || !seen || !works || !creators || !endorsers || !cmap
+        || !emap)
+        goto done;
+    uint8_t *cp = (uint8_t *)PyByteArray_AS_STRING(codes);
+    memset(cp, VC_NOT_VALIDATED, (size_t)n);
+
+    for (Py_ssize_t i = 0; i < n; i++) {
+        if ((i & 63) == 63) {         /* keep device pump threads fed */
+            Py_BEGIN_ALLOW_THREADS
+            Py_END_ALLOW_THREADS
+        }
+        PyObject *env = PySequence_Fast_GET_ITEM(seq, i);
+        PyObject *rec;
+        if (env == Py_None) {
+            cp[i] = FC2VC[E_NIL_ENVELOPE];
+            continue;
+        }
+        {
+            char *ep;
+            Py_ssize_t en;
+            if (PyBytes_AsStringAndSize(env, &ep, &en) < 0)
+                goto done;
+            rec = collect_env((const uint8_t *)ep, (size_t)en,
+                              (const uint8_t *)chan, (size_t)chan_n);
+        }
+        if (!rec)
+            goto done;
+        if (PyLong_Check(rec)) {      /* pre-registration failure */
+            long code = PyLong_AsLong(rec);
+            Py_DECREF(rec);
+            cp[i] = FC2VC[code];
+            continue;
+        }
+        Py_ssize_t rlen = PyTuple_GET_SIZE(rec);
+        PyObject *txid = PyTuple_GET_ITEM(rec, 1);
+        int dup = txid_is_dup(txid, seen, carry, ncarry, oracle);
+        if (dup < 0) { Py_DECREF(rec); goto done; }
+        if (dup) {
+            cp[i] = VC_DUPLICATE;
+            Py_DECREF(rec);
+            continue;
+        }
+        {
+            PyObject *num = PyLong_FromSsize_t(i);
+            int rc = num ? PyDict_SetItem(seen, txid, num) : -1;
+            Py_XDECREF(num);
+            if (rc < 0) { Py_DECREF(rec); goto done; }
+        }
+        if (rlen == 2) {              /* post-registration failure */
+            long code = PyLong_AsLong(PyTuple_GET_ITEM(rec, 0));
+            Py_DECREF(rec);
+            cp[i] = FC2VC[code];
+            continue;
+        }
+        long txtype = PyLong_AsLong(PyTuple_GET_ITEM(rec, 0));
+        if (txtype == 0 && n != 1) {  /* config tx in a multi-tx block */
+            cp[i] = VC_INVALID_CONFIG;
+            Py_DECREF(rec);
+            continue;
+        }
+        Py_ssize_t cslot = slot_of(cmap, creators,
+                                   PyTuple_GET_ITEM(rec, 2));
+        if (cslot < 0) { Py_DECREF(rec); goto done; }
+        PyObject *acts_in = PyTuple_GET_ITEM(rec, 6);
+        PyObject *acts2;
+        if (acts_in == Py_None) {
+            acts2 = Py_None;
+            Py_INCREF(acts2);
+        } else {
+            acts2 = digest_actions(acts_in, emap, endorsers);
+            if (!acts2) { Py_DECREF(rec); goto done; }
+        }
+        PyObject *work = PyTuple_New(7);
+        PyObject *txo = PyLong_FromSsize_t(i);
+        PyObject *typo = PyLong_FromLong(txtype);
+        PyObject *cso = PyLong_FromSsize_t(cslot);
+        if (!work || !txo || !typo || !cso) {
+            Py_XDECREF(work); Py_XDECREF(txo); Py_XDECREF(typo);
+            Py_XDECREF(cso); Py_DECREF(acts2); Py_DECREF(rec);
+            goto done;
+        }
+        PyTuple_SET_ITEM(work, 0, txo);
+        PyTuple_SET_ITEM(work, 1, typo);
+        PyTuple_SET_ITEM(work, 2, cso);
+        Py_INCREF(PyTuple_GET_ITEM(rec, 3));
+        PyTuple_SET_ITEM(work, 3, PyTuple_GET_ITEM(rec, 3));  /* payload */
+        Py_INCREF(PyTuple_GET_ITEM(rec, 4));
+        PyTuple_SET_ITEM(work, 4, PyTuple_GET_ITEM(rec, 4));  /* pdigest */
+        Py_INCREF(PyTuple_GET_ITEM(rec, 5));
+        PyTuple_SET_ITEM(work, 5, PyTuple_GET_ITEM(rec, 5));  /* signature */
+        PyTuple_SET_ITEM(work, 6, acts2);
+        Py_DECREF(rec);
+        int rc = PyList_Append(works, work);
+        Py_DECREF(work);
+        if (rc < 0)
+            goto done;
+    }
+    ret = PyTuple_New(5);
+    if (!ret)
+        goto done;
+    PyTuple_SET_ITEM(ret, 0, codes);
+    PyTuple_SET_ITEM(ret, 1, seen);
+    PyTuple_SET_ITEM(ret, 2, works);
+    PyTuple_SET_ITEM(ret, 3, creators);
+    PyTuple_SET_ITEM(ret, 4, endorsers);
+    codes = seen = works = creators = endorsers = NULL;
+done:
+    Py_XDECREF(seq);
+    Py_XDECREF(carry);
+    Py_XDECREF(codes);
+    Py_XDECREF(seen);
+    Py_XDECREF(works);
+    Py_XDECREF(creators);
+    Py_XDECREF(endorsers);
+    Py_XDECREF(cmap);
+    Py_XDECREF(emap);
+    return ret;
+}
+
+/* VerifyItem interning.  index maps item -> dispatch position; for
+ * P-256 items we probe with a plain 4-tuple FIRST (a tuple hashes and
+ * compares equal to the NamedTuple with the same fields) so repeats —
+ * the overwhelmingly common case on real blocks — never construct the
+ * NamedTuple at all.  Stored keys must be real VerifyItems because the
+ * dispatch path reads .scheme/.pubkey attributes off them. */
+static Py_ssize_t intern_p256(PyObject *index, PyObject *cls,
+                              PyObject *scheme, PyObject *wire,
+                              PyObject *sig, PyObject *dig)
+{
+    PyObject *probe = PyTuple_Pack(4, scheme, wire, sig, dig);
+    if (!probe)
+        return -1;
+    PyObject *v = PyDict_GetItemWithError(index, probe);
+    if (v) {
+        Py_DECREF(probe);
+        return PyLong_AsSsize_t(v);
+    }
+    if (PyErr_Occurred()) { Py_DECREF(probe); return -1; }
+    PyObject *item = PyObject_CallObject(cls, probe);
+    Py_DECREF(probe);
+    if (!item)
+        return -1;
+    Py_ssize_t idx = PyDict_GET_SIZE(index);
+    PyObject *iv = PyLong_FromSsize_t(idx);
+    int rc = iv ? PyDict_SetItem(index, item, iv) : -1;
+    Py_XDECREF(iv);
+    Py_DECREF(item);
+    return rc < 0 ? -1 : idx;
+}
+
+/* non-P-256 item (already a VerifyItem/own item shape): plain intern */
+static Py_ssize_t intern_item(PyObject *index, PyObject *item)
+{
+    PyObject *v = PyDict_GetItemWithError(index, item);
+    if (v)
+        return PyLong_AsSsize_t(v);
+    if (PyErr_Occurred())
+        return -1;
+    Py_ssize_t idx = PyDict_GET_SIZE(index);
+    PyObject *iv = PyLong_FromSsize_t(idx);
+    if (!iv)
+        return -1;
+    int rc = PyDict_SetItem(index, item, iv);
+    Py_DECREF(iv);
+    return rc < 0 ? -1 : idx;
+}
+
+/* assemble(works, c_ents, e_ents, endorsers, codes, index, plans,
+ *          verify_item_cls, scheme_p256, policy_for, pol_cache) -> n_refs
+ *
+ * c_ents/e_ents: per-slot (identity, p256_pub_wire|None) or None for
+ * identities the MSP rejected.  Appends to `plans`
+ * (tx_num, creator_idx, [(policy, [(item_idx, identity)...])...]) and
+ * interns items into `index` in EXACTLY the Python tail's order:
+ * creator first, then each action's endorsements, then that action's
+ * namespace policy lookups (a missing policy kills the tx but keeps
+ * already-interned items — n_unique_items parity).  n_refs counts
+ * 1 + sigset size per namespace entry over SURVIVING works only,
+ * matching _finish_inner's accounting. */
+static PyObject *py_assemble(PyObject *self, PyObject *args)
+{
+    PyObject *works, *c_ents, *e_ents, *endorsers, *codes, *index,
+             *plans, *cls, *scheme, *policy_for, *pol_cache;
+    if (!PyArg_ParseTuple(args, "OOOOOOOOOOO", &works, &c_ents, &e_ents,
+                          &endorsers, &codes, &index, &plans, &cls,
+                          &scheme, &policy_for, &pol_cache))
+        return NULL;
+    if (!PyList_Check(works) || !PyList_Check(c_ents)
+        || !PyList_Check(e_ents) || !PyList_Check(endorsers)
+        || !PyByteArray_Check(codes) || !PyDict_Check(index)
+        || !PyList_Check(plans) || !PyDict_Check(pol_cache)) {
+        PyErr_SetString(PyExc_TypeError, "assemble(): bad argument types");
+        return NULL;
+    }
+    uint8_t *cp = (uint8_t *)PyByteArray_AS_STRING(codes);
+    Py_ssize_t ncodes = PyByteArray_GET_SIZE(codes);
+    Py_ssize_t n_refs = 0;
+    for (Py_ssize_t w = 0; w < PyList_GET_SIZE(works); w++) {
+        if ((w & 255) == 255) {
+            Py_BEGIN_ALLOW_THREADS
+            Py_END_ALLOW_THREADS
+        }
+        PyObject *work = PyList_GET_ITEM(works, w);
+        Py_ssize_t tx = PyLong_AsSsize_t(PyTuple_GET_ITEM(work, 0));
+        long txtype = PyLong_AsLong(PyTuple_GET_ITEM(work, 1));
+        Py_ssize_t cslot = PyLong_AsSsize_t(PyTuple_GET_ITEM(work, 2));
+        if (tx < 0 || tx >= ncodes || cslot < 0
+            || cslot >= PyList_GET_SIZE(c_ents)) {
+            PyErr_SetString(PyExc_IndexError, "assemble(): slot range");
+            return NULL;
+        }
+        PyObject *ent = PyList_GET_ITEM(c_ents, cslot);
+        if (ent == Py_None) {         /* MSP rejected the creator */
+            cp[tx] = VC_BAD_CREATOR;
+            continue;
+        }
+        PyObject *creator = PyTuple_GET_ITEM(ent, 0);
+        PyObject *wire = PyTuple_GET_ITEM(ent, 1);
+        Py_ssize_t cidx;
+        if (wire != Py_None) {
+            cidx = intern_p256(index, cls, scheme, wire,
+                               PyTuple_GET_ITEM(work, 5),   /* signature */
+                               PyTuple_GET_ITEM(work, 4));  /* pdigest */
+        } else {
+            PyObject *item = PyObject_CallMethodObjArgs(
+                creator, s_verify_item, PyTuple_GET_ITEM(work, 3),
+                PyTuple_GET_ITEM(work, 5), NULL);
+            if (!item)
+                return NULL;
+            cidx = intern_item(index, item);
+            Py_DECREF(item);
+        }
+        if (cidx < 0)
+            return NULL;
+        PyObject *entries = PyList_New(0);
+        if (!entries)
+            return NULL;
+        int dead = 0;
+        PyObject *acts = PyTuple_GET_ITEM(work, 6);
+        if (txtype != 0 && acts != Py_None) {
+            for (Py_ssize_t a = 0;
+                 !dead && a < PyList_GET_SIZE(acts); a++) {
+                PyObject *act = PyList_GET_ITEM(acts, a);
+                PyObject *endorsed = PyTuple_GET_ITEM(act, 1);
+                PyObject *ends2 = PyTuple_GET_ITEM(act, 2);
+                PyObject *ns_names = PyTuple_GET_ITEM(act, 3);
+                PyObject *sigset = PyList_New(0);
+                if (!sigset) { Py_DECREF(entries); return NULL; }
+                for (Py_ssize_t e = 0; e < PyList_GET_SIZE(ends2); e++) {
+                    PyObject *end3 = PyList_GET_ITEM(ends2, e);
+                    Py_ssize_t slot =
+                        PyLong_AsSsize_t(PyTuple_GET_ITEM(end3, 0));
+                    if (slot < 0 || slot >= PyList_GET_SIZE(e_ents)) {
+                        PyErr_SetString(PyExc_IndexError,
+                                        "assemble(): endorser slot");
+                        Py_DECREF(sigset); Py_DECREF(entries);
+                        return NULL;
+                    }
+                    PyObject *eent = PyList_GET_ITEM(e_ents, slot);
+                    if (eent == Py_None)   /* undeserializable: skip */
+                        continue;
+                    PyObject *ident = PyTuple_GET_ITEM(eent, 0);
+                    PyObject *ewire = PyTuple_GET_ITEM(eent, 1);
+                    Py_ssize_t eidx;
+                    if (ewire != Py_None) {
+                        eidx = intern_p256(index, cls, scheme, ewire,
+                                           PyTuple_GET_ITEM(end3, 1),
+                                           PyTuple_GET_ITEM(end3, 2));
+                    } else {
+                        PyObject *msg = PySequence_Concat(
+                            endorsed, PyList_GET_ITEM(endorsers, slot));
+                        if (!msg) {
+                            Py_DECREF(sigset); Py_DECREF(entries);
+                            return NULL;
+                        }
+                        PyObject *item = PyObject_CallMethodObjArgs(
+                            ident, s_verify_item, msg,
+                            PyTuple_GET_ITEM(end3, 1), NULL);
+                        Py_DECREF(msg);
+                        if (!item) {
+                            Py_DECREF(sigset); Py_DECREF(entries);
+                            return NULL;
+                        }
+                        eidx = intern_item(index, item);
+                        Py_DECREF(item);
+                    }
+                    if (eidx < 0) {
+                        Py_DECREF(sigset); Py_DECREF(entries);
+                        return NULL;
+                    }
+                    PyObject *eio = PyLong_FromSsize_t(eidx);
+                    PyObject *pair = eio ? PyTuple_New(2) : NULL;
+                    if (!pair) {
+                        Py_XDECREF(eio);
+                        Py_DECREF(sigset); Py_DECREF(entries);
+                        return NULL;
+                    }
+                    PyTuple_SET_ITEM(pair, 0, eio);
+                    Py_INCREF(ident);
+                    PyTuple_SET_ITEM(pair, 1, ident);
+                    int rc = PyList_Append(sigset, pair);
+                    Py_DECREF(pair);
+                    if (rc < 0) {
+                        Py_DECREF(sigset); Py_DECREF(entries);
+                        return NULL;
+                    }
+                }
+                for (Py_ssize_t s = 0; s < PyList_GET_SIZE(ns_names);
+                     s++) {
+                    PyObject *ns = PyList_GET_ITEM(ns_names, s);
+                    PyObject *pol =
+                        PyDict_GetItemWithError(pol_cache, ns);
+                    if (!pol) {
+                        if (PyErr_Occurred()) {
+                            Py_DECREF(sigset); Py_DECREF(entries);
+                            return NULL;
+                        }
+                        pol = PyObject_CallFunctionObjArgs(policy_for,
+                                                           ns, NULL);
+                        if (!pol || PyDict_SetItem(pol_cache, ns,
+                                                   pol) < 0) {
+                            Py_XDECREF(pol);
+                            Py_DECREF(sigset); Py_DECREF(entries);
+                            return NULL;
+                        }
+                        Py_DECREF(pol);   /* pol_cache holds it */
+                    }
+                    if (pol == Py_None) {  /* unknown namespace */
+                        cp[tx] = VC_INVALID_CC;
+                        dead = 1;
+                        break;
+                    }
+                    PyObject *entry = PyTuple_New(2);
+                    if (!entry) {
+                        Py_DECREF(sigset); Py_DECREF(entries);
+                        return NULL;
+                    }
+                    Py_INCREF(pol);
+                    PyTuple_SET_ITEM(entry, 0, pol);
+                    Py_INCREF(sigset);
+                    PyTuple_SET_ITEM(entry, 1, sigset);
+                    int rc = PyList_Append(entries, entry);
+                    Py_DECREF(entry);
+                    if (rc < 0) {
+                        Py_DECREF(sigset); Py_DECREF(entries);
+                        return NULL;
+                    }
+                }
+                Py_DECREF(sigset);
+            }
+        }
+        if (dead) {
+            Py_DECREF(entries);
+            continue;
+        }
+        n_refs += 1;
+        for (Py_ssize_t s = 0; s < PyList_GET_SIZE(entries); s++)
+            n_refs += PyList_GET_SIZE(
+                PyTuple_GET_ITEM(PyList_GET_ITEM(entries, s), 1));
+        PyObject *plan = PyTuple_New(3);
+        PyObject *cio = PyLong_FromSsize_t(cidx);
+        if (!plan || !cio) {
+            Py_XDECREF(plan); Py_XDECREF(cio); Py_DECREF(entries);
+            return NULL;
+        }
+        Py_INCREF(PyTuple_GET_ITEM(work, 0));
+        PyTuple_SET_ITEM(plan, 0, PyTuple_GET_ITEM(work, 0));
+        PyTuple_SET_ITEM(plan, 1, cio);
+        PyTuple_SET_ITEM(plan, 2, entries);
+        int rc = PyList_Append(plans, plan);
+        Py_DECREF(plan);
+        if (rc < 0)
+            return NULL;
+    }
+    return PyLong_FromSsize_t(n_refs);
+}
+
+/* gate(plans, verdict: buffer[u8], codes, plugin, evaluator, eval_cache)
+ *
+ * Folds the device verdict bitmap into final ValidationCodes without a
+ * per-tx Python loop.  Per plan: creator bit (miss -> BAD_CREATOR_SIG),
+ * then per (policy, sigset) the verdict-filtered valid-identity list is
+ * evaluated via `plugin` memoized in eval_cache keyed
+ * (id(policy), id(ident)...) — same purity argument as
+ * txvalidator._memoized_plugin (policies and identities are interned
+ * per block, so ids are stable).  Any falsy evaluation ->
+ * ENDORSEMENT_POLICY_FAILURE, else VALID. */
+static PyObject *py_gate(PyObject *self, PyObject *args)
+{
+    PyObject *plans, *codes, *plugin, *evaluator, *eval_cache;
+    Py_buffer vb;
+    if (!PyArg_ParseTuple(args, "Oy*OOOO", &plans, &vb, &codes, &plugin,
+                          &evaluator, &eval_cache))
+        return NULL;
+    if (!PyList_Check(plans) || !PyByteArray_Check(codes)
+        || !PyDict_Check(eval_cache)) {
+        PyBuffer_Release(&vb);
+        PyErr_SetString(PyExc_TypeError, "gate(): bad argument types");
+        return NULL;
+    }
+    const uint8_t *v = (const uint8_t *)vb.buf;
+    Py_ssize_t nv = vb.len;
+    uint8_t *cp = (uint8_t *)PyByteArray_AS_STRING(codes);
+    Py_ssize_t ncodes = PyByteArray_GET_SIZE(codes);
+    for (Py_ssize_t p = 0; p < PyList_GET_SIZE(plans); p++) {
+        if ((p & 255) == 255) {
+            Py_BEGIN_ALLOW_THREADS
+            Py_END_ALLOW_THREADS
+        }
+        PyObject *plan = PyList_GET_ITEM(plans, p);
+        Py_ssize_t tx = PyLong_AsSsize_t(PyTuple_GET_ITEM(plan, 0));
+        Py_ssize_t cidx = PyLong_AsSsize_t(PyTuple_GET_ITEM(plan, 1));
+        if (tx < 0 || tx >= ncodes)
+            goto typefail;
+        if (cidx < 0 || cidx >= nv || !v[cidx]) {
+            cp[tx] = VC_BAD_CREATOR;
+            continue;
+        }
+        PyObject *entries = PyTuple_GET_ITEM(plan, 2);
+        int failed = 0;
+        for (Py_ssize_t s = 0;
+             !failed && s < PyList_GET_SIZE(entries); s++) {
+            PyObject *entry = PyList_GET_ITEM(entries, s);
+            PyObject *pol = PyTuple_GET_ITEM(entry, 0);
+            PyObject *sigset = PyTuple_GET_ITEM(entry, 1);
+            Py_ssize_t m = PyList_GET_SIZE(sigset);
+            PyObject *valid = PyList_New(0);
+            if (!valid)
+                goto fail;
+            for (Py_ssize_t e = 0; e < m; e++) {
+                PyObject *pair = PyList_GET_ITEM(sigset, e);
+                Py_ssize_t idx =
+                    PyLong_AsSsize_t(PyTuple_GET_ITEM(pair, 0));
+                if (idx >= 0 && idx < nv && v[idx]
+                    && PyList_Append(valid,
+                                     PyTuple_GET_ITEM(pair, 1)) < 0) {
+                    Py_DECREF(valid);
+                    goto fail;
+                }
+            }
+            Py_ssize_t nvalid = PyList_GET_SIZE(valid);
+            PyObject *key = PyTuple_New(1 + nvalid);
+            if (!key) { Py_DECREF(valid); goto fail; }
+            PyObject *ko = PyLong_FromVoidPtr((void *)pol);
+            if (!ko) { Py_DECREF(key); Py_DECREF(valid); goto fail; }
+            PyTuple_SET_ITEM(key, 0, ko);
+            int keyfail = 0;
+            for (Py_ssize_t e = 0; e < nvalid; e++) {
+                ko = PyLong_FromVoidPtr(
+                    (void *)PyList_GET_ITEM(valid, e));
+                if (!ko) { keyfail = 1; break; }
+                PyTuple_SET_ITEM(key, 1 + e, ko);
+            }
+            if (keyfail) {
+                Py_DECREF(key); Py_DECREF(valid);
+                goto fail;
+            }
+            PyObject *r = PyDict_GetItemWithError(eval_cache, key);
+            int truth;
+            if (r) {
+                truth = PyObject_IsTrue(r);
+            } else {
+                if (PyErr_Occurred()) {
+                    Py_DECREF(key); Py_DECREF(valid);
+                    goto fail;
+                }
+                PyObject *r2 = PyObject_CallFunctionObjArgs(
+                    plugin, pol, valid, evaluator, NULL);
+                if (!r2 || PyDict_SetItem(eval_cache, key, r2) < 0) {
+                    Py_XDECREF(r2); Py_DECREF(key); Py_DECREF(valid);
+                    goto fail;
+                }
+                truth = PyObject_IsTrue(r2);
+                Py_DECREF(r2);
+            }
+            Py_DECREF(key);
+            Py_DECREF(valid);
+            if (truth < 0)
+                goto fail;
+            if (!truth) {
+                cp[tx] = VC_POLICY_FAIL;
+                failed = 1;
+            }
+        }
+        if (!failed)
+            cp[tx] = VC_VALID;
+    }
+    PyBuffer_Release(&vb);
+    Py_RETURN_NONE;
+typefail:
+    PyErr_SetString(PyExc_IndexError, "gate(): tx out of range");
+fail:
+    PyBuffer_Release(&vb);
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
 /* Batched strict-DER ECDSA signature parsing.
  *
  * The provider's P-256 pass parses every signature's DER SEQUENCE of
@@ -1155,6 +1888,14 @@ static PyObject *py_sha256(PyObject *self, PyObject *args)
 static PyMethodDef methods[] = {
     {"collect", py_collect, METH_VARARGS,
      "collect(envs, channel_id) -> per-tx structural results"},
+    {"digest", py_digest, METH_VARARGS,
+     "digest(envs, channel_id, carry, oracle) -> "
+     "(codes, seen, works, creators, endorsers)"},
+    {"assemble", py_assemble, METH_VARARGS,
+     "assemble(works, c_ents, e_ents, endorsers, codes, index, plans, "
+     "verify_item_cls, scheme_p256, policy_for, pol_cache) -> n_refs"},
+    {"gate", py_gate, METH_VARARGS,
+     "gate(plans, verdict, codes, plugin, evaluator, eval_cache)"},
     {"parse_der_sigs", py_parse_der_sigs, METH_VARARGS,
      "parse_der_sigs(sigs) -> (ok bytes, r32s32 bytes)"},
     {"sha256", py_sha256, METH_VARARGS, "sha256(data) -> 32-byte digest"},
@@ -1171,5 +1912,8 @@ PyMODINIT_FUNC PyInit__fastcollect(void)
     if (__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx) && (ebx & (1u << 29)))
         sha256_block = sha256_block_shani;
 #endif
+    s_verify_item = PyUnicode_InternFromString("verify_item");
+    if (!s_verify_item)
+        return NULL;
     return PyModule_Create(&moddef);
 }

@@ -435,7 +435,8 @@ class PeerNode:
         self.channel_id = cfg.get("channel_id", "ch")
         self.provider = init_factories(
             FactoryOpts(default=cfg.get("bccsp", "SW"),
-                        degrade=bool(cfg.get("bccsp_degrade", False))))
+                        degrade=bool(cfg.get("bccsp_degrade", False)),
+                        compile_cache_dir=cfg.get("compile_cache_dir")))
         self.signer = load_signing_identity(
             cfg["mspid"], cfg["cert_pem"].encode(), cfg["key_pem"].encode())
         self.mspid = cfg["mspid"]

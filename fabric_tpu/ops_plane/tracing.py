@@ -199,11 +199,20 @@ class _Activation:
 class FlightRecorder:
     """Bounded store of finished traces: last `max_traces` complete ones
     plus the `max_slow` slowest ever seen (so a tail-latency outlier
-    survives long after ring eviction — the flight-recorder property)."""
+    survives long after ring eviction — the flight-recorder property).
 
-    def __init__(self, max_traces: int = 256, max_slow: int = 32):
+    `retention` adds a per-root-span-name cap on top of the global ring:
+    ``{"gossip.pull_window": 8}`` keeps only the newest 8 pull-window
+    traces, so a high-frequency poller can't flush the rarer (and more
+    interesting) request/block traces out of the recorder.  Configured
+    via the tracing localconfig sub-dict, e.g.
+    ``FABRIC_TPU_PEER_TRACING__RETENTION='{"gossip.pull_window": 8}'``."""
+
+    def __init__(self, max_traces: int = 256, max_slow: int = 32,
+                 retention: Optional[Dict[str, int]] = None):
         self.max_traces = int(max_traces)
         self.max_slow = int(max_slow)
+        self.retention = dict(retention or {})   # root span name -> max kept
         self._lock = threading.Lock()
         self._recent: "OrderedDict[str, dict]" = OrderedDict()
         self._slow: List[dict] = []          # sorted by duration desc
@@ -218,6 +227,14 @@ class FlightRecorder:
                                         record["duration_s"])
                 record = old
             self._recent[tid] = record
+            root = record.get("root_name")
+            cap = self.retention.get(root) if self.retention else None
+            if cap is not None:
+                # oldest-first: OrderedDict keeps insertion order
+                same = [k for k, r in self._recent.items()
+                        if r.get("root_name") == root]
+                for k in same[:max(0, len(same) - int(cap))]:
+                    self._maybe_keep_slow(self._recent.pop(k))
             while len(self._recent) > self.max_traces:
                 evicted_id, evicted = self._recent.popitem(last=False)
                 self._maybe_keep_slow(evicted)
@@ -307,6 +324,10 @@ class Tracer:
             cfg.get("max_traces", self.recorder.max_traces))
         self.recorder.max_slow = int(
             cfg.get("max_slow", self.recorder.max_slow))
+        retention = cfg.get("retention")
+        if retention is not None:
+            self.recorder.retention = {str(k): int(v)
+                                       for k, v in dict(retention).items()}
         return self
 
     # -- context ------------------------------------------------------------

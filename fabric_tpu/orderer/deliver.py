@@ -52,29 +52,52 @@ class DeliverHandler:
 
         `signed` is the deliver request's creator triple, checked against
         the channel Readers policy when the channel enforces one.
+
+        When the request rode in on a traced RPC (the req frame carried
+        a traceparent — e.g. a leader peer's gossip.pull_window), the
+        stream is timed under an `orderer.deliver` child span; untraced
+        traffic records nothing (require_parent).
         """
-        support = self.registrar.get(channel_id)
-        if support is None:
-            raise DeliverError(f"unknown channel {channel_id!r}")
-        support.authorize_read(signed)
+        from fabric_tpu.ops_plane import tracing
+        span = tracing.tracer.start_span(
+            "orderer.deliver", require_parent=True,
+            attributes={"channel": channel_id})
+        sent = 0
+        status = "OK"
+        try:
+            support = self.registrar.get(channel_id)
+            if support is None:
+                raise DeliverError(f"unknown channel {channel_id!r}")
+            support.authorize_read(signed)
 
-        height = support.ledger.height
-        start = self._resolve(seek.start, height)
-        stop = (self._resolve(seek.stop, height)
-                if seek.stop is not None else None)
-        if stop is not None and stop < start:
-            raise DeliverError(f"seek stop {stop} < start {start}")
+            height = support.ledger.height
+            start = self._resolve(seek.start, height)
+            stop = (self._resolve(seek.stop, height)
+                    if seek.stop is not None else None)
+            if stop is not None and stop < start:
+                raise DeliverError(f"seek stop {stop} < start {start}")
+            span.set_attribute("start", start)
 
-        num = start
-        while stop is None or num <= stop:
-            if num >= support.ledger.height:
-                if seek.behavior == BEHAVIOR_FAIL_IF_NOT_READY:
-                    raise NotReadyError(
-                        f"block {num} past tip {support.ledger.height}")
-                if not support.wait_for_height(num + 1, timeout_s):
-                    return  # timed out waiting at the tip
-            yield support.ledger.get_by_number(num)
-            num += 1
+            num = start
+            while stop is None or num <= stop:
+                if num >= support.ledger.height:
+                    if seek.behavior == BEHAVIOR_FAIL_IF_NOT_READY:
+                        raise NotReadyError(
+                            f"block {num} past tip {support.ledger.height}")
+                    if not support.wait_for_height(num + 1, timeout_s):
+                        return  # timed out waiting at the tip
+                yield support.ledger.get_by_number(num)
+                sent += 1
+                num += 1
+        except NotReadyError:
+            raise    # at-tip is the normal end of a window pull
+        except BaseException as e:   # incl. GeneratorExit on client cancel
+            span.set_attribute("error", repr(e))
+            status = "ERROR"
+            raise
+        finally:
+            span.set_attribute("blocks", sent)
+            span.end(status=status)
 
     @staticmethod
     def _resolve(pos, height: int) -> int:

@@ -34,6 +34,9 @@ python tests/smoke_traces.py
 echo "== seeded chaos probe (fault plane + convergence) =="
 python tests/smoke_chaos.py
 
+echo "== native streamed-window probe (C tail/gate vs Python mirror) =="
+python tests/smoke_window.py
+
 echo "== non-slow test subset =="
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 echo "OK: smoke passed"

@@ -1,0 +1,95 @@
+"""Streamed-window smoke probe (called by smoke.sh).
+
+Builds (or loads the prebuilt) native fastcollect extension — the
+import below triggers the lazy mtime-checked build in
+fabric_tpu/native/__init__.py — then runs an 8-block streamed
+validation window (depth-2 pipeline, carry-aware duplicates) TWICE:
+once on the deep C tail/gate path and once forced onto the pure-Python
+mirror.  Exits non-zero if the extension is missing its deep entry
+points or if ANY per-tx flag diverges between the two paths: one
+diverging flag forks the state of a mixed C/Python fleet, so this is a
+hard gate, not a warning.  Named smoke_* (not test_*) on purpose: this
+is a script for the shell gate, not a pytest module.
+"""
+
+import sys
+
+
+def main() -> int:
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    provider = init_factories(FactoryOpts(default="SW"))
+
+    from fabric_tpu.committer import txvalidator as tv
+    if tv._fastcollect is None:
+        print("FAIL: native _fastcollect did not build/load",
+              file=sys.stderr)
+        return 1
+    for entry in ("collect", "digest", "assemble", "gate"):
+        if not hasattr(tv._fastcollect, entry):
+            print(f"FAIL: _fastcollect lacks {entry}()", file=sys.stderr)
+            return 1
+
+    from fabric_tpu.committer import PolicyRegistry, TxValidator
+    from fabric_tpu.msp import CachedMSP
+    from fabric_tpu.msp.ca import DevOrg
+    from fabric_tpu.policy import parse_policy
+    from fabric_tpu.protocol import KVWrite, NsRwSet, TxRwSet, build
+    from fabric_tpu.protocol.types import Block, BlockHeader, BlockMetadata
+
+    org1, org2 = DevOrg("Org1"), DevOrg("Org2")
+    msps = {o.mspid: CachedMSP(o.msp()) for o in (org1, org2)}
+    policies = PolicyRegistry()
+    policies.set_policy(
+        "cc", parse_policy("OR('Org1.member', 'Org2.member')"))
+
+    def tx(b, i):
+        rws = TxRwSet((NsRwSet(
+            "cc", writes=(KVWrite(f"b{b}k{i}", b"v"),)),))
+        return build.endorser_tx(
+            "ch", "cc", "1.0", rws, org1.new_identity("c"),
+            [org1.new_identity("e1"), org2.new_identity("e2")])
+
+    blocks = []
+    carry_dup = tx(0, 999).serialize()
+    for b in range(8):
+        raws = [tx(b, i).serialize() for i in range(24)]
+        raws[5] = raws[4]                     # intra-block duplicate
+        raws[9] = raws[9][:-7]                # truncated envelope
+        if b in (3, 5):
+            raws.append(carry_dup)            # first sighting / carry dup
+        blocks.append(Block(BlockHeader(b, b"p", b"d"), raws,
+                            BlockMetadata()))
+
+    def run(force_py):
+        v = TxValidator("ch", msps, provider, policies)
+        v.force_python_collect = force_py
+        out, pending = [], []
+        for blk in blocks:                    # depth-2 streamed window
+            pending.append(v.validate_begin(blk))
+            if len(pending) >= 2:
+                out.append(v.validate_finish(pending.pop(0)).flags.codes())
+        while pending:
+            out.append(v.validate_finish(pending.pop(0)).flags.codes())
+        return out
+
+    native = run(False)
+    pure = run(True)
+    if native != pure:
+        for bn, (a, c) in enumerate(zip(native, pure)):
+            if a != c:
+                print(f"FAIL: flag divergence in block {bn}:\n"
+                      f"  native: {a}\n  python: {c}", file=sys.stderr)
+        return 1
+    n_tx = sum(len(c) for c in native)
+    n_valid = sum(x == 0 for c in native for x in c)
+    if n_valid == 0 or n_valid == n_tx:
+        print(f"FAIL: degenerate corpus ({n_valid}/{n_tx} valid)",
+              file=sys.stderr)
+        return 1
+    print(f"OK: 8-block streamed window, {n_tx} txs, {n_valid} valid, "
+          "C and Python paths bit-identical")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

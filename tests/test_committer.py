@@ -351,6 +351,123 @@ def test_fast_collect_late_error_parity_and_deep_nesting(world):
     assert fast[2] == int(ValidationCode.BAD_PAYLOAD)
 
 
+def test_deep_collect_three_way_differential_fuzz(world):
+    """State-fork invariant fuzz: the deep C tail (digest/assemble/gate),
+    the classic C-walker + Python-tail, and the pure-Python mirror must
+    produce bit-identical TxFlags and item counts over randomized
+    adversarial corpora — intra-block txid collisions, carry collisions
+    across PIPELINED blocks, ledger-oracle duplicates, unknown-org
+    creators, config txs, wrong-channel headers, and non-canonical
+    envelope bytes (truncations, junk, bitflips)."""
+    from fabric_tpu.committer import txvalidator as tv
+    if tv._fastcollect is None or not hasattr(tv._fastcollect, "digest"):
+        pytest.skip("deep native tail unavailable")
+    import random
+    from fabric_tpu.bccsp.factory import get_default
+    from fabric_tpu.protocol.types import Block, BlockHeader, BlockMetadata
+
+    org1, org2, _committer = world
+    stranger = DevOrg("OrgX")        # mspid absent from the validator MSPs
+    provider = get_default()
+    msps = {o.mspid: CachedMSP(o.msp()) for o in (org1, org2)}
+    policies = PolicyRegistry()
+    policies.set_policy("cc", parse_policy(
+        "OR('Org1.member', 'Org2.member')"))
+
+    # one tx whose txid the "ledger" already holds (oracle duplicate)
+    led_nonce = b"oracle-nonce-0001"
+    led_creator = org1.new_identity("led")
+    led_txid = build.compute_txid(led_nonce, led_creator.serialize())
+    led_raw = build.endorser_tx(
+        "ch", "cc", "1.0", rw(writes=[KVWrite("led", b"1")]), led_creator,
+        [org1.new_identity("e1"), org2.new_identity("e2")],
+        nonce=led_nonce).serialize()
+
+    def corpus(rng, n=30):
+        raws = []
+        for _ in range(n):
+            kind = rng.randrange(10)
+            if kind == 0 and raws:
+                raws.append(rng.choice(raws))          # intra-block dup
+                continue
+            creator = (stranger.new_identity("ghost") if kind == 1 else
+                       (org1 if rng.random() < 0.5 else
+                        org2).new_identity("c"))
+            if kind == 6:
+                raws.append(build.signed_envelope(
+                    "config", "ch", {"config": {"sequence": 1}},
+                    creator).serialize())
+                continue
+            ends = ([org1.new_identity("e1")] if kind == 2 else
+                    [org1.new_identity("e1"), org2.new_identity("e2")])
+            chan = "other" if kind == 7 else "ch"
+            rwset = rw(writes=[KVWrite(f"k{rng.random()}", b"v")])
+            raw = build.endorser_tx(chan, "cc", "1.0", rwset, creator,
+                                    ends).serialize()
+            if kind == 3 and len(raw) > 4:
+                raw = raw[:rng.randrange(1, len(raw))]  # truncated
+            elif kind == 4:
+                raw = rng.randbytes(rng.randrange(0, 48))   # junk
+            elif kind == 5:
+                mut = bytearray(raw)
+                mut[rng.randrange(len(mut))] ^= 0xFF        # bitflip
+                raw = bytes(mut)
+            raws.append(raw)
+        return raws
+
+    class _NoDigest:
+        """Hide `digest` so the validator takes the classic
+        C-walker + Python-tail path."""
+        def __init__(self, mod):
+            self._mod = mod
+
+        def __getattr__(self, name):
+            if name == "digest":
+                raise AttributeError(name)
+            return getattr(self._mod, name)
+
+    def run(mode, b1raws, b2raws, dup_raw):
+        v = TxValidator("ch", msps, provider, policies,
+                        ledger_has_txid=lambda t: t == led_txid)
+        real = tv._fastcollect
+        if mode == "python":
+            v.force_python_collect = True
+        elif mode == "classic":
+            tv._fastcollect = _NoDigest(real)
+        try:
+            b1 = Block(BlockHeader(5, b"p", b"d"),
+                       list(b1raws) + [dup_raw], BlockMetadata())
+            b2 = Block(BlockHeader(6, b"p", b"d"),
+                       list(b2raws) + [dup_raw, led_raw], BlockMetadata())
+            s1 = v.validate_begin(b1)
+            s2 = v.validate_begin(b2)   # pipelined: b1 carry, not ledger
+            r1 = v.validate_finish(s1)
+            r2 = v.validate_finish(s2)
+            return (r1.flags.codes(), r2.flags.codes(),
+                    r1.n_unique_items, r2.n_unique_items)
+        finally:
+            tv._fastcollect = real
+
+    for seed in (11, 22, 33):
+        rng = random.Random(seed)
+        dup_raw = build.endorser_tx(
+            "ch", "cc", "1.0", rw(writes=[KVWrite("dup", b"1")]),
+            org1.new_identity("dupc"),
+            [org1.new_identity("e1"), org2.new_identity("e2")],
+            nonce=bytes([seed]) * 20).serialize()
+        b1raws, b2raws = corpus(rng), corpus(rng)
+        deep = run("deep", b1raws, b2raws, dup_raw)
+        classic = run("classic", b1raws, b2raws, dup_raw)
+        pure = run("python", b1raws, b2raws, dup_raw)
+        assert deep == classic == pure, f"state fork at seed {seed}"
+        # the corpus really exercised the dedup layers: first sighting
+        # valid, carry copy + ledger-oracle copy both flagged
+        assert deep[0][len(b1raws)] == int(ValidationCode.VALID)
+        assert deep[1][len(b2raws)] == int(ValidationCode.DUPLICATE_TXID)
+        assert deep[1][len(b2raws) + 1] == \
+            int(ValidationCode.DUPLICATE_TXID)
+
+
 def test_pipelined_inflight_duplicate_txid(world):
     """A txid duplicated across two PIPELINED blocks (begin N+1 before
     block N commits) is flagged in the later block: the in-flight carry

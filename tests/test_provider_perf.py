@@ -14,6 +14,8 @@ All on the CPU backend (conftest), same code paths as TPU minus jit.
 
 import hashlib
 import random
+import threading
+import time
 
 import numpy as np
 import pytest
@@ -26,8 +28,13 @@ from fabric_tpu.crypto import (
     Encoding, PublicFormat)
 
 from fabric_tpu.bccsp import SCHEME_P256, VerifyItem
+from fabric_tpu.bccsp.factory import compile_cache_is_warm
 from fabric_tpu.bccsp.jaxtpu import JaxTpuProvider
 from fabric_tpu.ops import p256
+
+# rejoin the quick gate when the persistent XLA cache is prebaked
+# (node warmup --cache-dir): the kernel compiles below become cache hits
+_slow = pytest.mark.slow if not compile_cache_is_warm() else (lambda f: f)
 
 # one P-256 comb table in bytes (f32 (COMB_WINDOWS*COMB_ENTRIES, 2L))
 from fabric_tpu.ops import p256_tables as _pt
@@ -65,7 +72,7 @@ def _fresh(monkeypatch, **env):
     return prov
 
 
-@pytest.mark.slow
+@_slow
 def test_steady_state_ships_no_tables(monkeypatch, keypool):
     """After the first batch builds tables, later batches must ship only
     signature words: h2d per call stays ~100 B/sig, nowhere near the
@@ -85,7 +92,7 @@ def test_steady_state_ships_no_tables(monkeypatch, keypool):
     assert bool(np.asarray(out).all())
 
 
-@pytest.mark.slow
+@_slow
 def test_table_upload_once_per_key(monkeypatch, keypool):
     prov = _fresh(monkeypatch)
     items = _sigs(keypool[:8], 10)
@@ -97,7 +104,7 @@ def test_table_upload_once_per_key(monkeypatch, keypool):
 
 
 @pytest.mark.parametrize("n_keys", [3, 8, 64])
-@pytest.mark.slow
+@_slow
 def test_lane_choice_hot_keys_ride_rows(monkeypatch, keypool, n_keys):
     """>= threshold sigs per key in one batch -> every sig on the comb
     lane regardless of how many distinct keys there are (the round-3
@@ -110,7 +117,7 @@ def test_lane_choice_hot_keys_ride_rows(monkeypatch, keypool, n_keys):
     assert prov.key_tables.stats["builds"] == n_keys
 
 
-@pytest.mark.slow
+@_slow
 def test_lane_choice_cold_keys_ride_generic(monkeypatch, keypool):
     """Below-threshold groups must NOT earn a table build (one-off
     creators ride the generic ladder)."""
@@ -128,7 +135,7 @@ def test_lane_choice_cold_keys_ride_generic(monkeypatch, keypool):
     assert prov.stats["fast_key_sigs"] == len(warm) + len(one)
 
 
-@pytest.mark.slow
+@_slow
 def test_capacity_cliff_overflow_spills_to_generic(monkeypatch, keypool):
     """More hot keys than slots in ONE batch: the first max_keys groups
     win slots (pinned for the batch), the overflow rides the generic
@@ -151,7 +158,7 @@ def test_capacity_cliff_overflow_spills_to_generic(monkeypatch, keypool):
     assert prov.stats["fast_key_sigs"] == 2 * 4 * 5
 
 
-@pytest.mark.slow
+@_slow
 def test_capacity_cliff_rotation_evicts_correctly(monkeypatch, keypool):
     """Alternating hot-key populations churn the LRU across batches;
     verdicts stay correct and rebuild cost is bounded by the rotation."""
@@ -177,7 +184,7 @@ def test_capacity_cliff_rotation_evicts_correctly(monkeypatch, keypool):
     assert prov2.key_tables.stats["builds"] == builds == 6
 
 
-@pytest.mark.slow
+@_slow
 def test_dispatch_count_single_rows_dispatch(monkeypatch, keypool):
     """A mixed hot-key batch that fits one row chunk = exactly one
     device dispatch (merged rows lane), no generic-lane dispatch."""
@@ -202,6 +209,131 @@ def test_rows_chunk_splits_large_grids(keypool):
     assert prov.stats["dispatches"] - d0 >= 3
     sw = prov.fallback.batch_verify(items)
     assert (np.asarray(out) == np.asarray(sw)).all()
+
+
+def test_compile_cache_warm_requires_manifest(tmp_path):
+    """The quick-gate rejoin must be deterministic: cache entries left
+    by an ordinary test run never count as a warmup artifact — only a
+    completed `node.warmup` prebake (which stamps the manifest) does."""
+    from fabric_tpu.bccsp.factory import (WARMUP_MANIFEST,
+                                          compile_cache_is_warm)
+    d = tmp_path / "xla"
+    assert not compile_cache_is_warm(str(d))        # dir doesn't exist
+    d.mkdir()
+    for i in range(6):
+        (d / f"kernel{i}-cache").write_bytes(b"x")
+    assert not compile_cache_is_warm(str(d))        # entries alone: no
+    (d / WARMUP_MANIFEST).write_text("{}")
+    assert compile_cache_is_warm(str(d))            # manifest + entries
+    assert not compile_cache_is_warm(str(d), min_entries=99)
+
+
+class _SlowAsyncProvider:
+    """Fake device with an injected verify latency.  batch_verify_async
+    enqueues instantly and returns a resolve() that blocks until the
+    background 'device' finishes — the same contract as
+    JaxTpuProvider.batch_verify_async.  Records the device-busy windows
+    so the test can measure collect-under-verify overlap without real
+    kernels (no XLA compile, quick-gate safe)."""
+
+    name = "slow-async-fake"
+
+    def __init__(self, delay: float = 0.25):
+        self.delay = delay
+        self.busy = []                    # (enqueue_t, done_t) per dispatch
+
+    def batch_verify_async(self, items):
+        t_enq = time.perf_counter()
+        done = threading.Event()
+        out = np.ones(len(items), dtype=bool)
+
+        def work():
+            time.sleep(self.delay)
+            self.busy.append((t_enq, time.perf_counter()))
+            done.set()
+
+        threading.Thread(target=work, daemon=True).start()
+
+        def resolve():
+            done.wait()
+            return out
+
+        return resolve
+
+    def batch_verify(self, items):
+        return self.batch_verify_async(items)()
+
+
+def _overlap(win, busy):
+    """Seconds of `win` covered by the union of `busy` intervals."""
+    a, b = win
+    total = 0.0
+    for s, e in busy:
+        lo, hi = max(a, s), min(b, e)
+        if hi > lo:
+            total += hi - lo
+    return total
+
+
+def test_window_collect_under_verify(monkeypatch):
+    """Streamed-window economics regression (the config-5 pipeline):
+
+    * validate_begin must NEVER synchronize with the device — not per
+      block and not per chunk (FABRIC_TPU_VALIDATE_CHUNK forces several
+      intra-block flushes here); any hidden resolve() on the begin path
+      would cost >= one injected 0.25 s device delay per block;
+    * the measured collect-under-verify fraction for steady-state blocks
+      (every begin after the pipeline fills) must clear a floor — the
+      depth-2 window drives collect of block N+1 entirely under the
+      device's verify of block N when the host tail is fast enough.
+    """
+    from fabric_tpu.committer import PolicyRegistry, TxValidator
+    from fabric_tpu.msp import CachedMSP
+    from fabric_tpu.msp.ca import DevOrg
+    from fabric_tpu.policy import parse_policy
+    from fabric_tpu.protocol import KVWrite, NsRwSet, TxRwSet, build
+
+    monkeypatch.setenv("FABRIC_TPU_VALIDATE_CHUNK", "10")
+    org = DevOrg("Org1")
+    msps = {org.mspid: CachedMSP(org.msp())}
+    policies = PolicyRegistry(parse_policy("OR('Org1.member')"))
+    endorser = org.new_identity("e")
+    client = org.new_identity("c")
+    blocks = []
+    for b in range(4):
+        envs = []
+        for i in range(40):
+            rws = TxRwSet((NsRwSet(
+                "cc", writes=(KVWrite(f"b{b}k{i}", b"v"),)),))
+            envs.append(build.endorser_tx("ch", "cc", "1.0", rws,
+                                          client, (endorser,)))
+        blocks.append(build.new_block(b, b"\x00" * 32, envs))
+
+    prov = _SlowAsyncProvider(delay=0.25)
+    validator = TxValidator("ch", msps, prov, policies)
+    begins = []                           # (start_t, end_t) per block
+    pending = []
+    for blk in blocks:
+        t0 = time.perf_counter()
+        state = validator.validate_begin(blk)
+        begins.append((t0, time.perf_counter()))
+        pending.append(state)
+        if len(pending) >= 2:             # depth-2 pipeline
+            res = validator.validate_finish(pending.pop(0))
+            assert res.flags.valid_count() == 40
+    while pending:
+        res = validator.validate_finish(pending.pop(0))
+        assert res.flags.valid_count() == 40
+
+    # 1: begin never blocked on the device (per block or per chunk)
+    slowest = max(e - s for s, e in begins)
+    assert slowest < prov.delay * 0.5, (slowest, begins)
+    # 2: steady-state collects ran under an in-flight device verify
+    steady = begins[1:]
+    collect_s = sum(e - s for s, e in steady)
+    under = sum(_overlap(w, prov.busy) for w in steady)
+    frac = under / max(1e-9, collect_s)
+    assert frac >= 0.9, (frac, steady, prov.busy)
 
 
 def test_stats_snapshot_public_surface(keypool):

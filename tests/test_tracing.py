@@ -71,6 +71,102 @@ def test_recorder_bounds_eviction_and_slowest_retention():
     assert rec.list() == {"recent": [], "slowest": []}
 
 
+def test_recorder_retention_by_root_name_and_configure():
+    """Per-root retention: a high-frequency root (the gossip poller)
+    keeps only its newest N traces while other roots ride the normal
+    ring — the poller can't flush request/block traces out."""
+    rec = FlightRecorder(max_traces=64, max_slow=0,
+                         retention={"noisy": 3})
+    for i in range(8):
+        rec.add({"trace_id": f"n{i}", "root_name": "noisy",
+                 "start_wall": 0.0, "duration_s": 0.001,
+                 "spans": [{"name": "noisy"}]})
+        rec.add({"trace_id": f"q{i}", "root_name": "quiet",
+                 "start_wall": 0.0, "duration_s": 0.001,
+                 "spans": [{"name": "quiet"}]})
+    listing = rec.list()["recent"]
+    noisy = [r["trace_id"] for r in listing if r["root"] == "noisy"]
+    quiet = [r["trace_id"] for r in listing if r["root"] == "quiet"]
+    assert noisy == ["n7", "n6", "n5"]      # capped, newest kept
+    assert len(quiet) == 8                  # uncapped root untouched
+    # Tracer.configure wires the policy from the localconfig tracing
+    # sub-dict (FABRIC_TPU_PEER_TRACING__RETENTION='{"root": n}')
+    t = Tracer(FlightRecorder())
+    t.configure({"retention": {"gossip.pull_window": 2}})
+    assert t.recorder.retention == {"gossip.pull_window": 2}
+
+
+def test_pull_window_trace_covers_deliver():
+    """gossip.pull_window roots a trace and the orderer-side deliver
+    stream records an `orderer.deliver` child in the SAME trace (the
+    traceparent rides the ambient context / RPC req frame)."""
+    from fabric_tpu.gossip.blocksprovider import BlocksProvider
+    from fabric_tpu.orderer.deliver import DeliverHandler
+
+    class _Ledger:
+        def __init__(self, blocks):
+            self.blocks = blocks
+
+        @property
+        def height(self):
+            return len(self.blocks)
+
+        def get_by_number(self, n):
+            return self.blocks[n]
+
+    class _Support:
+        def __init__(self, blocks):
+            self.ledger = _Ledger(blocks)
+
+        def authorize_read(self, signed):
+            pass
+
+        def wait_for_height(self, h, timeout_s):
+            return False
+
+    class _Registrar:
+        def __init__(self, support):
+            self._s = support
+
+        def get(self, cid):
+            return self._s
+
+    class _Blk:
+        def __init__(self, n):
+            self.header = type("H", (), {"number": n})()
+
+    class _State:
+        def __init__(self):
+            self.committer = type("C", (), {"height": 0})()
+
+        def add_block(self, b):
+            self.committer.height += 1
+
+    blocks = [_Blk(i) for i in range(5)]
+    bp = BlocksProvider("ch", DeliverHandler(_Registrar(_Support(blocks))),
+                        _State(), window=8)
+    t = tracing.tracer
+    saved = (t.enabled, t.sample_rate, t.recorder)
+    t.enabled, t.sample_rate = True, 1.0
+    t.recorder = rec = FlightRecorder()
+    try:
+        assert bp.pull_window() == 5
+    finally:
+        t.enabled, t.sample_rate, t.recorder = saved
+    recent = rec.list()["recent"]
+    assert recent and recent[0]["root"] == "gossip.pull_window"
+    record = rec.get(recent[0]["trace_id"])
+    names = {s["name"] for s in record["spans"]}
+    assert {"gossip.pull_window", "orderer.deliver"} <= names
+    deliver = next(s for s in record["spans"]
+                   if s["name"] == "orderer.deliver")
+    assert deliver["attributes"]["blocks"] == 5
+    assert deliver["parent_id"] is not None      # child, not its own root
+    root = next(s for s in record["spans"]
+                if s["name"] == "gossip.pull_window")
+    assert root["attributes"]["accepted"] == 5
+
+
 def test_sampling_zero_records_nothing_but_propagates():
     t = Tracer(FlightRecorder())
     t.enabled = True
