@@ -536,6 +536,28 @@ def main():
         except Exception as exc:
             detail["window_error"] = str(exc)[:200]
 
+    # -- batching economics (same source as the live /metrics surface) -------
+    # bench and the node dashboard must agree on occupancy/pad-waste, so
+    # read the registry counters the provider itself maintains instead
+    # of recomputing from bench-side bookkeeping
+    try:
+        from fabric_tpu.ops_plane import registry as _reg
+        pad_c = _reg.get("provider_pad_slots_total")
+        slot_c = _reg.get("provider_lane_slots_total")
+        if pad_c is not None and slot_c is not None:
+            pad, slots = pad_c.total(), slot_c.total()
+            detail["pad_slots_total"] = int(pad)
+            detail["lane_slots_total"] = int(slots)
+            if slots:
+                detail["batch_occupancy"] = round(1.0 - pad / slots, 4)
+        fill_g = _reg.get("provider_lane_fill_fraction")
+        if fill_g is not None:
+            for key, v in sorted(fill_g.values().items()):
+                lane = dict(key).get("lane", "?")
+                detail[f"lane_fill_last_{lane}"] = round(v, 4)
+    except Exception as exc:
+        detail["occupancy_error"] = str(exc)[:200]
+
     result = {
         "metric": "ecdsa_p256_sig_verifies_per_sec",
         "value": round(rate, 1),

@@ -54,13 +54,13 @@ def boot(base, n_orderers):
     for p in paths["orderers"]:
         with open(p) as f:
             cfg = json.load(f)
+        cfg["ops_port"] = 0         # scrapeable end-to-end: every node
         orderers.append(OrdererNode(cfg, data_dir=cfg["data_dir"]).start())
-    for i, p in enumerate(paths["peers"]):
+    for p in paths["peers"]:
         with open(p) as f:
             cfg = json.load(f)
         cfg["gateway"] = {"linger_s": 0.005, "max_batch": 64}
-        if i == 0:
-            cfg["ops_port"] = 0     # /metrics, /traces, /spans/stats
+        cfg["ops_port"] = 0         # /metrics, /slo, /traces, /gateway
         peers.append(PeerNode(cfg, data_dir=cfg["data_dir"]).start())
     deadline = time.time() + 60
     while time.time() < deadline:
@@ -162,6 +162,16 @@ def main():
         for line in registry.expose_text().splitlines():
             if line.startswith("gateway_") and not line.startswith("#"):
                 print(" ", line)
+
+        # every node is scrapeable: render one cluster-top frame over
+        # the live ops surfaces (the watch form of this is
+        # `python -m fabric_tpu.node.top --targets ...`)
+        from fabric_tpu.node import top as cluster_top
+        targets = ",".join(f"{n.ops.addr[0]}:{n.ops.addr[1]}"
+                           for n in peers + orderers if n.ops is not None)
+        print(f"\ncluster top (--targets {targets}):")
+        rows = [cluster_top.collect_node(t) for t in targets.split(",")]
+        print(cluster_top.render(rows))
 
         # fetch one tx's trace over the peer's ops server: the flight
         # recorder stitches the request trace to its block trace, so the

@@ -337,6 +337,37 @@ class JaxTpuProvider(prov.Provider):
 
     # -- dispatch helpers ---------------------------------------------------
 
+    # lane-fill histogram bins: how full the padded device buckets run
+    _FILL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0,
+                     float("inf"))
+
+    def _observe_lane(self, lane: str, real: int, padded: int) -> None:
+        """Per-dispatch batching-economics telemetry: lane fill fraction
+        and padded-slot waste into the ops_plane registry (the live
+        counterpart of bench.py's one-shot occupancy numbers).  Guarded:
+        observability must never break the dispatch hot path."""
+        try:
+            from fabric_tpu.ops_plane import registry
+            fill = (real / padded) if padded else 1.0
+            registry.gauge(
+                "provider_lane_fill_fraction",
+                "real signatures / padded device slots, last dispatch"
+            ).set(fill, lane=lane)
+            registry.histogram(
+                "provider_lane_fill",
+                "per-dispatch lane fill fraction",
+                buckets=self._FILL_BUCKETS).observe(fill, lane=lane)
+            registry.counter(
+                "provider_pad_slots_total",
+                "padded device slots carrying no real signature"
+            ).add(float(padded - real), lane=lane)
+            registry.counter(
+                "provider_lane_slots_total",
+                "device slots dispatched (real + pad)"
+            ).add(float(padded), lane=lane)
+        except Exception:
+            pass
+
     def _dispatch(self, fn, keep, arrays, pending, extra_args=()):
         """Pad to buckets, chunk beyond MAX_BUCKET (bounds the compiled-
         program set while arbitrarily large blocks still use the device),
@@ -351,6 +382,8 @@ class JaxTpuProvider(prov.Provider):
             self.stats["device_sigs"] += hi - lo
             self.stats["h2d_bytes"] += sum(
                 np.asarray(a).nbytes for a in padded)
+            self._observe_lane("generic", hi - lo,
+                               int(np.asarray(padded[0]).shape[-1]))
             pending.append((keep[lo:hi], out))
 
     # Row-grid geometry for the fast lane (ops/p256_fixed.verify_words_
@@ -627,6 +660,7 @@ class JaxTpuProvider(prov.Provider):
         keep = slots_np[valid]
         self.stats["device_sigs"] += len(keep)
         self.stats["fast_key_sigs"] += len(keep)
+        self._observe_lane("rows", len(keep), len(slots_np))
         pending.append(
             (keep,
              lambda out=out, valid=valid:
@@ -790,6 +824,7 @@ class JaxTpuProvider(prov.Provider):
                      packed_g2["A"], packed_g2["B"], x1, y1, x2, y2)
             self.stats["dispatches"] += 1
             self.stats["device_sigs"] += len(g)
+            self._observe_lane("idemix", len(g), b)
             pending.append(([p[0] for p in g], out))
 
     def idemix_pair_probe(self, batch: int = None):
@@ -858,6 +893,16 @@ class JaxTpuProvider(prov.Provider):
 
             return resolve_fallback
 
+        # in-flight device work between enqueue and resolve (decremented
+        # once in resolve, success or fallback)
+        try:
+            from fabric_tpu.ops_plane import registry as _reg
+            _reg.gauge("provider_dispatch_queue_depth",
+                       "device dispatches enqueued, not yet resolved"
+                       ).add(float(len(pending)))
+        except Exception:
+            pass
+
         def resolve():
             import time as _time
             t0 = _time.perf_counter()
@@ -872,8 +917,10 @@ class JaxTpuProvider(prov.Provider):
                 self.stats["fallbacks"] += 1
                 span.set_attribute("fallback", "resolve")
                 span.end(status="ERROR")
+                self._drain_queue_depth(len(pending))
                 return self.fallback.batch_verify(items)
             wall = _time.perf_counter() - t0
+            self._drain_queue_depth(len(pending))
             if span.recording:
                 snap1 = self.stats_snapshot()
                 span.set_attribute("block_until_ready_s", round(wall, 6))
@@ -898,6 +945,10 @@ class JaxTpuProvider(prov.Provider):
                 registry.histogram(
                     "provider_resolve_seconds",
                     "batch_verify device resolve wait").observe(wall)
+                registry.gauge(
+                    "provider_device_sync_seconds",
+                    "last batch_verify device-sync (resolve) wait"
+                    ).set(wall)
                 registry.counter(
                     "provider_device_sigs_total",
                     "signatures resolved on device").add(len(items))
@@ -906,6 +957,17 @@ class JaxTpuProvider(prov.Provider):
             return verdicts
 
         return resolve
+
+    def _drain_queue_depth(self, n: int) -> None:
+        if not n:
+            return
+        try:
+            from fabric_tpu.ops_plane import registry
+            registry.gauge("provider_dispatch_queue_depth",
+                           "device dispatches enqueued, not yet resolved"
+                           ).add(-float(n))
+        except Exception:
+            pass
 
     def batch_verify(self, items: Sequence[VerifyItem]) -> np.ndarray:
         return self.batch_verify_async(items)()

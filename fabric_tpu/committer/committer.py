@@ -269,14 +269,23 @@ class Committer:
                 "validation_dispatch_seconds",
                 "batched signature dispatch time").observe(
                     vr.dispatch_s, channel=ch)
+            commit_s = 0.0
             for phase in ("state_validation_s", "block_commit_s",
                           "state_commit_s", "history_commit_s"):
                 v = getattr(stats, phase, None)
                 if v is not None:
+                    commit_s += v
                     registry.histogram(
                         "commit_phase_seconds",
                         "per-phase ledger commit time").observe(
                             v, channel=ch, phase=phase[:-2])
+            # the "commit" stage of the validator_stage_seconds family
+            # (collect/dispatch/gate land in txvalidator._observe_block)
+            registry.histogram(
+                "validator_stage_seconds",
+                "per-block validation stage latency",
+                buckets=self.validator._STAGE_BUCKETS).observe(
+                    commit_s, stage="commit", channel=ch)
             registry.counter(
                 "committed_blocks_total", "blocks committed").add(1, channel=ch)
             registry.counter(

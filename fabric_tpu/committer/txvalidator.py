@@ -100,6 +100,72 @@ class _TxWork:
     meta_writes: List[Tuple] = field(default_factory=list)
 
 
+def _interval_union(ivals):
+    """Merge (start, end) intervals into a sorted disjoint union."""
+    out: List[List[float]] = []
+    for a, b in sorted(ivals):
+        if out and a <= out[-1][1]:
+            if b > out[-1][1]:
+                out[-1][1] = b
+        else:
+            out.append([a, b])
+    return out
+
+
+def _intersection_s(u1, u2) -> float:
+    i = j = 0
+    s = 0.0
+    while i < len(u1) and j < len(u2):
+        a = max(u1[i][0], u2[j][0])
+        b = min(u1[i][1], u2[j][1])
+        if b > a:
+            s += b - a
+        if u1[i][1] < u2[j][1]:
+            i += 1
+        else:
+            j += 1
+    return s
+
+
+class _PipelineEconomics:
+    """Live collect-under-verify overlap over a rolling block window.
+
+    The bench-only measurement (bench.py `_window_trace_detail`) derives
+    the same fraction post-hoc from tracer spans; this tracks it on the
+    node itself so the SLO plane can watch the overlap floor without a
+    bench run.  Collect intervals come from validate_begin, verify
+    intervals span device enqueue -> resolve return (the
+    bccsp.batch_verify window).  All timestamps share perf_counter."""
+
+    WINDOW = 64            # blocks of history
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        from collections import deque
+        self._collect = deque(maxlen=self.WINDOW)
+        self._verify = deque(maxlen=self.WINDOW)
+
+    def note_collect(self, a: float, b: float) -> None:
+        if b > a:
+            with self._lock:
+                self._collect.append((a, b))
+
+    def note_verify(self, a: float, b: float) -> None:
+        if b > a:
+            with self._lock:
+                self._verify.append((a, b))
+
+    def frac(self) -> float:
+        with self._lock:
+            collect = list(self._collect)
+            verify = list(self._verify)
+        u_c = _interval_union(collect)
+        total = sum(b - a for a, b in u_c)
+        if total <= 0.0:
+            return 0.0
+        return min(1.0, _intersection_s(u_c, _interval_union(verify)) / total)
+
+
 @dataclass
 class ValidationResult:
     flags: TxFlags
@@ -149,6 +215,8 @@ class TxValidator:
         # block are also pruned: a replay of the same or an earlier
         # block (catch-up, crash recovery) is not a duplicate of itself.
         self._inflight_txids: List[Tuple[int, Dict[str, int]]] = []
+        # live pipeline-economics window (overlap gauge for the SLO plane)
+        self._econ = _PipelineEconomics()
 
     @property
     def msps(self):
@@ -476,10 +544,14 @@ class TxValidator:
                 # dispatch was enqueued first); a thread that is already
                 # blocked on the results keeps the fetch ahead of them.
                 holder: dict = {}
+                t_disp = time.perf_counter()
+                econ = self._econ
 
-                def run(resolve=resolve, holder=holder):
+                def run(resolve=resolve, holder=holder, t_disp=t_disp,
+                        econ=econ):
                     try:
                         holder["out"] = resolve()
+                        econ.note_verify(t_disp, time.perf_counter())
                     except BaseException as exc:   # re-raised at join
                         holder["err"] = exc
 
@@ -515,6 +587,7 @@ class TxValidator:
         flush()
         self._inflight_txids.append((num, seen_txids))
         collect_s = time.perf_counter() - t0
+        self._econ.note_collect(t0, t0 + collect_s)
         tracing.tracer.record_span(
             "validator.collect", t0, t0 + collect_s,
             attributes={"block": int(num), "txs": n,
@@ -564,10 +637,14 @@ class TxValidator:
                 # classic path's flush(): keep the result fetch ahead of
                 # any later dispatch on relayed transports
                 holder: dict = {}
+                t_disp = time.perf_counter()
+                econ = self._econ
 
-                def run(resolve=resolve, holder=holder):
+                def run(resolve=resolve, holder=holder, t_disp=t_disp,
+                        econ=econ):
                     try:
                         holder["out"] = resolve()
+                        econ.note_verify(t_disp, time.perf_counter())
                     except BaseException as exc:   # re-raised at join
                         holder["err"] = exc
 
@@ -593,6 +670,7 @@ class TxValidator:
             flush()
         self._inflight_txids.append((num, seen_txids))
         collect_s = time.perf_counter() - t0
+        self._econ.note_collect(t0, t0 + collect_s)
         tracing.tracer.record_span(
             "validator.collect", t0, t0 + collect_s,
             attributes={"block": int(num), "txs": n,
@@ -601,6 +679,30 @@ class TxValidator:
                 "plans": plans, "items": index, "resolvers": resolvers,
                 "msps": self._msps_snapshot, "seen_txids": seen_txids,
                 "collect_s": collect_s, "n_refs": n_refs}
+
+    # per-block stage SLIs + live overlap gauge (the SLO plane's inputs;
+    # the "commit" stage lands next door in committer._observe_metrics)
+    _STAGE_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                     1.0, 2.5, 5.0, 10.0, float("inf"))
+
+    def _observe_block(self, collect_s: float, dispatch_s: float,
+                       gate_s: float) -> None:
+        try:
+            from fabric_tpu.ops_plane import registry
+            h = registry.histogram(
+                "validator_stage_seconds",
+                "per-block validation stage latency",
+                buckets=self._STAGE_BUCKETS)
+            ch = self.channel_id
+            h.observe(collect_s, stage="collect", channel=ch)
+            h.observe(dispatch_s, stage="dispatch", channel=ch)
+            h.observe(gate_s, stage="gate", channel=ch)
+            registry.gauge(
+                "pipeline_collect_under_verify_frac",
+                "live collect-under-verify overlap, rolling block window"
+            ).set(self._econ.frac(), channel=ch)
+        except Exception:
+            pass
 
     def _finish_deep(self, state: dict) -> ValidationResult:
         block = state["block"]
@@ -630,6 +732,7 @@ class TxValidator:
                         "txs": len(state["plans"])})
 
         block.metadata.items[META_TXFLAGS] = flags.to_bytes()
+        self._observe_block(collect_s, dispatch_s, gate_s)
         logger.info(
             "[%s] validated block %d: %d/%d valid | collect=%.1fms "
             "dispatch=%.1fms (%d uniq sigs) gate=%.1fms",
@@ -685,6 +788,7 @@ class TxValidator:
 
         n_refs = sum(1 + sum(len(s) for _, _, s in w.namespaces) for w in works)
         block.metadata.items[META_TXFLAGS] = flags.to_bytes()
+        self._observe_block(collect_s, dispatch_s, gate_s)
         logger.info(
             "[%s] validated block %d: %d/%d valid | collect=%.1fms "
             "dispatch=%.1fms (%d uniq sigs) gate=%.1fms",

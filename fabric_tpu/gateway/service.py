@@ -106,6 +106,25 @@ class GatewayService:
         rpc.serve("gateway.submit", self._rpc_submit)
         rpc.serve("gateway.commit_status", self._rpc_commit_status)
 
+    def register_ops(self, ops) -> None:
+        """Mount GET /gateway on the hosting node's ops server: live
+        front-door state (admission queue, in-flight, dedup window,
+        per-orderer breaker snapshot).  The gateway shares the node
+        process, so /metrics and /slo on the same server already carry
+        its registry series — this adds the structured view."""
+        def _gateway(path, body):
+            with self._lock:
+                depth = len(self._queue)
+                inflight = len(self._inflight)
+                recent = len(self._recent)
+            return 200, {"queue_depth": depth,
+                         "max_queue": self.max_queue,
+                         "inflight": inflight,
+                         "dedup_window": recent,
+                         "healthy": self.broadcaster.healthy(),
+                         "orderers": self.broadcaster.states()}
+        ops.register_route("GET", "/gateway", _gateway)
+
     def start(self) -> None:
         self._thread.start()
 

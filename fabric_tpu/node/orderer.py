@@ -168,6 +168,16 @@ class OrdererNode:
                                         "/participation/v1/channels/",
                                         self._rest_remove)
 
+        # SLO plane: GET /slo + /slo/alerts (burn-rate alerting over the
+        # metrics registry), FABRIC_TPU_ORDERER_SLO__* env-overridable
+        self.slo = None
+        slo_cfg = cfg.get("slo", {})
+        if self.ops is not None and slo_cfg.get("enabled", True):
+            from fabric_tpu.ops_plane import slo as _slo
+            self.slo = _slo.SloEvaluator(slo_cfg)
+            _slo.register_routes(self.ops, self.slo)
+            self.slo.start()
+
     # -- channelparticipation REST (restapi.go) ------------------------------
 
     def _rest_channels(self, path: str, body: bytes):
@@ -445,6 +455,8 @@ class OrdererNode:
         for support in self.registrar.channels().values():
             support.chain.halt()
         self.rpc.stop()
+        if getattr(self, "slo", None) is not None:
+            self.slo.stop()
         if self.ops is not None:
             self.ops.stop()
 

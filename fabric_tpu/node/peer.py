@@ -574,6 +574,21 @@ class PeerNode:
             # production — the plan only exists during chaos drills)
             from fabric_tpu.comm import faults as _faults
             _faults.register_routes(self.ops)
+            # GET /gateway: front-door queue + breaker snapshot (the
+            # gateway shares the peer process and ops surface)
+            if self.gateway is not None:
+                self.gateway.register_ops(self.ops)
+
+        # SLO plane: GET /slo + /slo/alerts, burn-rate alerting over the
+        # metrics registry; config/env via the `slo` sub-dict
+        # (FABRIC_TPU_PEER_SLO__SHORT_WINDOW_S=30 etc.)
+        self.slo = None
+        slo_cfg = cfg.get("slo", {})
+        if self.ops is not None and slo_cfg.get("enabled", True):
+            from fabric_tpu.ops_plane import slo as _slo
+            self.slo = _slo.SloEvaluator(slo_cfg)
+            _slo.register_routes(self.ops, self.slo)
+            self.slo.start()
 
     def _check_orderers(self):
         """healthz: at least one orderer breaker not OPEN (or no
@@ -932,6 +947,8 @@ class PeerNode:
         self.rpc.stop()
         if getattr(self, "cc_support", None) is not None:
             self.cc_support.stop()      # kills external chaincode processes
+        if getattr(self, "slo", None) is not None:
+            self.slo.stop()
         if self.ops is not None:
             self.ops.stop()
 

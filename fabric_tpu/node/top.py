@@ -1,0 +1,257 @@
+"""Cluster `top`: a refreshing per-node view of the pipeline economics.
+
+    python -m fabric_tpu.node.top --targets 127.0.0.1:9443,127.0.0.1:9444
+    python -m fabric_tpu.node.top --targets ... --interval 2
+    python -m fabric_tpu.node.top --targets ... --once      # one frame
+
+Polls each node's ops surface — `/metrics` (Prometheus text),
+`/spans/stats`, `/slo`, `/faults`, `/healthz` — and renders one row per
+node: ledger height, throughput, validation stage p50/p99, device batch
+occupancy, live collect-under-verify overlap, breaker/fault state and
+SLO verdicts.  Read-only: the dashboard only issues GETs against the
+control-plane HTTP server, so watching a node never perturbs the data
+path.  Everything is stdlib (urllib + a small exposition parser); any
+endpoint a node doesn't serve degrades to a blank cell, so mixed
+topologies (peers + orderers) render fine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+_LABEL_RE = re.compile(r'(\w+)="((?:[^"\\]|\\.)*)"')
+_UNESCAPE = {"\\\\": "\\", '\\"': '"', "\\n": "\n"}
+
+
+def _unescape(v: str) -> str:
+    if "\\" not in v:
+        return v
+    return re.sub(r'\\[\\"n]', lambda m: _UNESCAPE[m.group(0)], v)
+
+
+def parse_metrics(text: str) -> Dict[str, List[Tuple[dict, float]]]:
+    """Prometheus text exposition -> {name: [(labels, value), ...]}."""
+    out: Dict[str, List[Tuple[dict, float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            head, val = line.rsplit(None, 1)
+            if "{" in head:
+                name, rest = head.split("{", 1)
+                labels = {k: _unescape(v) for k, v in
+                          _LABEL_RE.findall(rest.rsplit("}", 1)[0])}
+            else:
+                name, labels = head, {}
+            out.setdefault(name, []).append((labels, float(val)))
+        except Exception:
+            continue
+    return out
+
+
+def _get_json(addr: str, path: str, timeout: float = 2.0):
+    with urllib.request.urlopen(f"http://{addr}{path}",
+                                timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _get_text(addr: str, path: str, timeout: float = 2.0) -> str:
+    with urllib.request.urlopen(f"http://{addr}{path}",
+                                timeout=timeout) as r:
+        return r.read().decode()
+
+
+def _quantile_ms(buckets: Dict[str, float], q: float) -> Optional[float]:
+    """p-quantile (ms) from /spans/stats per-bin bucket counts."""
+    bins = []
+    for k, c in buckets.items():
+        ub = float("inf") if k == "+Inf" else float(k)
+        bins.append((ub, c))
+    bins.sort()
+    n = sum(c for _, c in bins)
+    if n == 0:
+        return None
+    target = q * n
+    cum = 0
+    last_finite = 0.0
+    for ub, c in bins:
+        if ub != float("inf"):
+            last_finite = ub
+        cum += c
+        if cum >= target:
+            return (ub if ub != float("inf") else last_finite) * 1e3
+    return last_finite * 1e3
+
+
+def _sum(series, label_filter=None) -> float:
+    total = 0.0
+    for labels, v in series or ():
+        if label_filter is None or all(labels.get(k) == val
+                                       for k, val in label_filter.items()):
+            total += v
+    return total
+
+
+def collect_node(addr: str, timeout: float = 2.0) -> dict:
+    """One node's dashboard row (raw values; render() formats)."""
+    row: dict = {"addr": addr, "up": False}
+    try:
+        metrics = parse_metrics(_get_text(addr, "/metrics", timeout))
+        row["up"] = True
+    except Exception as exc:
+        row["error"] = str(exc)[:60]
+        return row
+    row["height"] = max((v for _, v in metrics.get("ledger_height", ())),
+                        default=None)
+    row["txs"] = _sum(metrics.get("committed_txs_total"))
+    row["blocks"] = _sum(metrics.get("committed_blocks_total"))
+    pad = _sum(metrics.get("provider_pad_slots_total"))
+    slots = _sum(metrics.get("provider_lane_slots_total"))
+    row["occupancy"] = (1.0 - pad / slots) if slots else None
+    ov = [v for _, v in
+          metrics.get("pipeline_collect_under_verify_frac", ())]
+    row["overlap"] = (sum(ov) / len(ov)) if ov else None
+    row["queue_depth"] = _sum(metrics.get("provider_dispatch_queue_depth"))
+    row["breakers_open"] = _sum(metrics.get("gateway_orderer_breaker_open"))
+    row["faults_fired"] = _sum(metrics.get("fault_injected_total"))
+
+    try:
+        doc = _get_json(addr, "/spans/stats", timeout)
+        stats = doc.get("spans", {})    # {enabled, sample_rate, spans}
+    except Exception:
+        stats = {}
+    for col, span in (("collect", "validator.collect"),
+                      ("dispatch", "validator.dispatch_wait"),
+                      ("gate", "validator.gate"),
+                      ("commit", "committer.store_block")):
+        st = stats.get(span)
+        row[col] = ((_quantile_ms(st["buckets"], 0.5),
+                     _quantile_ms(st["buckets"], 0.99))
+                    if st and st.get("buckets") else None)
+
+    try:
+        slo = _get_json(addr, "/slo", timeout)
+        objs = slo.get("objectives", [])
+        row["slo_total"] = len(objs)
+        row["slo_alerting"] = sorted(
+            o["name"] for o in objs if o.get("state") == "alerting")
+    except Exception:
+        row["slo_total"] = None
+        row["slo_alerting"] = []
+
+    try:
+        f = _get_json(addr, "/faults", timeout)
+        row["fault_plan"] = f.get("name") if f.get("active") else None
+    except Exception:
+        row["fault_plan"] = None
+    try:
+        row["health"] = _get_json(addr, "/healthz", timeout).get("status")
+    except Exception as exc:
+        # /healthz answers 503 with a JSON body while degraded
+        body = getattr(exc, "read", lambda: b"")()
+        try:
+            row["health"] = json.loads(body).get("status")
+        except Exception:
+            row["health"] = "?"
+    return row
+
+
+def _fmt_pair(p) -> str:
+    if not p or p[0] is None:
+        return "-"
+    return f"{p[0]:.0f}/{p[1]:.0f}"
+
+
+def _fmt_pct(v) -> str:
+    return "-" if v is None else f"{v * 100:.0f}%"
+
+
+def _rate(row: dict, prev: dict) -> Optional[float]:
+    if not prev or row.get("txs") is None or prev.get("txs") is None:
+        return None
+    dt = row["_t"] - prev["_t"]
+    return (row["txs"] - prev["txs"]) / dt if dt > 0 else None
+
+
+_COLS = ("NODE", "HT", "TX/S", "COLLECT", "DISP", "GATE", "COMMIT",
+         "OCC", "OVLP", "QD", "BRKR", "FAULTS", "SLO", "HEALTH")
+_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 5, 4, 5, 7, 12, 8)
+
+
+def render(rows: List[dict]) -> str:
+    """Fixed-width table; stage cells are `p50/p99` in ms."""
+    lines = ["  ".join(c.ljust(w) for c, w in zip(_COLS, _WIDTHS))]
+    for r in rows:
+        if not r.get("up"):
+            lines.append(f"{r['addr']:<21}  DOWN  {r.get('error', '')}")
+            continue
+        alerting = r.get("slo_alerting") or []
+        if r.get("slo_total") is None:
+            slo = "-"
+        elif alerting:
+            slo = "ALERT:" + ",".join(alerting)
+        else:
+            slo = f"ok({r['slo_total']})"
+        faults = f"{r['faults_fired']:.0f}"
+        if r.get("fault_plan"):
+            faults += f"[{r['fault_plan']}]"
+        cells = (
+            r["addr"],
+            "-" if r["height"] is None else f"{r['height']:.0f}",
+            "-" if r.get("rate") is None else f"{r['rate']:.1f}",
+            _fmt_pair(r.get("collect")), _fmt_pair(r.get("dispatch")),
+            _fmt_pair(r.get("gate")), _fmt_pair(r.get("commit")),
+            _fmt_pct(r.get("occupancy")), _fmt_pct(r.get("overlap")),
+            f"{r.get('queue_depth', 0):.0f}",
+            f"{r.get('breakers_open', 0):.0f}",
+            faults, slo, str(r.get("health", "?")))
+        lines.append("  ".join(str(c).ljust(w)
+                               for c, w in zip(cells, _WIDTHS)))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m fabric_tpu.node.top",
+        description="cluster dashboard over the ops plane")
+    ap.add_argument("--targets", required=True,
+                    help="comma-separated host:port ops addresses")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh interval in seconds")
+    ap.add_argument("--once", action="store_true",
+                    help="render a single frame and exit")
+    ap.add_argument("--timeout", type=float, default=2.0)
+    args = ap.parse_args(argv)
+    targets = [t.strip() for t in args.targets.split(",") if t.strip()]
+    prev: Dict[str, dict] = {}
+    try:
+        while True:
+            rows = []
+            for t in targets:
+                row = collect_node(t, args.timeout)
+                row["_t"] = time.monotonic()
+                row["rate"] = _rate(row, prev.get(t, {}))
+                prev[t] = row
+                rows.append(row)
+            frame = (time.strftime("%H:%M:%S")
+                     + f"  fabric-tpu top — {len(targets)} node(s)\n"
+                     + render(rows))
+            if args.once:
+                print(frame)
+                return 0
+            sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

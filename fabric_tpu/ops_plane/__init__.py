@@ -16,8 +16,10 @@ from .tracing import (FlightRecorder, Span, SpanContext, Tracer, tracer,
                       configure as configure_tracing,
                       register_routes as register_trace_routes)
 from .logging import jlog
+from .slo import (SloEvaluator,
+                  register_routes as register_slo_routes)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
            "OperationsServer", "FlightRecorder", "Span", "SpanContext",
            "Tracer", "tracer", "configure_tracing", "register_trace_routes",
-           "jlog"]
+           "jlog", "SloEvaluator", "register_slo_routes"]

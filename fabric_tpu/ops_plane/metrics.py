@@ -8,19 +8,47 @@ With("name", value, ...) pairing convention.
 from __future__ import annotations
 
 import bisect
+import re
 import threading
 from typing import Dict, List, Optional, Tuple
 
 _DEFAULT_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
                     5.0, 10.0, float("inf"))
 
+# prometheus data-model name rules (common/expfmt); metric names may
+# carry colons (recording-rule convention), label names may not
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+# label names validated once, then cached — _label_key sits on the
+# dispatch/commit hot paths
+_validated_labels: set = set()
+
+
+def _check_metric_name(name: str) -> str:
+    if not _METRIC_NAME_RE.match(name):
+        raise ValueError(f"invalid metric name {name!r}")
+    return name
+
 
 def _label_key(pairs) -> Tuple:
+    for k in pairs:
+        if k not in _validated_labels:
+            if not _LABEL_NAME_RE.match(k):
+                raise ValueError(f"invalid label name {k!r}")
+            _validated_labels.add(k)
     return tuple(sorted(pairs.items()))
 
 
+def _escape_label_value(v) -> str:
+    s = str(v)
+    if "\\" in s or '"' in s or "\n" in s:
+        s = (s.replace("\\", "\\\\").replace('"', '\\"')
+             .replace("\n", "\\n"))
+    return s
+
+
 def _fmt_labels(key: Tuple, extra: str = "") -> str:
-    parts = [f'{k}="{v}"' for k, v in key]
+    parts = [f'{k}="{_escape_label_value(v)}"' for k, v in key]
     if extra:
         parts.append(extra)
     return "{" + ",".join(parts) + "}" if parts else ""
@@ -28,7 +56,7 @@ def _fmt_labels(key: Tuple, extra: str = "") -> str:
 
 class Counter:
     def __init__(self, name: str, help_: str = ""):
-        self.name = name
+        self.name = _check_metric_name(name)
         self.help = help_
         self._lock = threading.Lock()
         self._values: Dict[Tuple, float] = {}
@@ -39,7 +67,14 @@ class Counter:
             self._values[k] = self._values.get(k, 0.0) + delta
 
     def value(self, **labels) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        k = _label_key(labels)
+        with self._lock:
+            return self._values.get(k, 0.0)
+
+    def total(self) -> float:
+        """Sum across every label set (SLO-window rate source)."""
+        with self._lock:
+            return sum(self._values.values())
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -52,7 +87,7 @@ class Counter:
 
 class Gauge:
     def __init__(self, name: str, help_: str = ""):
-        self.name = name
+        self.name = _check_metric_name(name)
         self.help = help_
         self._lock = threading.Lock()
         self._values: Dict[Tuple, float] = {}
@@ -67,7 +102,14 @@ class Gauge:
             self._values[k] = self._values.get(k, 0.0) + delta
 
     def value(self, **labels) -> float:
-        return self._values.get(_label_key(labels), 0.0)
+        k = _label_key(labels)
+        with self._lock:
+            return self._values.get(k, 0.0)
+
+    def values(self) -> Dict[Tuple, float]:
+        """Snapshot of every label set (SLO breaker-fraction source)."""
+        with self._lock:
+            return dict(self._values)
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -81,7 +123,7 @@ class Gauge:
 class Histogram:
     def __init__(self, name: str, help_: str = "",
                  buckets: Tuple[float, ...] = _DEFAULT_BUCKETS):
-        self.name = name
+        self.name = _check_metric_name(name)
         self.help = help_
         self.buckets = tuple(buckets)
         self._lock = threading.Lock()
@@ -98,6 +140,20 @@ class Histogram:
                 counts[i] += 1
             self._sum[k] = self._sum.get(k, 0.0) + value
             self._n[k] = self._n.get(k, 0) + 1
+
+    def state(self) -> Tuple[List[int], float, int]:
+        """Aggregate (bucket counts, sum, n) across every label set.
+
+        Cumulative snapshots of this feed the SLO evaluator's windowed
+        quantiles (delta between two snapshots = the window's
+        distribution).
+        """
+        with self._lock:
+            counts = [0] * len(self.buckets)
+            for per_key in self._counts.values():
+                for i, c in enumerate(per_key):
+                    counts[i] += c
+            return counts, sum(self._sum.values()), sum(self._n.values())
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -133,6 +189,11 @@ class MetricsRegistry:
                   buckets: Tuple[float, ...] = _DEFAULT_BUCKETS) -> Histogram:
         return self._get(name, lambda: Histogram(name, help_, buckets),
                          Histogram)
+
+    def get(self, name: str):
+        """Registered metric by name, or None (read-only lookup)."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def _get(self, name, factory, cls):
         with self._lock:

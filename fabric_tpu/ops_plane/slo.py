@@ -1,0 +1,373 @@
+"""SLO evaluator: multi-window burn-rate alerting over the metrics plane.
+
+Implements the SRE-workbook multi-window pattern on top of the local
+ops_plane registry: each objective is measured over a SHORT and a LONG
+rolling window, burn rate = measured value relative to the objective's
+threshold, and an alert fires only when BOTH windows burn — the short
+window proves the problem is happening *now*, the long window proves it
+is sustained (one slow block never pages, a stuck pipeline does).
+Alerts are deduplicated by a per-objective state machine with hysteresis
+(clear only when the short window drops below clear_ratio * threshold)
+and land in three places: a jlog record, a `slo.alert` root span in the
+trace stream, and the `/slo` + `/slo/alerts` ops routes.
+
+Everything here is sampling/aggregation off the hot path: the evaluator
+thread reads cumulative snapshots (Histogram.state / Counter.total /
+Gauge.values) on an interval and derives windowed deltas, so observing
+code never pays more than it already does for the registry.
+
+Node wiring: the `slo` sub-dict of the local config (peer and orderer),
+env-overridable as FABRIC_TPU_<ROLE>_SLO__<KEY> (localconfig tiering).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from .logging import jlog
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .metrics import registry as default_registry
+
+logger = logging.getLogger("fabric_tpu.ops_plane.slo")
+
+# the four pipeline-economics objectives every node watches out of the
+# box; node config merges overrides (or adds new ones) by name
+DEFAULT_OBJECTIVES: Dict[str, dict] = {
+    "commit_p99_s": {
+        "kind": "max", "source": "histogram_quantile",
+        "metric": "validation_duration_seconds", "q": 0.99,
+        "threshold": 5.0,
+        "help": "per-block validate wall time p99 (seconds)"},
+    "verify_throughput_floor": {
+        "kind": "min", "source": "counter_rate",
+        "metric": "provider_device_sigs_total", "threshold": 0.0,
+        "help": "device-verified signatures per second"},
+    "breaker_open_frac": {
+        "kind": "max", "source": "gauge_mean",
+        "metric": "gateway_orderer_breaker_open", "threshold": 0.5,
+        "help": "fraction of orderer circuit breakers open"},
+    "overlap_floor": {
+        "kind": "min", "source": "gauge_mean",
+        "metric": "pipeline_collect_under_verify_frac", "threshold": 0.0,
+        "help": "live collect-under-verify overlap fraction"},
+}
+
+_BURN_CAP = 1e6          # keep /slo JSON strict (no Infinity literals)
+
+
+def _burn(kind: str, value: Optional[float],
+          threshold: float) -> Optional[float]:
+    """Burn rate: 1.0 = consuming budget exactly at the threshold.
+
+    max-objectives (value must stay <= threshold): value/threshold.
+    min-objectives (value must stay >= threshold): threshold/value.
+    """
+    if value is None:
+        return None
+    if kind == "max":
+        if threshold <= 0.0:
+            return 0.0 if value <= 0.0 else _BURN_CAP
+        return min(_BURN_CAP, value / threshold)
+    if threshold <= 0.0:
+        return 0.0
+    if value <= 0.0:
+        return _BURN_CAP
+    return min(_BURN_CAP, threshold / value)
+
+
+class SloEvaluator:
+    """Samples the registry on an interval, evaluates objectives over
+    short/long windows, and runs the multi-window alert state machine."""
+
+    def __init__(self, cfg: Optional[dict] = None, *,
+                 registry: Optional[MetricsRegistry] = None,
+                 clock=None):
+        cfg = dict(cfg or {})
+        self.registry = registry or default_registry
+        self._clock = clock or time.monotonic
+        self.sample_interval_s = float(cfg.get("sample_interval_s", 5.0))
+        self.short_window_s = float(cfg.get("short_window_s", 60.0))
+        self.long_window_s = float(cfg.get("long_window_s", 300.0))
+        self.burn_threshold = float(cfg.get("burn_threshold", 1.0))
+        self.clear_ratio = float(cfg.get("clear_ratio", 0.9))
+        # delta sources need this much of the window covered by samples
+        self.min_coverage = float(cfg.get("min_coverage", 0.5))
+
+        self.objectives: Dict[str, dict] = {}
+        merged = {k: dict(v) for k, v in DEFAULT_OBJECTIVES.items()}
+        for name, o in (cfg.get("objectives") or {}).items():
+            merged.setdefault(name, {}).update(o or {})
+        for name, o in merged.items():
+            if o.get("enabled", True) is False:
+                continue
+            o.setdefault("kind", "max")
+            o.setdefault("source", "gauge_mean")
+            o.setdefault("threshold", 0.0)
+            if "metric" not in o:
+                raise ValueError(f"slo objective {name!r} needs a metric")
+            self.objectives[name] = o
+
+        maxlen = max(16, int(self.long_window_s /
+                             max(self.sample_interval_s, 1e-3)) * 2 + 4)
+        self._samples: deque = deque(maxlen=min(maxlen, 4096))
+        self._lock = threading.RLock()
+        self._states: Dict[str, dict] = {
+            n: {"state": "no_data", "since": time.time()}
+            for n in self.objectives}
+        self._active: Dict[str, dict] = {}
+        self._history: deque = deque(maxlen=64)
+        self._last_status: List[dict] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # -- sampling ------------------------------------------------------------
+
+    def _capture(self) -> dict:
+        snap: dict = {}
+        for o in self.objectives.values():
+            name = o["metric"]
+            if name in snap:
+                continue
+            m = self.registry.get(name)
+            if isinstance(m, Histogram):
+                snap[name] = ("h", m.buckets, m.state())
+            elif isinstance(m, Counter):
+                snap[name] = ("c", m.total())
+            elif isinstance(m, Gauge):
+                vals = m.values()
+                snap[name] = ("g", (sum(vals.values()) / len(vals))
+                              if vals else None)
+        return snap
+
+    def sample(self, now: Optional[float] = None) -> None:
+        now = self._clock() if now is None else now
+        snap = self._capture()
+        with self._lock:
+            self._samples.append((now, snap))
+
+    # -- windowed values -----------------------------------------------------
+
+    def _window_value(self, o: dict, samples: list, now: float,
+                      window_s: float) -> Optional[float]:
+        metric, src = o["metric"], o["source"]
+        if src == "gauge_mean":
+            vals = [p[metric][1] for t, p in samples
+                    if now - window_s < t <= now and metric in p
+                    and p[metric][0] == "g" and p[metric][1] is not None]
+            return (sum(vals) / len(vals)) if vals else None
+        # delta sources: newest sample vs the newest sample at/before
+        # the window start (falling back to the oldest we have)
+        present = [(t, p) for t, p in samples if metric in p]
+        if len(present) < 2:
+            return None
+        t1, p1 = present[-1]
+        base = None
+        for t, p in present:
+            if t <= now - window_s:
+                base = (t, p)
+            else:
+                break
+        t0, p0 = base if base is not None else present[0]
+        span = t1 - t0
+        if span <= 0.0 or span < self.min_coverage * window_s:
+            return None
+        if src == "counter_rate":
+            if p0[metric][0] != "c" or p1[metric][0] != "c":
+                return None
+            return max(0.0, p1[metric][1] - p0[metric][1]) / span
+        if src == "histogram_quantile":
+            if p0[metric][0] != "h" or p1[metric][0] != "h":
+                return None
+            buckets = p1[metric][1]
+            c0, _, n0 = p0[metric][2]
+            c1, _, n1 = p1[metric][2]
+            n = n1 - n0
+            if n <= 0:
+                return None
+            target = float(o.get("q", 0.99)) * n
+            cum = 0
+            last_finite = 0.0
+            for ub, a, b in zip(buckets, c1, c0):
+                cum += a - b
+                if ub != float("inf"):
+                    last_finite = ub
+                if cum >= target:
+                    return ub if ub != float("inf") else last_finite
+            return last_finite
+        return None
+
+    # -- evaluation + alert state machine ------------------------------------
+
+    def evaluate(self, now: Optional[float] = None) -> List[dict]:
+        now = self._clock() if now is None else now
+        with self._lock:
+            samples = list(self._samples)
+        statuses: List[dict] = []
+        for name, o in self.objectives.items():
+            short_s = float(o.get("short_window_s", self.short_window_s))
+            long_s = float(o.get("long_window_s", self.long_window_s))
+            bt = float(o.get("burn_threshold", self.burn_threshold))
+            kind = o["kind"]
+            thr = float(o["threshold"])
+            vs = self._window_value(o, samples, now, short_s)
+            vl = self._window_value(o, samples, now, long_s)
+            bs = _burn(kind, vs, thr)
+            bl = _burn(kind, vl, thr)
+            with self._lock:
+                st = self._states[name]
+                prev = st["state"]
+                if prev == "alerting":
+                    # hysteresis: only a clearly-healthy SHORT window
+                    # clears; no-data holds the alert (absence of
+                    # evidence is not recovery)
+                    if bs is not None and bs < bt * self.clear_ratio:
+                        st["state"] = "ok"
+                        st["since"] = time.time()
+                        self._clear_alert(name, vs, bs, bl)
+                else:
+                    if bs is not None and bl is not None \
+                            and bs >= bt and bl >= bt:
+                        st["state"] = "alerting"
+                        st["since"] = time.time()
+                        self._fire_alert(name, o, vs, bs, bl)
+                    elif bs is None and bl is None:
+                        if prev != "no_data":
+                            st["state"] = "no_data"
+                            st["since"] = time.time()
+                    elif prev != "ok":
+                        st["state"] = "ok"
+                        st["since"] = time.time()
+                state = st["state"]
+                since = st["since"]
+            statuses.append({
+                "name": name, "kind": kind, "source": o["source"],
+                "metric": o["metric"], "threshold": thr,
+                "help": o.get("help", ""),
+                "windows": {"short_s": short_s, "long_s": long_s},
+                "burn_threshold": bt,
+                "value_short": vs, "value_long": vl,
+                "burn_short": bs, "burn_long": bl,
+                "state": state, "since": since})
+        with self._lock:
+            self._last_status = statuses
+        return statuses
+
+    def _alert_attrs(self, name, value, bs, bl) -> dict:
+        o = self.objectives[name]
+        return {"objective": name, "metric": o["metric"],
+                "kind": o["kind"], "threshold": float(o["threshold"]),
+                "value": value, "burn_short": bs, "burn_long": bl}
+
+    def _fire_alert(self, name, o, value, bs, bl) -> None:
+        rec = dict(self._alert_attrs(name, value, bs, bl),
+                   state="firing", fired_at=time.time())
+        self._active[name] = rec
+        self._history.append(rec)
+        try:
+            self.registry.counter(
+                "slo_alerts_total", "SLO alerts fired").add(
+                    1, objective=name)
+            self.registry.gauge(
+                "slo_alerting", "1 while the objective is alerting").set(
+                    1.0, objective=name)
+        except Exception:
+            pass
+        jlog(logger, "slo.alert_fired", level=logging.WARNING,
+             **self._alert_attrs(name, value, bs, bl))
+        self._trace_alert("slo.alert_fired", name, value, bs, bl)
+
+    def _clear_alert(self, name, value, bs, bl) -> None:
+        rec = self._active.pop(name, None)
+        if rec is not None:
+            rec["state"] = "resolved"
+            rec["cleared_at"] = time.time()
+        try:
+            self.registry.gauge(
+                "slo_alerting", "1 while the objective is alerting").set(
+                    0.0, objective=name)
+        except Exception:
+            pass
+        jlog(logger, "slo.alert_cleared",
+             **self._alert_attrs(name, value, bs, bl))
+        self._trace_alert("slo.alert_cleared", name, value, bs, bl)
+
+    def _trace_alert(self, event, name, value, bs, bl) -> None:
+        """Alert transitions land in the trace stream as a `slo.alert`
+        root span carrying an event annotation — the evaluator thread
+        has no ambient request context, so it roots its own trace."""
+        try:
+            from . import tracing
+            attrs = self._alert_attrs(name, value, bs, bl)
+            with tracing.tracer.start_span("slo.alert", attributes=attrs):
+                tracing.event(event, **attrs)
+        except Exception:
+            pass
+
+    # -- public surface ------------------------------------------------------
+
+    def step(self, now: Optional[float] = None) -> None:
+        self.sample(now)
+        self.evaluate(now)
+
+    def status(self) -> dict:
+        with self._lock:
+            statuses = list(self._last_status)
+            n_samples = len(self._samples)
+            active = sorted(self._active)
+        if not statuses:           # no step yet: evaluate on demand
+            statuses = self.evaluate()
+            with self._lock:
+                active = sorted(self._active)
+        return {"enabled": True, "sampled_at": time.time(),
+                "sample_count": n_samples,
+                "sample_interval_s": self.sample_interval_s,
+                "windows": {"short_s": self.short_window_s,
+                            "long_s": self.long_window_s},
+                "burn_threshold": self.burn_threshold,
+                "clear_ratio": self.clear_ratio,
+                "alerting": active,
+                "objectives": statuses}
+
+    def alerts_snapshot(self) -> dict:
+        with self._lock:
+            return {"active": [dict(r) for r in self._active.values()],
+                    "history": [dict(r) for r in self._history]}
+
+    # -- background thread ---------------------------------------------------
+
+    def start(self) -> "SloEvaluator":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.sample_interval_s):
+                try:
+                    self.step()
+                except Exception:      # never take the node down
+                    logger.exception("slo evaluator step failed")
+
+        self._thread = threading.Thread(
+            target=loop, name="slo-evaluator", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        th, self._thread = self._thread, None
+        if th is not None:
+            th.join(timeout=2.0)
+
+
+def register_routes(ops, evaluator: SloEvaluator) -> None:
+    """Mount GET /slo and GET /slo/alerts.  /slo/alerts first: the ops
+    server matches registered prefixes in insertion order."""
+    ops.register_route("GET", "/slo/alerts",
+                       lambda path, body: (200,
+                                           evaluator.alerts_snapshot()))
+    ops.register_route("GET", "/slo",
+                       lambda path, body: (200, evaluator.status()))

@@ -45,7 +45,8 @@ class ChaosNet:
                  peer_orgs=("Org1", "Org2"), peers_per_org: int = 1,
                  channel_id: str = "ch", batch=None,
                  gateway_cfg: Optional[dict] = None,
-                 peer_overrides: Optional[dict] = None):
+                 peer_overrides: Optional[dict] = None,
+                 orderer_overrides: Optional[dict] = None):
         from fabric_tpu.node.provision import provision_network
         self.base_dir = str(base_dir)
         self.channel_id = channel_id
@@ -57,6 +58,7 @@ class ChaosNet:
             "linger_s": 0.002, "max_batch": 8,
             "broadcast_deadline_s": 20.0}
         self.peer_overrides = dict(peer_overrides or {})
+        self.orderer_overrides = dict(orderer_overrides or {})
         # name -> (kind, cfg-path); insertion order = start order
         self._specs: Dict[str, Tuple[str, str]] = {}
         for p in self.paths["orderers"]:
@@ -78,6 +80,7 @@ class ChaosNet:
             cfg = json.load(f)
         if kind == "orderer":
             from fabric_tpu.node.orderer import OrdererNode
+            cfg.update(self.orderer_overrides)
             return OrdererNode(cfg, data_dir=cfg["data_dir"])
         from fabric_tpu.node.peer import PeerNode
         cfg["gateway"] = dict(self.gateway_cfg)

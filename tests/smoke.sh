@@ -34,6 +34,9 @@ python tests/smoke_traces.py
 echo "== seeded chaos probe (fault plane + convergence) =="
 python tests/smoke_chaos.py
 
+echo "== telemetry + SLO probe (/metrics, /slo, /gateway, node.top) =="
+python tests/smoke_metrics.py
+
 echo "== native streamed-window probe (C tail/gate vs Python mirror) =="
 python tests/smoke_window.py
 

@@ -1,0 +1,142 @@
+"""Smoke probe for the telemetry + SLO plane (called by smoke.sh).
+
+Boots a minimal 3-node ChaosNet (1 raft orderer, Org1/Org2 peers, SW
+provider) with the ops surface enabled on EVERY node, pushes a few
+transactions through the gateway, then asserts:
+
+  - /metrics exposes the pipeline-economics families (stage SLIs,
+    live overlap gauge, commit counters),
+  - /slo reports all four default objectives with burn-rate fields and
+    the evaluator thread is actually sampling,
+  - /slo/alerts serves the active/history split,
+  - /gateway shows the front door's admission state,
+  - node.top collects and renders one row for every node in the
+    topology (peers AND orderer).
+
+Named smoke_* (not test_*) on purpose: this is a script for the shell
+gate, not a pytest module.
+"""
+
+import json
+import sys
+import tempfile
+import time
+import urllib.request
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.config import BatchConfig
+from fabric_tpu.node import top
+from fabric_tpu.protocol.txflags import ValidationCode
+from fabric_tpu.testing import ChaosNet
+
+
+def _fail(msg) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def main() -> int:
+    init_factories(FactoryOpts(default="SW"))
+    with tempfile.TemporaryDirectory() as base:
+        net = ChaosNet(
+            base, n_orderers=1, peer_orgs=["Org1", "Org2"],
+            peers_per_org=1,
+            batch=BatchConfig(max_message_count=4, timeout_s=0.05),
+            gateway_cfg={"linger_s": 0.002, "max_batch": 8,
+                         "broadcast_deadline_s": 20.0,
+                         "rpc_timeout_s": 2.0},
+            peer_overrides={"ops_port": 0,
+                            "slo": {"sample_interval_s": 0.2,
+                                    "short_window_s": 2.0,
+                                    "long_window_s": 6.0}},
+            orderer_overrides={"ops_port": 0})
+        net.start()
+        try:
+            gw = net.client("Org1")
+            try:
+                for i in range(4):
+                    code, _ = gw.submit_transaction(
+                        "assets", "create", [b"sli%d" % i, b"v"],
+                        commit_timeout_s=60.0)
+                    if code != int(ValidationCode.VALID):
+                        return _fail(f"tx {i} code {code}")
+            finally:
+                gw.close()
+
+            host, port = net.peers()[0].ops.addr
+
+            def get(path, raw=False):
+                with urllib.request.urlopen(
+                        f"http://{host}:{port}{path}", timeout=5) as r:
+                    body = r.read().decode()
+                    return body if raw else json.loads(body)
+
+            # pipeline-economics families on the exposition surface
+            text = get("/metrics", raw=True)
+            for family in ("committed_blocks_total",
+                           "committed_txs_total",
+                           "validation_duration_seconds",
+                           'validator_stage_seconds_bucket'
+                           '{channel="ch",stage="collect",le="0.001"}',
+                           'validator_stage_seconds_bucket'
+                           '{channel="ch",stage="commit",le="0.001"}',
+                           "pipeline_collect_under_verify_frac"):
+                if family not in text:
+                    return _fail(f"/metrics missing {family!r}")
+
+            # the SLO evaluator is sampling and serves every objective
+            deadline = time.time() + 10
+            slo = get("/slo")
+            while time.time() < deadline and slo["sample_count"] < 3:
+                time.sleep(0.3)
+                slo = get("/slo")
+            if slo["sample_count"] < 3:
+                return _fail(f"slo evaluator not sampling: {slo}")
+            names = {o["name"] for o in slo["objectives"]}
+            want = {"commit_p99_s", "verify_throughput_floor",
+                    "breaker_open_frac", "overlap_floor"}
+            if not want <= names:
+                return _fail(f"/slo objectives {names} missing {want}")
+            for o in slo["objectives"]:
+                for k in ("state", "burn_short", "burn_long",
+                          "value_short", "threshold", "windows"):
+                    if k not in o:
+                        return _fail(f"objective {o['name']} missing {k}")
+            alerts = get("/slo/alerts")
+            if set(alerts) != {"active", "history"}:
+                return _fail(f"/slo/alerts shape: {alerts}")
+
+            # the gateway's admission state rides the same surface
+            gw_state = get("/gateway")
+            for k in ("queue_depth", "healthy", "orderers"):
+                if k not in gw_state:
+                    return _fail(f"/gateway missing {k}: {gw_state}")
+
+            # node.top: one scrapeable row per node, rendered
+            targets = ["%s:%d" % n.ops.addr[:2]
+                       for n in net.peers() + net.orderers()]
+            rows = [top.collect_node(t) for t in targets]
+            for row in rows:
+                if not row["up"]:
+                    return _fail(f"top row down: {row}")
+            peer_rows = rows[:len(net.peers())]
+            if any(r["height"] is None or r["height"] < 1
+                   for r in peer_rows):
+                return _fail(f"top peer heights: {peer_rows}")
+            if any(r["collect"] is None or r["commit"] is None
+                   for r in peer_rows):
+                return _fail(f"top peer stage quantiles: {peer_rows}")
+            frame = top.render(rows)
+            if any(t not in frame for t in targets):
+                return _fail(f"render missing a node:\n{frame}")
+
+            print(f"OK: 4 txs VALID; /metrics+/slo+/gateway live on "
+                  f"{host}:{port}; top rendered {len(rows)} nodes "
+                  f"(slo samples={slo['sample_count']})")
+            return 0
+        finally:
+            net.stop_all()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

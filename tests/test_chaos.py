@@ -386,7 +386,12 @@ def chaos_net(tmp_path_factory, provider):
                      "broadcast_deadline_s": 30.0,
                      "rpc_timeout_s": 2.0,
                      "submit_timeout_s": 30.0},
-        peer_overrides={"ops_port": 0})
+        peer_overrides={"ops_port": 0,
+                        # tight SLO windows so the blackout drill below
+                        # flips an objective within seconds, not minutes
+                        "slo": {"sample_interval_s": 0.2,
+                                "short_window_s": 1.0,
+                                "long_window_s": 3.0}})
     net.start()
     try:
         yield net
@@ -420,7 +425,12 @@ def test_chaos_convergence_exactly_once(chaos_net):
               max_fires=40)
         # client -> gateway submits: duplicated frames (handler runs
         # twice; the txid dedup window must absorb the second run)
-        .rule(method="gateway.submit", kind="req", dup=0.5, max_fires=8))
+        .rule(method="gateway.submit", kind="req", dup=0.5, max_fires=8)
+        # raft heartbeat/append casts: adjacent frames swapped — raft's
+        # term checks must tolerate out-of-order delivery.  The cast
+        # stream is high-frequency, so the parked frame is always
+        # released by the next heartbeat (no wedge).
+        .rule(method="raft.step", kind="cast", reorder=0.25, max_fires=10))
 
     # while installed, the ops plane shows the plan on every node
     code, body = _ops_get(net.peers()[0], "/faults")
@@ -493,10 +503,17 @@ def test_chaos_convergence_exactly_once(chaos_net):
     for tag in txids:
         assert valid_keys.count(tag) == 1, (tag, valid_keys)
 
-    # the plan actually fired all three fault kinds
+    # the plan actually fired all four fault kinds, and the fired
+    # reorders are visible on the metrics surface
     assert plan.fired["drop"] > 0, plan.fired
     assert plan.fired["delay"] > 0, plan.fired
     assert plan.fired["dup"] > 0, plan.fired
+    assert plan.fired["reorder"] > 0, plan.fired
+    host, port = net.peers()[0].ops.addr[:2]
+    with urllib.request.urlopen(
+            f"http://{host}:{port}/metrics", timeout=5) as r:
+        metrics_text = r.read().decode()
+    assert 'fault_injected_total{action="reorder"' in metrics_text
 
     # after heal + uninstall: /faults is empty and /healthz is clean
     code, body = _ops_get(net.peers()[0], "/faults")
@@ -511,25 +528,58 @@ def test_chaos_convergence_exactly_once(chaos_net):
     assert body["status"] == "OK", body
 
 
-def test_orderer_breaker_recovers_after_restart(chaos_net):
+def test_orderer_breaker_recovers_after_restart(chaos_net, caplog):
     """Severing every orderer trips all gateway breakers (healthz goes
-    red); healing lets the half-open probe close them again."""
+    red) and flips the breaker_open_frac SLO to alerting — the alert
+    lands on /slo, /slo/alerts, the jlog stream and the trace stream;
+    healing lets the half-open probe close the breakers again."""
+    import logging
     net = chaos_net
     gw_peer = net.peers()[0]
     bc = gw_peer.gateway.broadcaster
 
-    plan = faults.install(FaultPlan(seed=9, name="blackout"))
-    plan.isolate([net.orderer_addr(n) for n, (k, _) in net._specs.items()
-                  if k == "orderer"])
-    client = net.client("Org1")
-    try:
-        with pytest.raises(Exception):
-            client.submit_transaction("assets", "create",
-                                      [b"blackout", b"x"],
-                                      commit_timeout_s=8.0)
-    finally:
-        client.close()
-    assert bc.healthy() is False or bc._failures > 0
+    with caplog.at_level(logging.WARNING,
+                         logger="fabric_tpu.ops_plane.slo"):
+        plan = faults.install(FaultPlan(seed=9, name="blackout"))
+        plan.isolate([net.orderer_addr(n)
+                      for n, (k, _) in net._specs.items()
+                      if k == "orderer"])
+        client = net.client("Org1")
+        try:
+            with pytest.raises(Exception):
+                client.submit_transaction("assets", "create",
+                                          [b"blackout", b"x"],
+                                          commit_timeout_s=8.0)
+        finally:
+            client.close()
+        assert bc.healthy() is False or bc._failures > 0
+
+        # the sustained blackout burns through both SLO windows: the
+        # peer's evaluator flips breaker_open_frac to alerting
+        st = None
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            _, slo = _ops_get(gw_peer, "/slo")
+            st = {o["name"]: o
+                  for o in slo["objectives"]}["breaker_open_frac"]
+            if st["state"] == "alerting":
+                break
+            time.sleep(0.3)
+        assert st is not None and st["state"] == "alerting", st
+        assert st["burn_short"] >= 1.0 and st["burn_long"] >= 1.0, st
+        assert "breaker_open_frac" in slo["alerting"]
+        _, alerts = _ops_get(gw_peer, "/slo/alerts")
+        assert any(a["objective"] == "breaker_open_frac"
+                   and a["state"] == "firing"
+                   for a in alerts["active"]), alerts
+
+    # the alert transition landed as a structured jlog record ...
+    fired = [r for r in caplog.records if "slo.alert_fired" in r.message]
+    assert any(json.loads(r.message)["objective"] == "breaker_open_frac"
+               for r in fired), caplog.records
+    # ... and as a root span in the trace stream
+    _, doc = _ops_get(gw_peer, "/spans/stats")
+    assert "slo.alert" in doc["spans"], sorted(doc["spans"])
 
     plan.heal()
     faults.uninstall()

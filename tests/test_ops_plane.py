@@ -128,6 +128,271 @@ def test_profiling_routes():
         ops.stop()
 
 
+# -- exposition correctness (escaping, name validation, le boundaries) ------
+
+
+def test_label_value_escaping():
+    reg = MetricsRegistry()
+    reg.counter("esc_total").add(1, path='a\\b"c\nd')
+    text = reg.expose_text()
+    assert 'esc_total{path="a\\\\b\\"c\\nd"} 1.0' in text
+    # stays one-line-per-sample despite the raw newline, and the
+    # dashboard's exposition parser round-trips the original value
+    assert sum("esc_total{" in line for line in text.splitlines()) == 1
+    from fabric_tpu.node import top
+    (labels, value), = top.parse_metrics(text)["esc_total"]
+    assert labels == {"path": 'a\\b"c\nd'} and value == 1.0
+
+
+def test_metric_and_label_name_validation():
+    reg = MetricsRegistry()
+    with pytest.raises(ValueError):
+        reg.counter("bad-name")
+    with pytest.raises(ValueError):
+        reg.gauge("1starts_with_digit")
+    with pytest.raises(ValueError):
+        reg.histogram("bad metric")
+    reg.counter("ns:ok_total").add(1)      # colons legal in metric names
+    with pytest.raises(ValueError):
+        reg.counter("ok_total").add(1, **{"bad:label": "x"})
+
+
+def test_histogram_boundary_values_land_in_le_bucket():
+    """le semantics are inclusive: a value EQUAL to an upper bound
+    belongs in that bound's bucket."""
+    reg = MetricsRegistry()
+    h = reg.histogram("bound_seconds", buckets=(0.1, 1.0, float("inf")))
+    h.observe(0.1)             # == first bound
+    h.observe(1.0)             # == second bound
+    h.observe(1.0000001)       # just over -> +Inf bucket only
+    text = reg.expose_text()
+    assert 'bound_seconds_bucket{le="0.1"} 1' in text
+    assert 'bound_seconds_bucket{le="1.0"} 2' in text
+    assert 'bound_seconds_bucket{le="+Inf"} 3' in text
+    assert "bound_seconds_count 3" in text
+
+
+def test_counter_gauge_locked_reads_and_aggregates():
+    reg = MetricsRegistry()
+    c = reg.counter("reads_total")
+    c.add(2, x="1")
+    c.add(3, x="2")
+    assert c.value(x="1") == 2.0
+    assert c.total() == 5.0
+    g = reg.gauge("reads_gauge")
+    g.set(4, x="1")
+    g.add(-1, x="1")
+    assert g.value(x="1") == 3.0
+    assert g.values() == {(("x", "1"),): 3.0}
+    counts, total, n = reg.histogram("reads_seconds").state()
+    assert counts == [0] * len(reg.histogram("reads_seconds").buckets)
+    assert total == 0.0 and n == 0
+
+
+# -- SLO evaluator (multi-window burn rate, dedup/hysteresis, routes) -------
+
+
+def _slo_eval(reg, **overrides):
+    from fabric_tpu.ops_plane.slo import SloEvaluator
+    cfg = {"sample_interval_s": 1.0, "short_window_s": 4.0,
+           "long_window_s": 8.0}
+    cfg.update(overrides)
+    return SloEvaluator(cfg, registry=reg)
+
+
+def test_slo_gauge_objective_fires_dedups_and_recovers():
+    reg = MetricsRegistry()
+    g = reg.gauge("gateway_orderer_breaker_open")
+    g.set(0.0, orderer="a")
+    g.set(0.0, orderer="b")
+    ev = _slo_eval(reg)
+    t = 0.0
+    for _ in range(10):
+        ev.sample(t)
+        ev.evaluate(t)
+        t += 1.0
+    sts = {s["name"]: s for s in ev.evaluate(t)}
+    assert sts["breaker_open_frac"]["state"] == "ok"
+    assert not ev.alerts_snapshot()["active"]
+
+    # blackout: every breaker opens -> frac 1.0 > 0.5 threshold
+    g.set(1.0, orderer="a")
+    g.set(1.0, orderer="b")
+    for _ in range(10):
+        ev.sample(t)
+        ev.evaluate(t)
+        t += 1.0
+    sts = {s["name"]: s for s in ev.evaluate(t)}
+    st = sts["breaker_open_frac"]
+    assert st["state"] == "alerting"
+    assert st["burn_short"] >= 1.0 and st["burn_long"] >= 1.0
+    alerts = ev.alerts_snapshot()
+    assert [a["objective"] for a in alerts["active"]] == \
+        ["breaker_open_frac"]
+    n_hist = len(alerts["history"])
+
+    # dedup: sustained burn fires NO additional alert records
+    for _ in range(5):
+        ev.sample(t)
+        ev.evaluate(t)
+        t += 1.0
+    assert len(ev.alerts_snapshot()["history"]) == n_hist
+
+    # recovery with hysteresis: the first healthy sample leaves stale
+    # burn in the short window -> still alerting; the window draining
+    # below clear_ratio clears it
+    g.set(0.0, orderer="a")
+    g.set(0.0, orderer="b")
+    ev.sample(t)
+    ev.evaluate(t)
+    assert ev.alerts_snapshot()["active"], "cleared too eagerly"
+    cleared = None
+    for i in range(10):
+        t += 1.0
+        ev.sample(t)
+        ev.evaluate(t)
+        if not ev.alerts_snapshot()["active"]:
+            cleared = i
+            break
+    assert cleared is not None
+    hist = ev.alerts_snapshot()["history"]
+    assert hist[-1]["state"] == "resolved" and "cleared_at" in hist[-1]
+
+
+def test_slo_throughput_floor_counter_rate():
+    reg = MetricsRegistry()
+    c = reg.counter("provider_device_sigs_total")
+    ev = _slo_eval(reg, objectives={
+        "verify_throughput_floor": {"threshold": 100.0}})
+    t = 0.0
+    for _ in range(10):
+        c.add(500.0)             # 500 sigs/s, well above the floor
+        ev.sample(t)
+        ev.evaluate(t)
+        t += 1.0
+    sts = {s["name"]: s for s in ev.evaluate(t)}
+    st = sts["verify_throughput_floor"]
+    assert st["state"] == "ok"
+    assert st["value_short"] == pytest.approx(500.0, rel=0.3)
+    for _ in range(10):
+        c.add(10.0)              # collapse below the floor
+        ev.sample(t)
+        ev.evaluate(t)
+        t += 1.0
+    sts = {s["name"]: s for s in ev.evaluate(t)}
+    st = sts["verify_throughput_floor"]
+    assert st["state"] == "alerting"
+    assert st["burn_short"] > 1.0
+
+
+def test_slo_histogram_quantile_windowed():
+    reg = MetricsRegistry()
+    h = reg.histogram("validation_duration_seconds",
+                      buckets=(0.1, 1.0, 5.0, float("inf")))
+    ev = _slo_eval(reg, objectives={
+        "commit_p99_s": {"threshold": 1.0, "q": 0.99}})
+    t = 0.0
+    for _ in range(10):
+        for _ in range(5):
+            h.observe(0.05)
+        ev.sample(t)
+        ev.evaluate(t)
+        t += 1.0
+    sts = {s["name"]: s for s in ev.evaluate(t)}
+    st = sts["commit_p99_s"]
+    assert st["state"] == "ok"
+    assert st["value_short"] == pytest.approx(0.1)   # bucket upper bound
+    for _ in range(10):
+        for _ in range(5):
+            h.observe(3.0)       # p99 moves to the 5.0 bucket
+        ev.sample(t)
+        ev.evaluate(t)
+        t += 1.0
+    sts = {s["name"]: s for s in ev.evaluate(t)}
+    st = sts["commit_p99_s"]
+    assert st["state"] == "alerting"
+    assert st["value_short"] == pytest.approx(5.0)
+
+
+def test_slo_alert_lands_in_jlog_and_trace(caplog):
+    from fabric_tpu.ops_plane import tracing
+    reg = MetricsRegistry()
+    g = reg.gauge("gateway_orderer_breaker_open")
+    g.set(1.0, orderer="a")
+    ev = _slo_eval(reg, short_window_s=2.0, long_window_s=4.0)
+    prev_enabled = tracing.tracer.enabled
+    tracing.tracer.enabled = True
+    try:
+        with caplog.at_level(logging.WARNING,
+                             logger="fabric_tpu.ops_plane.slo"):
+            t = 0.0
+            for _ in range(8):
+                ev.sample(t)
+                ev.evaluate(t)
+                t += 1.0
+    finally:
+        tracing.tracer.enabled = prev_enabled
+    fired = [r for r in caplog.records if "slo.alert_fired" in r.message]
+    assert fired, "alert must land as a jlog record"
+    doc = json.loads(fired[0].message)
+    assert doc["event"] == "slo.alert_fired"
+    assert doc["objective"] == "breaker_open_frac"
+    assert "slo.alert" in tracing.tracer.span_stats()
+
+
+def test_slo_routes_shape():
+    from fabric_tpu.ops_plane import slo as slomod
+    reg = MetricsRegistry()
+    reg.gauge("pipeline_collect_under_verify_frac").set(0.5, channel="ch")
+    ev = slomod.SloEvaluator({}, registry=reg)
+    ev.step()
+    srv = OperationsServer(metrics=reg).start()
+    try:
+        slomod.register_routes(srv, ev)
+        code, body = _get(srv.addr, "/slo")
+        doc = json.loads(body)
+        assert code == 200 and doc["enabled"] is True
+        names = {o["name"] for o in doc["objectives"]}
+        assert {"commit_p99_s", "verify_throughput_floor",
+                "breaker_open_frac", "overlap_floor"} <= names
+        for o in doc["objectives"]:
+            assert {"state", "burn_short", "burn_long", "value_short",
+                    "value_long", "threshold", "windows"} <= set(o)
+            assert o["state"] in ("ok", "alerting", "no_data")
+        code, body = _get(srv.addr, "/slo/alerts")
+        doc = json.loads(body)
+        assert code == 200
+        assert doc["active"] == [] and doc["history"] == []
+    finally:
+        srv.stop()
+
+
+# -- cluster top dashboard ---------------------------------------------------
+
+
+def test_top_collect_and_render():
+    from fabric_tpu.node import top
+    reg = MetricsRegistry()
+    reg.gauge("ledger_height").set(5, channel="ch")
+    reg.counter("committed_txs_total").add(40, channel="ch")
+    reg.counter("provider_pad_slots_total").add(25, lane="rows")
+    reg.counter("provider_lane_slots_total").add(100, lane="rows")
+    reg.gauge("pipeline_collect_under_verify_frac").set(0.42, channel="ch")
+    srv = OperationsServer(metrics=reg).start()
+    try:
+        addr = "%s:%d" % srv.addr
+        row = top.collect_node(addr)
+        assert row["up"] and row["height"] == 5 and row["txs"] == 40
+        assert row["occupancy"] == pytest.approx(0.75)
+        assert row["overlap"] == pytest.approx(0.42)
+        table = top.render([row])
+        assert addr in table and "75%" in table and "42%" in table
+    finally:
+        srv.stop()
+    down = top.collect_node("127.0.0.1:1")       # nothing listens there
+    assert not down["up"] and "DOWN" in top.render([down])
+
+
 def test_profiling_disabled_by_default():
     import urllib.error
     import urllib.request
