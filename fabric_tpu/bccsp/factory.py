@@ -26,6 +26,11 @@ class FactoryOpts:
     default: str = "JAXTPU"          # "SW" | "JAXTPU"
     require_low_s: bool = True
     use_mesh: bool = False           # shard batches over all visible devices
+    placement: bool = False          # per-channel device placement: carve
+    #                                  the mesh into sub-meshes sized by
+    #                                  channel queue depth (parallel/placement)
+    mesh_devices: Optional[int] = None   # cap the device count the mesh /
+    #                                  placement scheduler may use (None: all)
     degrade: bool = False            # wrap in DegradingProvider (breaker
     #                                  + SW fallback on device sickness)
     compile_cache_dir: Optional[str] = None   # persistent XLA cache dir
@@ -85,21 +90,44 @@ def compile_cache_is_warm(cache_dir: Optional[str] = None,
                and n != WARMUP_MANIFEST) >= min_entries
 
 
+_placement = None                # PlacementScheduler when opts.placement
+
+
 def init_factories(opts: Optional[FactoryOpts] = None) -> Provider:
     """Initialize the default provider (InitFactories equivalent)."""
-    global _default
+    global _default, _placement
     opts = opts or FactoryOpts()
     kind = opts.default.upper()
+    _placement = None
     if kind == "SW":
         _default = SoftwareProvider(require_low_s=opts.require_low_s)
     elif kind == "JAXTPU":
         enable_compile_cache(opts.compile_cache_dir)
         from .jaxtpu import JaxTpuProvider
+        import jax
+        devices = jax.devices()
+        if opts.mesh_devices:
+            devices = devices[:opts.mesh_devices]
         mesh = None
-        if opts.use_mesh:
+        if opts.use_mesh and len(devices) > 1:
             from fabric_tpu.parallel import mesh as meshmod
-            mesh = meshmod.make_mesh()
+            mesh = meshmod.make_mesh(devices)
         _default = JaxTpuProvider(require_low_s=opts.require_low_s, mesh=mesh)
+        if opts.placement and len(devices) > 1:
+            from fabric_tpu.parallel.placement import PlacementScheduler
+            wrap = None
+            if opts.degrade:
+                from .degrade import DegradingProvider
+                low_s = opts.require_low_s
+
+                def wrap(p):
+                    return DegradingProvider(
+                        p, SoftwareProvider(require_low_s=low_s))
+            _placement = PlacementScheduler(
+                devices=devices,
+                provider_factory=lambda m: JaxTpuProvider(
+                    require_low_s=opts.require_low_s, mesh=m),
+                wrap=wrap)
     else:
         raise ValueError(f"unknown BCCSP provider {opts.default!r}")
     if opts.degrade:
@@ -108,6 +136,22 @@ def init_factories(opts: Optional[FactoryOpts] = None) -> Provider:
             _default, SoftwareProvider(require_low_s=opts.require_low_s))
     logger.info("BCCSP default provider: %s", _default.name)
     return _default
+
+
+def get_placement():
+    """The PlacementScheduler, or None when placement is off / SW."""
+    return _placement
+
+
+def provider_for_channel(channel_id: str,
+                         demand: Optional[int] = None) -> Optional[Provider]:
+    """Per-channel provider from the placement scheduler, or None when
+    placement is disabled (callers fall back to the default provider).
+    `demand` is the caller's current queue depth — it sizes the
+    channel's device span on the next carve."""
+    if _placement is None:
+        return None
+    return _placement.provider_for(channel_id, demand=demand)
 
 
 def get_default() -> Provider:

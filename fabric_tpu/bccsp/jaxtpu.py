@@ -17,6 +17,15 @@ of programs.  Malformed items short-circuit to False on the host.
 If device dispatch fails entirely, the whole batch falls back to the
 software provider atomically (SURVEY.md §7 hard-part #5: fallback must be
 atomic to keep determinism).
+
+Device placement: with a mesh (parallel/mesh.py) every lane — generic
+ladder, fixed-comb rows, idemix pairing — shards its flat batch across
+the 1-D 'batch' axis via shard_map, buckets padded to a multiple of the
+mesh size so each device holds an equal tile; verdict bitmaps and the
+psum'd valid count stay on-device until resolve.  The lane-fill gauges
+carry a `device` label so per-chip tile occupancy is observable live.
+Independent channels can pin to disjoint sub-meshes through
+parallel/placement.py (one provider per device subset).
 """
 
 from __future__ import annotations
@@ -158,6 +167,15 @@ class JaxTpuProvider(prov.Provider):
         self.fast_key_threshold = int(
             fast_key_threshold if fast_key_threshold is not None
             else os.environ.get("FABRIC_TPU_FAST_KEY_THRESHOLD", "64"))
+        # telemetry identity of each tile: sharded dispatches lay the
+        # batch out contiguously across the mesh, so slot accounting can
+        # attribute real/pad slots per chip without touching the device
+        if mesh is not None:
+            devs = list(np.asarray(mesh.devices).flat)
+        else:
+            devs = [_jax.devices()[0]]
+        self.device_labels = tuple(
+            f"{d.platform}:{d.id}" for d in devs)
 
     def stats_snapshot(self) -> ProviderStats:
         """Point-in-time copy of the provider's counters plus the table
@@ -246,7 +264,11 @@ class JaxTpuProvider(prov.Provider):
                         {"flags": flags, "A": A1, "B": B1},
                         {"flags": flags, "A": A2, "B": B2},
                         x1, y1, x2, y2)
-                if jax.default_backend() == "cpu":
+                if self.mesh is not None:
+                    from fabric_tpu.parallel import mesh as meshmod
+                    f = meshmod.sharded_idemix_pair_verify(self.mesh)
+                    self._fns[key] = lambda *a: f(*a)[0]
+                elif jax.default_backend() == "cpu":
                     self._fns[key] = pair_fn
                 else:
                     self._fns[key] = jax.jit(pair_fn)
@@ -325,8 +347,12 @@ class JaxTpuProvider(prov.Provider):
     def _pad(self, arrays, n: int):
         b = _bucket(n)
         if self.mesh is not None:
+            # equal per-device tiles: the bucket must split evenly over
+            # the mesh (power-of-two buckets already divide power-of-two
+            # meshes; the rounding covers odd carved sub-mesh sizes)
             size = self.mesh.devices.size
             b = max(b, size)
+            b += (-b) % size
         out = []
         for a in arrays:
             a = np.asarray(a)
@@ -341,30 +367,50 @@ class JaxTpuProvider(prov.Provider):
     _FILL_BUCKETS = (0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0,
                      float("inf"))
 
-    def _observe_lane(self, lane: str, real: int, padded: int) -> None:
+    def _per_device_slots(self, real: int, padded: int,
+                          per_device=None) -> list:
+        """[(device_label, real_d, slots_d)] for one dispatch.  Sharded
+        batches are laid out contiguously over the mesh, real slots
+        first, so each device's real count is a clamped prefix share;
+        lanes whose pad slots interleave (rows) pass explicit counts."""
+        if per_device is not None:
+            return per_device
+        labels = self.device_labels
+        tile, rem = divmod(padded, len(labels))
+        if rem:        # non-mesh-divisible dispatch: charge device 0
+            return [(labels[0], real, padded)]
+        return [(dev, min(max(real - i * tile, 0), tile), tile)
+                for i, dev in enumerate(labels)]
+
+    def _observe_lane(self, lane: str, real: int, padded: int,
+                      per_device=None) -> None:
         """Per-dispatch batching-economics telemetry: lane fill fraction
         and padded-slot waste into the ops_plane registry (the live
-        counterpart of bench.py's one-shot occupancy numbers).  Guarded:
-        observability must never break the dispatch hot path."""
+        counterpart of bench.py's one-shot occupancy numbers), broken
+        out per device tile so a chip running empty shards is visible.
+        Guarded: observability must never break the dispatch hot path."""
         try:
             from fabric_tpu.ops_plane import registry
             fill = (real / padded) if padded else 1.0
-            registry.gauge(
+            fill_g = registry.gauge(
                 "provider_lane_fill_fraction",
-                "real signatures / padded device slots, last dispatch"
-            ).set(fill, lane=lane)
+                "real signatures / padded device slots, last dispatch")
+            pad_c = registry.counter(
+                "provider_pad_slots_total",
+                "padded device slots carrying no real signature")
+            slot_c = registry.counter(
+                "provider_lane_slots_total",
+                "device slots dispatched (real + pad)")
+            for dev, r_d, s_d in self._per_device_slots(
+                    real, padded, per_device):
+                fill_g.set((r_d / s_d) if s_d else 1.0,
+                           lane=lane, device=dev)
+                pad_c.add(float(s_d - r_d), lane=lane, device=dev)
+                slot_c.add(float(s_d), lane=lane, device=dev)
             registry.histogram(
                 "provider_lane_fill",
                 "per-dispatch lane fill fraction",
                 buckets=self._FILL_BUCKETS).observe(fill, lane=lane)
-            registry.counter(
-                "provider_pad_slots_total",
-                "padded device slots carrying no real signature"
-            ).add(float(padded - real), lane=lane)
-            registry.counter(
-                "provider_lane_slots_total",
-                "device slots dispatched (real + pad)"
-            ).add(float(padded), lane=lane)
         except Exception:
             pass
 
@@ -660,7 +706,18 @@ class JaxTpuProvider(prov.Provider):
         keep = slots_np[valid]
         self.stats["device_sigs"] += len(keep)
         self.stats["fast_key_sigs"] += len(keep)
-        self._observe_lane("rows", len(keep), len(slots_np))
+        # rows-lane pad slots interleave (within-row pad + pad rows), so
+        # the per-device split counts the valid mask over each device's
+        # contiguous row range instead of assuming a real-slot prefix
+        per_device = None
+        n_dev = len(self.device_labels)
+        if len(slots_np) % n_dev == 0:
+            chunk = len(slots_np) // n_dev
+            per_device = [
+                (dev, int(valid[i * chunk:(i + 1) * chunk].sum()), chunk)
+                for i, dev in enumerate(self.device_labels)]
+        self._observe_lane("rows", len(keep), len(slots_np),
+                           per_device=per_device)
         pending.append(
             (keep,
              lambda out=out, valid=valid:
@@ -813,6 +870,10 @@ class JaxTpuProvider(prov.Provider):
             b = self.IDEMIX_MIN_BUCKET
             while b < len(g):
                 b <<= 1
+            if self.mesh is not None:
+                size = int(np.asarray(self.mesh.devices).size)
+                b = max(b, size)
+                b += (-b) % size
             padded = g + [g[0]] * (b - len(g))
             # P2 = -Abar: the kernel checks e(P1, w) * e(P2, g2) == 1
             x1 = np.stack([bnmod.int_to_limbs(p[1][0]) for p in padded], 1)

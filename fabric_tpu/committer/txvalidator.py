@@ -187,10 +187,17 @@ class TxValidator:
                  policies: PolicyRegistry,
                  ledger_has_txid=None, bundle_source=None,
                  sbe_lookup=None,
-                 validation_plugin: str = "DefaultValidation"):
+                 validation_plugin: str = "DefaultValidation",
+                 provider_source=None):
         self.channel_id = channel_id
         self._static_msps = msps
-        self.provider = provider
+        self._provider = provider
+        # per-channel device placement hook:
+        # provider_source(channel_id, demand) -> Provider | None.  When
+        # wired (bccsp_placement), each flush re-resolves the provider
+        # and reports its batch size so the placement scheduler can
+        # resize this channel's device span from observed queue depth.
+        self.provider_source = provider_source
         self.policies = policies
         self.bundle_source = bundle_source
         # pluggable commit-time decision (handlers/library/registry.go;
@@ -217,6 +224,28 @@ class TxValidator:
         self._inflight_txids: List[Tuple[int, Dict[str, int]]] = []
         # live pipeline-economics window (overlap gauge for the SLO plane)
         self._econ = _PipelineEconomics()
+
+    @property
+    def provider(self):
+        """The channel's current verify provider: placement-resolved
+        when a provider_source is wired, else the static one."""
+        return self._resolve_provider()
+
+    @provider.setter
+    def provider(self, p):
+        self._provider = p
+
+    def _resolve_provider(self, demand=None):
+        if self.provider_source is not None:
+            try:
+                p = self.provider_source(self.channel_id, demand)
+            except Exception:
+                logger.exception("placement provider_source failed; "
+                                 "using static provider")
+                p = None
+            if p is not None:
+                return p
+        return self._provider
 
     @property
     def msps(self):
@@ -535,7 +564,8 @@ class TxValidator:
             new = keys[flushed:]
             if new:
                 # items are their OWN dedup keys (VerifyItem NamedTuple)
-                resolve = self.provider.batch_verify_async(new)
+                resolve = self._resolve_provider(
+                    len(new)).batch_verify_async(new)
                 # EAGER background resolution: start fetching results
                 # the moment the dispatch is enqueued.  Relayed device
                 # transports serialize a result read behind any LATER
@@ -632,7 +662,8 @@ class TxValidator:
             keys = list(index.keys())
             new = keys[flushed:]
             if new:
-                resolve = self.provider.batch_verify_async(new)
+                resolve = self._resolve_provider(
+                    len(new)).batch_verify_async(new)
                 # eager background resolution — same rationale as the
                 # classic path's flush(): keep the result fetch ahead of
                 # any later dispatch on relayed transports

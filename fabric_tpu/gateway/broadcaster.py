@@ -27,6 +27,14 @@ metric, a jlog line, and a span event on the ambient trace.
 Per-envelope outcomes stay independent: a 4xx (bad envelope, unknown
 channel, filter veto) is final for that envelope only, while 503s
 requeue for the next attempt until the deadline lapses.
+
+Leader hint: a follower that answers 503 includes the raft leader's id
+in its response (`BroadcastResponse.leader_hint`).  The broadcaster maps
+raft ids to endpoints by lazily probing each orderer's `status` RPC, and
+the next rotation jumps STRAIGHT to the leader instead of walking the
+list — without the hint a 5-orderer set wastes up to 4 failed attempts
+(plus backoffs) per leadership change before landing on the node that
+can actually order.
 """
 
 from __future__ import annotations
@@ -102,6 +110,8 @@ class BatchBroadcaster:
         self._idx = 0          # current orderer (sticky while healthy)
         self._conn = None
         self._failures = 0     # consecutive rotate count (drives backoff)
+        self._raft_ids = {}    # orderer idx -> raft id (from status probes)
+        self._leader_idx = None  # where the last leader hint points
 
     # breaker -----------------------------------------------------------
 
@@ -138,6 +148,7 @@ class BatchBroadcaster:
                 0.8 * st.ewma_s + 0.2 * latency_s
             self._set_state(st, CLOSED, "success")
             self._failures = 0
+            self._leader_idx = None   # hint consumed; stickiness takes over
 
     def _on_failure(self, idx: int, reason: str) -> None:
         now = time.monotonic()
@@ -167,6 +178,12 @@ class BatchBroadcaster:
             if st.usable(now) or st.state == HALF_OPEN:
                 candidates.append(i)
         if candidates:
+            # the last leader hint beats the health score while the
+            # leader's own breaker is CLOSED — the healthiest follower
+            # still answers 503 to every broadcast
+            li = self._leader_idx
+            if li in candidates and self._states[li].state == CLOSED:
+                return li
             return min(candidates, key=lambda i: self._states[i].score())
         # everything OPEN inside cooldown: force-probe the one expiring
         # first so a total outage recovers without operator action
@@ -208,7 +225,8 @@ class BatchBroadcaster:
                                  timeout=min(self.rpc_timeout_s, 5.0))
             return self._idx, self._conn
 
-    def _rotate(self, reason: str) -> None:
+    def _rotate(self, reason: str, prefer: Optional[int] = None) -> None:
+        followed = False
         with self._lock:
             if self._conn is not None:
                 try:
@@ -216,9 +234,15 @@ class BatchBroadcaster:
                 except Exception:
                     pass
                 self._conn = None
-            # legacy rotation: advance off the failed orderer so the
-            # next _connection() re-selects; _select may override
-            self._idx = (self._idx + 1) % len(self.orderers)
+            if prefer is not None and prefer != self._idx:
+                # leader hint: jump straight to the node that can order
+                # instead of walking the list one failed attempt at a time
+                self._idx = prefer
+                followed = True
+            else:
+                # legacy rotation: advance off the failed orderer so the
+                # next _connection() re-selects; _select may override
+                self._idx = (self._idx + 1) % len(self.orderers)
             self._failures += 1
         try:
             from fabric_tpu.ops_plane import registry
@@ -226,8 +250,54 @@ class BatchBroadcaster:
                 "gateway_broadcast_retries_total",
                 "orderer broadcast attempts that failed over").add(
                     1, reason=reason)
+            if followed:
+                registry.counter(
+                    "gateway_leader_follows_total",
+                    "rotations that jumped to the hinted raft leader").add(
+                        1, orderer="%s:%s" % self.orderers[prefer])
         except Exception:
             pass
+
+    def _learn_leader(self, raft_id) -> Optional[int]:
+        """Map a raft leader id from a broadcast response to an orderer
+        index, lazily probing unprobed endpoints' `status` RPC to build
+        the raft-id -> endpoint table.  Returns the index (and records
+        it as the rotation preference) or None when unknown/stale."""
+        try:
+            raft_id = int(raft_id or 0)
+        except (TypeError, ValueError):
+            return None
+        if raft_id <= 0:
+            return None
+        with self._lock:
+            known = dict(self._raft_ids)
+        for i, rid in known.items():
+            if rid == raft_id:
+                with self._lock:
+                    self._leader_idx = i
+                return i
+        # probe outside the lock: status is a fast metadata RPC, but a
+        # dead endpoint costs a dial timeout we must not serialize the
+        # breaker plane behind
+        for i, addr in enumerate(self.orderers):
+            if i in known or self._states[i].state == OPEN:
+                continue
+            try:
+                conn = connect(addr, self.signer, self.msps, timeout=2.0)
+                try:
+                    out = conn.call("status", {}, timeout=2.0)
+                finally:
+                    conn.close()
+                rid = int(out.get("raft_id", 0))
+            except Exception:
+                continue
+            with self._lock:
+                self._raft_ids[i] = rid
+            if rid == raft_id:
+                with self._lock:
+                    self._leader_idx = i
+                return i
+        return None
 
     def close(self) -> None:
         with self._lock:
@@ -307,9 +377,12 @@ class BatchBroadcaster:
                 break
             pending = retry
             # the orderer answered but can't order (no leader / halted):
-            # transport is fine, service is not — count against health
+            # transport is fine, service is not — count against health.
+            # Follow its leader hint so the retry lands on the raft
+            # leader instead of the next follower in the list.
             self._on_failure(idx, "unavailable")
-            self._rotate("unavailable")
+            self._rotate("unavailable",
+                         prefer=self._learn_leader(out.get("leader")))
             if time.monotonic() >= deadline:
                 break
             time.sleep(self._backoff())

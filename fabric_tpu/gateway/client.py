@@ -51,18 +51,22 @@ class GatewayClient:
 
     def __init__(self, peer_addr: Tuple[str, int], signer, msps,
                  channel_id: Optional[str] = None,
-                 timeout: float = 5.0):
+                 timeout: float = 5.0, call_timeout: float = 30.0):
         self.peer_addr = tuple(peer_addr)
         self.signer = signer
         self.msps = msps
         self.channel_id = channel_id
         self._timeout = timeout
+        self._call_timeout = call_timeout
         self._lock = threading.Lock()
         self._conn = None
 
     # plumbing ----------------------------------------------------------
 
-    def _call(self, verb: str, body: dict, timeout: float = 30.0) -> dict:
+    def _call(self, verb: str, body: dict,
+              timeout: Optional[float] = None) -> dict:
+        if timeout is None:
+            timeout = self._call_timeout
         with self._lock:
             if self._conn is None:
                 self._conn = connect(self.peer_addr, self.signer, self.msps,
@@ -140,7 +144,8 @@ class GatewayClient:
             # serde is float-free by design: timeouts ride as int ms
             body["timeout_ms"] = int(timeout_s * 1000)
         out = self._call("gateway.submit", body,
-                         timeout=(timeout_s or 20.0) + 10.0)
+                         timeout=max((timeout_s or 20.0) + 10.0,
+                                     self._call_timeout))
         if out.get("status") != 200:
             raise GatewayError(
                 f"submit failed ({out.get('status')}): "
@@ -185,7 +190,9 @@ class GatewayClient:
             env = assemble_transaction(sp, responses, self.signer)
             txid = env.header().channel_header.txid
             span.set_attribute("txid", txid)
-            self.submit_envelope(env)
+            # the commit budget bounds the ordering ack too: on a slow
+            # verify provider the default in-flight window is too short
+            self.submit_envelope(env, timeout_s=commit_timeout_s)
             code, block = self.commit_status(txid, channel=ch,
                                              timeout_s=commit_timeout_s)
         if code != int(ValidationCode.VALID):

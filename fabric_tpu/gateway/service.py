@@ -70,6 +70,15 @@ class GatewayService:
             backoff_max_s=float(cfg.get("backoff_max_s", 2.0)),
             deadline_s=float(cfg.get("broadcast_deadline_s", 10.0)),
             rpc_timeout_s=float(cfg.get("rpc_timeout_s", 10.0)))
+        # endorse fan-out budgets: a dropped org endorsement silently
+        # weakens the policy sig-set and only surfaces at COMMIT time
+        # (ENDORSEMENT_POLICY_FAILURE), so on slow verify providers these
+        # must cover the authenticated handshake, not a bare TCP dial
+        self.fan_dial_timeout_s = float(cfg.get(
+            "fan_dial_timeout_s", max(3.0, float(cfg.get("rpc_timeout_s",
+                                                         3.0)))))
+        self.fan_call_timeout_s = float(cfg.get(
+            "fan_call_timeout_s", max(10.0, self.fan_dial_timeout_s)))
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
         self._queue: List[_Pending] = []
@@ -190,9 +199,10 @@ class GatewayService:
             for addr in self.node.peers:
                 try:
                     conn = connect(tuple(addr[:2]), self.node.signer,
-                                   ch.msps, timeout=3.0)
+                                   ch.msps, timeout=self.fan_dial_timeout_s)
                     try:
-                        out = conn.call("endorse", fan_body, timeout=10.0)
+                        out = conn.call("endorse", fan_body,
+                                        timeout=self.fan_call_timeout_s)
                     finally:
                         conn.close()
                 except Exception as exc:

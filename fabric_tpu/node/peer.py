@@ -260,13 +260,24 @@ class PeerChannel:
             for field in cc.get("indexes", []):
                 self.ledger.statedb.create_index(cc["name"], field)
 
+        # per-channel device placement: when the scheduler is live
+        # (bccsp_placement) each channel verifies on its own carved
+        # device span; provider_source lets every validator flush
+        # re-resolve + report queue depth so spans track demand
+        from fabric_tpu.bccsp import factory as bccsp_factory
+        ch_provider = (bccsp_factory.provider_for_channel(self.channel_id)
+                       or node.provider)
+        provider_source = (bccsp_factory.provider_for_channel
+                           if bccsp_factory.get_placement() is not None
+                           else None)
         self.validator = TxValidator(
-            self.channel_id, None, node.provider, self.policies,
+            self.channel_id, None, ch_provider, self.policies,
             bundle_source=self.bundle_source,
-            sbe_lookup=statedb_lookup(self.ledger.statedb))
+            sbe_lookup=statedb_lookup(self.ledger.statedb),
+            provider_source=provider_source)
         self.committer = Committer(self.ledger, self.validator,
                                    bundle_source=self.bundle_source,
-                                   provider=node.provider,
+                                   provider=ch_provider,
                                    confighistory=self.confighistory)
 
         # private data plane
@@ -436,6 +447,9 @@ class PeerNode:
         self.provider = init_factories(
             FactoryOpts(default=cfg.get("bccsp", "SW"),
                         degrade=bool(cfg.get("bccsp_degrade", False)),
+                        use_mesh=bool(cfg.get("bccsp_mesh", False)),
+                        placement=bool(cfg.get("bccsp_placement", False)),
+                        mesh_devices=cfg.get("bccsp_mesh_devices"),
                         compile_cache_dir=cfg.get("compile_cache_dir")))
         self.signer = load_signing_identity(
             cfg["mspid"], cfg["cert_pem"].encode(), cfg["key_pem"].encode())

@@ -3,6 +3,9 @@
     python -m fabric_tpu.node.top --targets 127.0.0.1:9443,127.0.0.1:9444
     python -m fabric_tpu.node.top --targets ... --interval 2
     python -m fabric_tpu.node.top --targets ... --once      # one frame
+    python -m fabric_tpu.node.top --targets ... --sort occ  # order rows
+    python -m fabric_tpu.node.top --targets ... --watch-alerts
+                                   # stream SLO fired/cleared transitions
 
 Polls each node's ops surface — `/metrics` (Prometheus text),
 `/spans/stats`, `/slo`, `/faults`, `/healthz` — and renders one row per
@@ -115,6 +118,19 @@ def collect_node(addr: str, timeout: float = 2.0) -> dict:
     pad = _sum(metrics.get("provider_pad_slots_total"))
     slots = _sum(metrics.get("provider_lane_slots_total"))
     row["occupancy"] = (1.0 - pad / slots) if slots else None
+    # per-device occupancy from the device-labeled slot counters (the
+    # sharded provider attributes real/pad slots per chip)
+    devices: Dict[str, List[float]] = {}
+    for labels, v in metrics.get("provider_lane_slots_total", ()) or ():
+        d = labels.get("device")
+        if d:
+            devices.setdefault(d, [0.0, 0.0])[0] += v
+    for labels, v in metrics.get("provider_pad_slots_total", ()) or ():
+        d = labels.get("device")
+        if d:
+            devices.setdefault(d, [0.0, 0.0])[1] += v
+    row["devices"] = {
+        d: (1.0 - p / s) if s else None for d, (s, p) in devices.items()}
     ov = [v for _, v in
           metrics.get("pipeline_collect_under_verify_frac", ())]
     row["overlap"] = (sum(ov) / len(ov)) if ov else None
@@ -180,9 +196,51 @@ def _rate(row: dict, prev: dict) -> Optional[float]:
     return (row["txs"] - prev["txs"]) / dt if dt > 0 else None
 
 
+def _fmt_devices(devs) -> str:
+    """Compact per-device occupancy: `8×91-97%` (count × min-max), or
+    `-` when the node has no device-labeled slot series yet."""
+    vals = sorted(v for v in (devs or {}).values() if v is not None)
+    if not vals:
+        return "-"
+    lo, hi = vals[0] * 100, vals[-1] * 100
+    if round(lo) == round(hi):
+        return f"{len(vals)}×{hi:.0f}%"
+    return f"{len(vals)}×{lo:.0f}-{hi:.0f}%"
+
+
 _COLS = ("NODE", "HT", "TX/S", "COLLECT", "DISP", "GATE", "COMMIT",
-         "OCC", "OVLP", "QD", "BRKR", "FAULTS", "SLO", "HEALTH")
-_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 5, 4, 5, 7, 12, 8)
+         "OCC", "DEV", "OVLP", "QD", "BRKR", "FAULTS", "SLO", "HEALTH")
+_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 4, 5, 7, 12, 8)
+
+# --sort column -> row key; None values sort last, numeric descending
+# (the interesting rows — hottest, furthest ahead, most alerting — rise)
+_SORT_KEYS = {
+    "node": "addr", "ht": "height", "tx/s": "rate", "occ": "occupancy",
+    "ovlp": "overlap", "qd": "queue_depth", "brkr": "breakers_open",
+    "faults": "faults_fired", "slo": "slo_alerting", "height": "height",
+    "rate": "rate", "occupancy": "occupancy", "dev": "devices",
+}
+
+
+def sort_rows(rows: List[dict], column: str) -> List[dict]:
+    key = _SORT_KEYS.get(column.lower())
+    if key is None:
+        raise SystemExit(f"--sort: unknown column {column!r} "
+                         f"(one of {', '.join(sorted(_SORT_KEYS))})")
+    if key == "addr":
+        return sorted(rows, key=lambda r: r["addr"])
+
+    def rank(r):
+        v = r.get(key)
+        if key == "slo_alerting":
+            v = len(v) if v is not None else None
+        elif key == "devices":
+            vals = [x for x in (v or {}).values() if x is not None]
+            v = min(vals) if vals else None
+        if not isinstance(v, (int, float)):
+            return (1, 0.0)
+        return (0, -float(v))
+    return sorted(rows, key=rank)
 
 
 def render(rows: List[dict]) -> str:
@@ -208,13 +266,58 @@ def render(rows: List[dict]) -> str:
             "-" if r.get("rate") is None else f"{r['rate']:.1f}",
             _fmt_pair(r.get("collect")), _fmt_pair(r.get("dispatch")),
             _fmt_pair(r.get("gate")), _fmt_pair(r.get("commit")),
-            _fmt_pct(r.get("occupancy")), _fmt_pct(r.get("overlap")),
+            _fmt_pct(r.get("occupancy")), _fmt_devices(r.get("devices")),
+            _fmt_pct(r.get("overlap")),
             f"{r.get('queue_depth', 0):.0f}",
             f"{r.get('breakers_open', 0):.0f}",
             faults, slo, str(r.get("health", "?")))
         lines.append("  ".join(str(c).ljust(w)
                                for c, w in zip(cells, _WIDTHS)))
     return "\n".join(lines)
+
+
+def watch_alerts(targets: List[str], timeout: float, interval: float,
+                 once: bool = False) -> int:
+    """Stream SLO alert transitions: one timestamped line per
+    (node, objective) fired/cleared edge instead of a refreshing table —
+    tail-able, grep-able, and safe to pipe into an incident log."""
+    active: Dict[Tuple[str, str], bool] = {}
+    first = True
+    while True:
+        now = time.strftime("%H:%M:%S")
+        for t in targets:
+            try:
+                objs = _get_json(t, "/slo", timeout).get("objectives", [])
+            except Exception as exc:
+                key = (t, "__reach__")
+                if not active.get(key):
+                    print(f"{now}  {t}  UNREACHABLE  {str(exc)[:60]}")
+                    active[key] = True
+                continue
+            if active.pop((t, "__reach__"), None):
+                print(f"{now}  {t}  REACHABLE")
+            for o in objs:
+                key = (t, o.get("name", "?"))
+                alerting = o.get("state") == "alerting"
+                was = active.get(key, False)
+                if alerting and not was:
+                    print(f"{now}  {t}  FIRED    {key[1]}  "
+                          f"burn={o.get('burn_rate', '?')}")
+                elif was and not alerting:
+                    print(f"{now}  {t}  CLEARED  {key[1]}")
+                elif alerting and first and once:
+                    pass
+                active[key] = alerting
+        if first:
+            live = sorted(k for k, v in active.items()
+                          if v and k[1] != "__reach__")
+            if not live:
+                print(f"{now}  no active alerts on {len(targets)} node(s)")
+            first = False
+        if once:
+            return 0
+        sys.stdout.flush()
+        time.sleep(interval)
 
 
 def main(argv=None) -> int:
@@ -227,11 +330,22 @@ def main(argv=None) -> int:
                     help="refresh interval in seconds")
     ap.add_argument("--once", action="store_true",
                     help="render a single frame and exit")
+    ap.add_argument("--sort", metavar="COLUMN",
+                    help="order rows by a column (e.g. occ, tx/s, qd, "
+                         "slo); numeric descending, missing values last")
+    ap.add_argument("--watch-alerts", action="store_true",
+                    help="stream SLO fired/cleared transition lines "
+                         "instead of the table")
     ap.add_argument("--timeout", type=float, default=2.0)
     args = ap.parse_args(argv)
     targets = [t.strip() for t in args.targets.split(",") if t.strip()]
-    prev: Dict[str, dict] = {}
+    if args.sort:
+        sort_rows([], args.sort)        # validate the column name up front
     try:
+        if args.watch_alerts:
+            return watch_alerts(targets, args.timeout, args.interval,
+                                once=args.once)
+        prev: Dict[str, dict] = {}
         while True:
             rows = []
             for t in targets:
@@ -240,6 +354,8 @@ def main(argv=None) -> int:
                 row["rate"] = _rate(row, prev.get(t, {}))
                 prev[t] = row
                 rows.append(row)
+            if args.sort:
+                rows = sort_rows(rows, args.sort)
             frame = (time.strftime("%H:%M:%S")
                      + f"  fabric-tpu top — {len(targets)} node(s)\n"
                      + render(rows))

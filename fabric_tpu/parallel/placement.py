@@ -1,0 +1,166 @@
+"""Per-channel device placement: carve the chip mesh into sub-meshes.
+
+The reference validates channels independently — each channel gets its
+own txvalidator goroutine pool sized by `peer.validatorPoolSize`
+(core/committer/txvalidator/v20/validator.go), all contending for the
+same host cores.  Here the contended resource is the device mesh: a
+peer joined to N channels owns all 8 chips, and pinning every channel's
+batches to the full mesh would serialize them through one compiled
+program while 7/8 of each tile sits empty on light channels.
+
+`PlacementScheduler` instead assigns each channel a **disjoint
+contiguous device span** sized from its observed queue depth (EWMA of
+the per-flush batch sizes the validator reports via `demand`):
+
+  - shares are powers of two (`mesh.allocate_devices`), so the padded
+    bucket series — and therefore the compiled-program set — is stable
+    across rebalances;
+  - spans are contiguous (`mesh.carve_submeshes`), keeping each
+    sub-mesh on ICI-neighbouring chips;
+  - rebalances are hysteretic: the carve is only redone when a new
+    channel registers or some channel's demand drifts by more than
+    `rebalance_ratio` from the demand snapshot the current carve was
+    built from.  Providers are cached per device span, so a rebalance
+    that hands a channel a span some earlier carve used re-attaches the
+    already-warm provider instead of recompiling.
+
+The scheduler never blocks a verify: `provider_for` does cheap host
+bookkeeping and returns a provider; device work stays inside it.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+from fabric_tpu.parallel import mesh as meshmod
+
+
+class PlacementScheduler:
+    def __init__(self, devices=None, provider_factory=None,
+                 wrap: Optional[Callable] = None,
+                 rebalance_ratio: float = 2.0,
+                 ewma_alpha: float = 0.3):
+        """`provider_factory(mesh) -> Provider` builds the per-span
+        provider (a single-device provider when the span is one chip);
+        `wrap(provider) -> provider` optionally decorates each one once
+        (the factory passes the degradation breaker here so per-channel
+        providers keep the SW-fallback behaviour of the global one)."""
+        if devices is None:
+            import jax
+            devices = jax.devices()
+        if provider_factory is None:
+            from fabric_tpu.bccsp.jaxtpu import JaxTpuProvider
+
+            def provider_factory(m):
+                return JaxTpuProvider(mesh=m)
+        self.devices = list(devices)
+        self.provider_factory = provider_factory
+        self.wrap = wrap
+        self.rebalance_ratio = float(rebalance_ratio)
+        self.ewma_alpha = float(ewma_alpha)
+        self._lock = threading.Lock()
+        self._demand = {}          # channel -> EWMA of reported batch sizes
+        self._carve_demand = {}    # demand snapshot the current carve used
+        self._assign = {}          # channel -> (lo, size)
+        self._providers = {}       # (lo, size) -> wrapped provider
+        self.rebalances = 0
+
+    # -- internals (callers hold self._lock) --------------------------------
+
+    def _span_provider(self, lo: int, size: int):
+        key = (lo, size)
+        p = self._providers.get(key)
+        if p is None:
+            if size == 1:
+                m = None            # single chip: skip shard_map overhead
+                p = self.provider_factory(m)
+                # pin dispatches to the span's chip, not devices()[0]
+                dev = self.devices[lo]
+                if hasattr(p, "device_labels"):
+                    p.device_labels = (f"{dev.platform}:{dev.id}",)
+            else:
+                m = meshmod.make_mesh(self.devices[lo:lo + size])
+                p = self.provider_factory(m)
+            if self.wrap is not None:
+                p = self.wrap(p)
+            self._providers[key] = p
+        return p
+
+    def _recarve(self):
+        channels = sorted(self._demand)
+        sizes = meshmod.allocate_devices(
+            len(self.devices), [self._demand[c] for c in channels])
+        lo = 0
+        self._assign = {}
+        for ch, sz in zip(channels, sizes):
+            self._assign[ch] = (lo, sz)
+            lo += sz
+        self._carve_demand = dict(self._demand)
+        self.rebalances += 1
+        try:
+            from fabric_tpu.ops_plane import registry
+            g = registry.gauge(
+                "placement_channel_devices",
+                "devices assigned to each channel by the placement scheduler")
+            for ch, (_, sz) in self._assign.items():
+                g.set(float(sz), channel=ch)
+        except Exception:
+            pass
+
+    def _drifted(self) -> bool:
+        for ch, d in self._demand.items():
+            base = self._carve_demand.get(ch)
+            if base is None:
+                return True
+            hi, lo = max(d, base, 1e-9), max(min(d, base), 1e-9)
+            if hi / lo >= self.rebalance_ratio:
+                return True
+        return False
+
+    # -- public API ----------------------------------------------------------
+
+    def provider_for(self, channel_id: str, demand: Optional[int] = None):
+        """The provider for `channel_id`'s current device span.
+
+        `demand` is the caller's queue depth at this flush (batch size);
+        it feeds the EWMA that sizes the next carve.  Registration of a
+        new channel always recarves; otherwise only ratio drift does."""
+        with self._lock:
+            a = self.ewma_alpha
+            prev = self._demand.get(channel_id)
+            if demand is not None and demand > 0:
+                self._demand[channel_id] = (
+                    float(demand) if prev is None
+                    else (1 - a) * prev + a * float(demand))
+            elif prev is None:
+                self._demand[channel_id] = 1.0
+            new_channel = channel_id not in self._assign
+            if new_channel or (self._drifted() and self._would_resize()):
+                self._recarve()
+            lo, size = self._assign[channel_id]
+            return self._span_provider(lo, size)
+
+    def _would_resize(self) -> bool:
+        """True when recarving under current demand changes any span
+        size — drift that allocates identically is not worth a carve."""
+        channels = sorted(self._demand)
+        sizes = meshmod.allocate_devices(
+            len(self.devices), [self._demand[c] for c in channels])
+        for ch, sz in zip(channels, sizes):
+            cur = self._assign.get(ch)
+            if cur is None or cur[1] != sz:
+                return True
+        return False
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "channels": {
+                    ch: {"devices": sz, "span_start": lo,
+                         "demand_ewma": round(self._demand.get(ch, 0.0), 2)}
+                    for ch, (lo, sz) in self._assign.items()},
+                "n_devices": len(self.devices),
+                "rebalances": self.rebalances,
+                "cached_spans": sorted(self._providers),
+            }

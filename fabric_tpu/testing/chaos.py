@@ -171,8 +171,11 @@ class ChaosNet:
             time.sleep(0.1)
         raise AssertionError("no raft leader within %.0fs" % timeout_s)
 
-    def client(self, org: str = "Org1", peer_idx: int = 0):
-        """GatewayClient bound to one running peer."""
+    def client(self, org: str = "Org1", peer_idx: int = 0,
+               timeout: float = 5.0, call_timeout: float = 30.0):
+        """GatewayClient bound to one running peer.  Raise the timeouts
+        when the peers verify on a slow provider (e.g. the JAXTPU eager
+        CPU path, seconds per handshake/endorse on a 1-core host)."""
         from fabric_tpu.gateway import GatewayClient
         from fabric_tpu.node.orderer import load_signing_identity
         with open(self.paths["clients"][org]) as f:
@@ -181,7 +184,8 @@ class ChaosNet:
             cc["mspid"], cc["cert_pem"].encode(), cc["key_pem"].encode())
         peer = self.peers()[peer_idx]
         return GatewayClient(peer.rpc.addr, signer, peer.msps,
-                             channel_id=self.channel_id)
+                             channel_id=self.channel_id,
+                             timeout=timeout, call_timeout=call_timeout)
 
     # -- convergence invariants ------------------------------------------
 

@@ -40,6 +40,9 @@ python tests/smoke_metrics.py
 echo "== native streamed-window probe (C tail/gate vs Python mirror) =="
 python tests/smoke_window.py
 
+echo "== sharded mesh window probe (8 virtual devices, divergence gate) =="
+python tests/smoke_mesh.py
+
 echo "== non-slow test subset =="
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 echo "OK: smoke passed"

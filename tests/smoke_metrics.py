@@ -1,11 +1,14 @@
 """Smoke probe for the telemetry + SLO plane (called by smoke.sh).
 
-Boots a minimal 3-node ChaosNet (1 raft orderer, Org1/Org2 peers, SW
-provider) with the ops surface enabled on EVERY node, pushes a few
+Boots a minimal 3-node ChaosNet (1 raft orderer, JAXTPU peers, SW
+orderer) with the ops surface enabled on EVERY node, pushes a few
 transactions through the gateway, then asserts:
 
   - /metrics exposes the pipeline-economics families (stage SLIs,
     live overlap gauge, commit counters),
+  - the peers' JAXTPU provider emits the device-labeled lane-fill /
+    slot counters on the live exposition surface (the per-chip
+    occupancy proof the sharded dispatcher is judged by),
   - /slo reports all four default objectives with burn-rate fields and
     the evaluator thread is actually sampling,
   - /slo/alerts serves the active/history split,
@@ -35,24 +38,51 @@ def _fail(msg) -> int:
     return 1
 
 
+def _warm_eager_provider():
+    """One throwaway dispatch absorbs the JAXTPU eager path's one-time
+    in-process warmup (tens of seconds of per-primitive XLA:CPU compile,
+    cached process-globally) so the live peers' first endorse RPC stays
+    inside the client timeout."""
+    import hashlib
+
+    from fabric_tpu.bccsp.jaxtpu import JaxTpuProvider
+    from fabric_tpu.bccsp.provider import SCHEME_P256, VerifyItem
+    from fabric_tpu.bccsp.sw import SoftwareProvider
+
+    sw = SoftwareProvider()
+    k = sw.key_gen(SCHEME_P256)
+    digest = hashlib.sha256(b"warm").digest()
+    item = VerifyItem(SCHEME_P256, k.public_bytes(), sw.sign(k, digest),
+                      digest)
+    assert bool(JaxTpuProvider().batch_verify([item])[0])
+
+
 def main() -> int:
     init_factories(FactoryOpts(default="SW"))
+    _warm_eager_provider()
     with tempfile.TemporaryDirectory() as base:
         net = ChaosNet(
             base, n_orderers=1, peer_orgs=["Org1", "Org2"],
             peers_per_org=1,
             batch=BatchConfig(max_message_count=4, timeout_s=0.05),
             gateway_cfg={"linger_s": 0.002, "max_batch": 8,
-                         "broadcast_deadline_s": 20.0,
-                         "rpc_timeout_s": 2.0},
+                         "broadcast_deadline_s": 30.0,
+                         # JAXTPU peers verify eagerly on CPU (seconds per
+                         # dispatch on a 1-core host): endorse RPCs need
+                         # headroom the SW provider never did
+                         "rpc_timeout_s": 30.0},
             peer_overrides={"ops_port": 0,
+                            # peers verify on the JAXTPU provider so the
+                            # device-labeled lane telemetry is live on a
+                            # real node (eager CPU path: no compiles)
+                            "bccsp": "JAXTPU",
                             "slo": {"sample_interval_s": 0.2,
                                     "short_window_s": 2.0,
                                     "long_window_s": 6.0}},
             orderer_overrides={"ops_port": 0})
         net.start()
         try:
-            gw = net.client("Org1")
+            gw = net.client("Org1", timeout=60.0, call_timeout=180.0)
             try:
                 for i in range(4):
                     code, _ = gw.submit_transaction(
@@ -83,6 +113,19 @@ def main() -> int:
                            "pipeline_collect_under_verify_frac"):
                 if family not in text:
                     return _fail(f"/metrics missing {family!r}")
+
+            # device-labeled batching economics from the JAXTPU provider:
+            # every lane-fill / slot series must name the chip it ran on
+            for family in ("provider_lane_fill_fraction{",
+                           "provider_lane_slots_total{"):
+                lines = [ln for ln in text.splitlines()
+                         if ln.startswith(family)]
+                if not lines:
+                    return _fail(f"/metrics missing {family!r} series")
+                bad = [ln for ln in lines
+                       if 'device="' not in ln or 'lane="' not in ln]
+                if bad:
+                    return _fail(f"series without device/lane label: {bad}")
 
             # the SLO evaluator is sampling and serves every objective
             deadline = time.time() + 10
@@ -129,6 +172,11 @@ def main() -> int:
             frame = top.render(rows)
             if any(t not in frame for t in targets):
                 return _fail(f"render missing a node:\n{frame}")
+            if "DEV" not in frame:
+                return _fail(f"top frame missing DEV column:\n{frame}")
+            if not any(r.get("devices") for r in peer_rows):
+                return _fail(f"top rows lack per-device occupancy: "
+                             f"{[r.get('devices') for r in peer_rows]}")
 
             print(f"OK: 4 txs VALID; /metrics+/slo+/gateway live on "
                   f"{host}:{port}; top rendered {len(rows)} nodes "
