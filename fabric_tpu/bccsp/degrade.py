@@ -197,3 +197,9 @@ class DegradingProvider(Provider):
     def stats_snapshot(self):
         snap = getattr(self.primary, "stats_snapshot", None)
         return snap() if callable(snap) else None
+
+    def __getattr__(self, name):
+        # anything this wrapper doesn't own (stats, idemix probes,
+        # device labels, ...) belongs to the primary — callers must not
+        # have to care whether the provider is breaker-fronted
+        return getattr(self.primary, name)

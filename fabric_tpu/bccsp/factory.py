@@ -31,8 +31,13 @@ class FactoryOpts:
     #                                  channel queue depth (parallel/placement)
     mesh_devices: Optional[int] = None   # cap the device count the mesh /
     #                                  placement scheduler may use (None: all)
-    degrade: bool = False            # wrap in DegradingProvider (breaker
-    #                                  + SW fallback on device sickness)
+    degrade: Optional[bool] = None   # wrap in DegradingProvider (breaker
+    #                                  + SW fallback on device sickness).
+    #                                  None = auto: ON for JAXTPU (a node
+    #                                  that loses its accelerator keeps
+    #                                  committing on SW, healthz flags it),
+    #                                  OFF for SW.  Explicit False is the
+    #                                  fail-stop escape hatch.
     compile_cache_dir: Optional[str] = None   # persistent XLA cache dir
     #                                  (node config "compile_cache_dir" /
     #                                  FABRIC_TPU_<ROLE>_COMPILE_CACHE_DIR)
@@ -98,6 +103,8 @@ def init_factories(opts: Optional[FactoryOpts] = None) -> Provider:
     global _default, _placement
     opts = opts or FactoryOpts()
     kind = opts.default.upper()
+    degrade = (kind == "JAXTPU") if opts.degrade is None else \
+        bool(opts.degrade)
     _placement = None
     if kind == "SW":
         _default = SoftwareProvider(require_low_s=opts.require_low_s)
@@ -116,7 +123,7 @@ def init_factories(opts: Optional[FactoryOpts] = None) -> Provider:
         if opts.placement and len(devices) > 1:
             from fabric_tpu.parallel.placement import PlacementScheduler
             wrap = None
-            if opts.degrade:
+            if degrade:
                 from .degrade import DegradingProvider
                 low_s = opts.require_low_s
 
@@ -130,7 +137,7 @@ def init_factories(opts: Optional[FactoryOpts] = None) -> Provider:
                 wrap=wrap)
     else:
         raise ValueError(f"unknown BCCSP provider {opts.default!r}")
-    if opts.degrade:
+    if degrade:
         from .degrade import DegradingProvider
         _default = DegradingProvider(
             _default, SoftwareProvider(require_low_s=opts.require_low_s))

@@ -188,10 +188,17 @@ class TxValidator:
                  ledger_has_txid=None, bundle_source=None,
                  sbe_lookup=None,
                  validation_plugin: str = "DefaultValidation",
-                 provider_source=None):
+                 provider_source=None, verify_cache=None):
         self.channel_id = channel_id
         self._static_msps = msps
         self._provider = provider
+        # verify-once plane (verify_plane.VerdictCache) — None keeps the
+        # classic always-verify behaviour.  When wired, each flush
+        # partitions its dispatch batch against the cache: MAC-verified
+        # hits skip the device, misses verify and backfill.  Identity
+        # validity and policy evaluation are NEVER cached — the gate
+        # always runs live; only the pure signature bit is reused.
+        self.verify_cache = verify_cache
         # per-channel device placement hook:
         # provider_source(channel_id, demand) -> Provider | None.  When
         # wired (bccsp_placement), each flush re-resolves the provider
@@ -500,6 +507,15 @@ class TxValidator:
         has no analogue — its validator is synchronous per block)."""
         self._msps_snapshot = (self.bundle_source.current().msps
                                if self.bundle_source is not None else None)
+        if self.verify_cache is not None and self.bundle_source is not None:
+            # pin the cache epoch to the config sequence: a config update
+            # (new CRL, rotated CA, policy change) invalidates every
+            # verdict minted under the previous sequence
+            try:
+                self.verify_cache.set_epoch(
+                    self.bundle_source.current().sequence)
+            except Exception:
+                pass
         try:
             return self._begin_inner(block)
         finally:
@@ -554,15 +570,37 @@ class TxValidator:
         seen_txids: Dict[str, int] = {}
         items: Dict[VerifyItem, None] = {}   # insertion-ordered dedup set
         works: List[_TxWork] = []
-        resolvers: List[Tuple[object, List[Tuple]]] = []
+        # (result-or-None, dispatched keys, [(key, verdict, trace)])
+        resolvers: List[Tuple] = []
         flushed = 0
+        hit_n = miss_n = 0
+        spec_links: set = set()
+        cache = self.verify_cache
         chunk = self.overlap_chunk
 
         def flush():
-            nonlocal flushed
+            nonlocal flushed, hit_n, miss_n
             keys = list(items.keys())
             new = keys[flushed:]
             if new:
+                # verify-once: MAC-verified cached verdicts skip the
+                # device entirely; anything else — miss, MAC failure,
+                # stale epoch — goes through the full dispatch below
+                hits: list = []
+                if cache is not None:
+                    miss_pos, raw_hits = cache.filter(new)
+                    hits = [(new[i], v, tr) for i, v, tr in raw_hits]
+                    new = [new[i] for i in miss_pos]
+                    hit_n += len(hits)
+                    miss_n += len(new)
+                    for _, _, tr in hits:
+                        if tr:
+                            spec_links.add(tr)
+                if not new:
+                    if hits:
+                        resolvers.append((None, [], hits))
+                    flushed = len(keys)
+                    return
                 # items are their OWN dedup keys (VerifyItem NamedTuple)
                 resolve = self._resolve_provider(
                     len(new)).batch_verify_async(new)
@@ -594,7 +632,7 @@ class TxValidator:
                         raise holder["err"]
                     return holder["out"]
 
-                resolvers.append((result, new))
+                resolvers.append((result, new, hits))
                 flushed = len(keys)
 
         if use_fast:
@@ -618,14 +656,21 @@ class TxValidator:
         self._inflight_txids.append((num, seen_txids))
         collect_s = time.perf_counter() - t0
         self._econ.note_collect(t0, t0 + collect_s)
+        attrs = {"block": int(num), "txs": n, "unique_items": len(items)}
+        if hit_n or miss_n:
+            attrs["cache_hits"] = hit_n
+            attrs["cache_misses"] = miss_n
+        if spec_links:
+            # stitch the block trace to the speculative spans whose
+            # verdicts it consumed
+            attrs["links"] = sorted(spec_links)[:8]
         tracing.tracer.record_span(
-            "validator.collect", t0, t0 + collect_s,
-            attributes={"block": int(num), "txs": n,
-                        "unique_items": len(items)})
+            "validator.collect", t0, t0 + collect_s, attributes=attrs)
         return {"block": block, "flags": flags, "items": items,
                 "works": works, "resolvers": resolvers,
                 "msps": self._msps_snapshot, "seen_txids": seen_txids,
-                "collect_s": collect_s}
+                "collect_s": collect_s, "cache_hits": hit_n,
+                "cache_misses": miss_n}
 
     def _begin_deep(self, block: Block, num: int, carry: list) -> dict:
         """Deep native pass 1: the C walker consumes its own tuples
@@ -653,15 +698,37 @@ class TxValidator:
         index: Dict[VerifyItem, int] = {}   # item -> dispatch position
         plans: list = []
         pol_cache: dict = {}
-        resolvers: List[Tuple[object, int, int]] = []
+        # (result, verdict positions, dispatched items)
+        resolvers: List[Tuple] = []
         flushed = 0
         n_refs = 0
+        hit_n = miss_n = 0
+        hit_fills: list = []       # (verdict position, cached verdict)
+        spec_links: set = set()
+        cache = self.verify_cache
 
         def flush():
-            nonlocal flushed
+            nonlocal flushed, hit_n, miss_n
             keys = list(index.keys())
             new = keys[flushed:]
             if new:
+                # verify-once partition — same contract as the classic
+                # flush: only MAC-verified fresh hits skip the device
+                if cache is not None:
+                    miss_pos, raw_hits = cache.filter(new)
+                    positions = [flushed + i for i in miss_pos]
+                    for i, v, tr in raw_hits:
+                        hit_fills.append((flushed + i, v))
+                        if tr:
+                            spec_links.add(tr)
+                    new = [new[i] for i in miss_pos]
+                    hit_n += len(raw_hits)
+                    miss_n += len(new)
+                    if not new:
+                        flushed = len(keys)
+                        return
+                else:
+                    positions = list(range(flushed, flushed + len(new)))
                 resolve = self._resolve_provider(
                     len(new)).batch_verify_async(new)
                 # eager background resolution — same rationale as the
@@ -688,7 +755,7 @@ class TxValidator:
                         raise holder["err"]
                     return holder["out"]
 
-                resolvers.append((result, flushed, len(new)))
+                resolvers.append((result, positions, new))
                 flushed = len(keys)
 
         chunk = self.overlap_chunk
@@ -702,14 +769,20 @@ class TxValidator:
         self._inflight_txids.append((num, seen_txids))
         collect_s = time.perf_counter() - t0
         self._econ.note_collect(t0, t0 + collect_s)
+        attrs = {"block": int(num), "txs": n, "unique_items": len(index)}
+        if hit_n or miss_n:
+            attrs["cache_hits"] = hit_n
+            attrs["cache_misses"] = miss_n
+        if spec_links:
+            attrs["links"] = sorted(spec_links)[:8]
         tracing.tracer.record_span(
-            "validator.collect", t0, t0 + collect_s,
-            attributes={"block": int(num), "txs": n,
-                        "unique_items": len(index)})
+            "validator.collect", t0, t0 + collect_s, attributes=attrs)
         return {"deep": True, "block": block, "codes": codes,
                 "plans": plans, "items": index, "resolvers": resolvers,
                 "msps": self._msps_snapshot, "seen_txids": seen_txids,
-                "collect_s": collect_s, "n_refs": n_refs}
+                "collect_s": collect_s, "n_refs": n_refs,
+                "cache_hits": hit_n, "cache_misses": miss_n,
+                "hit_fills": hit_fills}
 
     # per-block stage SLIs + live overlap gauge (the SLO plane's inputs;
     # the "commit" stage lands next door in committer._observe_metrics)
@@ -735,6 +808,35 @@ class TxValidator:
         except Exception:
             pass
 
+    def _note_coverage(self, state: dict) -> None:
+        """Verify-once economics for one block: feed the rolling
+        coverage window and, on a node whose cache is speculatively
+        filled (the gateway host), publish speculative_coverage_frac —
+        the fraction of this window's unique verify items whose
+        verdicts were already cached when the block arrived."""
+        cache = self.verify_cache
+        if cache is None:
+            return
+        hits = state.get("cache_hits", 0)
+        total = hits + state.get("cache_misses", 0)
+        cache.coverage.note(hits, total)
+        if not cache.speculative_attached:
+            return
+        try:
+            from fabric_tpu.ops_plane import registry
+            registry.gauge(
+                "speculative_coverage_frac",
+                "fraction of committed unique verify items whose "
+                "verdicts were cached before the block arrived "
+                "(rolling block window)"
+            ).set(cache.coverage.frac(), channel=self.channel_id,
+                  # the registry is process-global: multi-node test
+                  # topologies share it, so each node's coverage must be
+                  # its own series or the last committer wins the sample
+                  owner=getattr(cache, "owner", "node"))
+        except Exception:
+            pass
+
     def _finish_deep(self, state: dict) -> ValidationResult:
         block = state["block"]
         codes = state["codes"]
@@ -743,9 +845,16 @@ class TxValidator:
 
         t0 = time.perf_counter()
         verdict = np.zeros(len(index), dtype=np.uint8)
-        for resolve, start, count in state["resolvers"]:
+        for pos, v in state.get("hit_fills", ()):
+            verdict[pos] = 1 if v else 0
+        cache = self.verify_cache
+        for resolve, positions, sub in state["resolvers"]:
             out = resolve()
-            verdict[start:start + count] = np.asarray(out, dtype=bool)
+            if cache is not None:
+                cache.store(sub, out, site="commit")
+            verdict[np.asarray(positions, dtype=np.intp)] = \
+                np.asarray(out, dtype=bool)
+        self._note_coverage(state)
         dispatch_s = time.perf_counter() - t0
         tracing.tracer.record_span(
             "validator.dispatch_wait", t0, t0 + dispatch_s,
@@ -785,10 +894,18 @@ class TxValidator:
         t0 = time.perf_counter()
         keys = list(items.keys())
         verdict: Dict[Tuple, bool] = {}
-        for resolve, chunk_keys in state["resolvers"]:
+        cache = self.verify_cache
+        for resolve, chunk_keys, hits in state["resolvers"]:
+            for k, v, _tr in hits:
+                verdict[k] = bool(v)
+            if resolve is None:
+                continue
             out = resolve()
+            if cache is not None:
+                cache.store(chunk_keys, out, site="commit")
             verdict.update(
                 (k, bool(v)) for k, v in zip(chunk_keys, out))
+        self._note_coverage(state)
         dispatch_s = time.perf_counter() - t0
         tracing.tracer.record_span(
             "validator.dispatch_wait", t0, t0 + dispatch_s,

@@ -313,13 +313,19 @@ class BatchBroadcaster:
     def broadcast_batch(
             self, envs: Sequence,
             deadline_s: Optional[float] = None,
-            tps: Optional[Sequence[str]] = None) -> List[Tuple[int, str]]:
+            tps: Optional[Sequence[str]] = None,
+            attests: Optional[Sequence[str]] = None
+    ) -> List[Tuple[int, str]]:
         """Send every envelope, retrying transient failures across the
         orderer set; returns one (status, info) per envelope in order.
 
         `tps` (optional, aligned with envs) carries each envelope's
         traceparent so the orderer can continue per-tx traces even
-        though the whole batch rides one RPC frame."""
+        though the whole batch rides one RPC frame.  `attests` (same
+        alignment) carries the verify-once plane's per-envelope verdict
+        attestations; both are re-aligned by pending index on every 503
+        retry so a partial requeue never shifts an attestation onto a
+        different envelope."""
         results: List[Optional[Tuple[int, str]]] = [None] * len(envs)
         pending = list(enumerate(envs))
         deadline = time.monotonic() + (deadline_s if deadline_s is not None
@@ -333,6 +339,9 @@ class BatchBroadcaster:
                 if tps and any(tps):
                     body["tps"] = [tps[i] if i < len(tps) else ""
                                    for i, _ in pending]
+                if attests and any(attests):
+                    body["attests"] = [attests[i] if i < len(attests)
+                                       else "" for i, _ in pending]
                 t0 = time.monotonic()
                 out = conn.call(
                     "broadcast_batch", body,

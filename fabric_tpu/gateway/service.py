@@ -362,9 +362,26 @@ class GatewayService:
             # is how orderer-side spans join the right per-tx trace
             tps = [tracing.format_traceparent(sp.context)
                    if sp.recording else "" for sp in spans_order]
+            # verify-once plane: stamp creator verdicts at ingress (one
+            # batched dispatch), queue endorsement sets for speculative
+            # verification while the orderer cuts the block, and send
+            # the verdict attestations alongside the envelopes so the
+            # orderer can skip its own device verify
+            attests = None
+            spec = getattr(self.node, "speculative", None)
+            if spec is not None:
+                try:
+                    attests = spec.stamp(
+                        [p.env for p in batch],
+                        [p.env.header().channel_header.channel_id
+                         for p in batch],
+                        spans=spans_order)
+                except Exception:
+                    logger.exception("verify-plane ingress stamp failed")
+                    attests = None
             try:
                 results = self.broadcaster.broadcast_batch(
-                    [p.env for p in batch], tps=tps)
+                    [p.env for p in batch], tps=tps, attests=attests)
             except Exception as exc:
                 logger.exception("broadcast batch failed")
                 jlog(logger, "gateway.broadcast_failed",

@@ -63,6 +63,21 @@ class OrdererNode:
         self.signer = load_signing_identity(
             cfg["mspid"], cfg["cert_pem"].encode(), cfg["key_pem"].encode())
 
+        # verify-once plane (on by default; `verify_once: {"enabled":
+        # false}` opts out): duplicate/retried submissions stop
+        # re-verifying, and — with trust_attestations — a gateway's
+        # ingress verdict rides in so the SigFilter's device verify is
+        # skipped for attested envelopes from authenticated peers
+        vcfg = dict(cfg.get("verify_once", {}))
+        self.verify_cache = None
+        self._trust_attestations = bool(vcfg.get("trust_attestations",
+                                                 True))
+        if vcfg.get("enabled", True):
+            from fabric_tpu.verify_plane import VerdictCache
+            self.verify_cache = VerdictCache(
+                capacity=int(vcfg.get("capacity", 65536)),
+                owner="orderer%s" % cfg.get("raft_id", ""))
+
         channel_cfg = ChannelConfig.deserialize(
             bytes.fromhex(cfg["channel_config_hex"]))
         self.bundle_source = BundleSource(Bundle(channel_cfg))
@@ -154,6 +169,13 @@ class OrdererNode:
             # chaos drills)
             from fabric_tpu.comm import faults as _faults
             _faults.register_routes(self.ops)
+            # GET /verify_plane: the verdict cache's live economics
+            if self.verify_cache is not None:
+                from fabric_tpu import verify_plane as _vp
+                _vp.register_ops(
+                    self.ops, self.verify_cache,
+                    extra=lambda: {
+                        "trust_attestations": self._trust_attestations})
             self.ops.register_route("GET", "/participation/v1/channels",
                                     self._rest_channels)
             # the ops server is PLAIN HTTP with no client auth, so the
@@ -271,6 +293,9 @@ class OrdererNode:
             chain_factory=lambda cutter, writer, on_block: RaftChain(
                 node, cutter, writer, on_block=on_block),
             bundle_source=bundle_source)
+        if self.verify_cache is not None:
+            support.processor.verify_cache = self.verify_cache
+            support.processor.trust_attestations = self._trust_attestations
         self.cluster.add_chain(cid, support.chain,
                                consenters=ch_consenters, peers=ch_peers)
         return support
@@ -336,7 +361,12 @@ class OrdererNode:
         """Gateway fan-in: many envelopes per RPC round trip.  Each is
         admitted independently; statuses/infos line up by index."""
         envs = [Envelope.deserialize(e) for e in body["envelopes"]]
-        resps = self.broadcast.handle_batch(envs, tps=body.get("tps"))
+        # verdict attestations are only honoured from a transport-
+        # authenticated caller — an anonymous frame must never vouch
+        # for a signature this orderer would otherwise verify
+        attests = body.get("attests") if peer_identity is not None else None
+        resps = self.broadcast.handle_batch(envs, tps=body.get("tps"),
+                                            attests=attests)
         leader = 0
         for r in resps:
             leader = getattr(r, "leader_hint", 0) or leader

@@ -274,7 +274,8 @@ class PeerChannel:
             self.channel_id, None, ch_provider, self.policies,
             bundle_source=self.bundle_source,
             sbe_lookup=statedb_lookup(self.ledger.statedb),
-            provider_source=provider_source)
+            provider_source=provider_source,
+            verify_cache=node.verify_cache)
         self.committer = Committer(self.ledger, self.validator,
                                    bundle_source=self.bundle_source,
                                    provider=ch_provider,
@@ -444,9 +445,13 @@ class PeerNode:
         self.cfg = cfg
         self.data_dir = data_dir
         self.channel_id = cfg.get("channel_id", "ch")
+        # `bccsp_degrade` unset -> None -> the factory's auto rule:
+        # degrade ON for JAXTPU (a peer that loses its accelerator keeps
+        # committing on SW, healthz flags it), OFF for SW.
+        # `bccsp_degrade: false` is the fail-stop escape hatch.
         self.provider = init_factories(
             FactoryOpts(default=cfg.get("bccsp", "SW"),
-                        degrade=bool(cfg.get("bccsp_degrade", False)),
+                        degrade=cfg.get("bccsp_degrade"),
                         use_mesh=bool(cfg.get("bccsp_mesh", False)),
                         placement=bool(cfg.get("bccsp_placement", False)),
                         mesh_devices=cfg.get("bccsp_mesh_devices"),
@@ -454,6 +459,21 @@ class PeerNode:
         self.signer = load_signing_identity(
             cfg["mspid"], cfg["cert_pem"].encode(), cfg["key_pem"].encode())
         self.mspid = cfg["mspid"]
+
+        # verify-once plane: ONE MAC'd verdict cache per peer process,
+        # shared by the gateway's ingress stamping, the speculative
+        # worker, and every channel's commit-time validator — so a
+        # signature verified at submit time is never re-dispatched at
+        # commit.  On by default; `verify_once: {"enabled": false}`
+        # restores the classic always-verify pipeline.
+        vcfg = dict(cfg.get("verify_once", {}))
+        self.verify_cache = None
+        self.speculative = None
+        if vcfg.get("enabled", True):
+            from fabric_tpu.verify_plane import VerdictCache
+            self.verify_cache = VerdictCache(
+                capacity=int(vcfg.get("capacity", 65536)),
+                owner=self.mspid)
 
         channel_cfg = ChannelConfig.deserialize(
             bytes.fromhex(cfg["channel_config_hex"]))
@@ -560,6 +580,14 @@ class PeerNode:
             from fabric_tpu.gateway import GatewayService
             self.gateway = GatewayService(self, cfg.get("gateway", {}))
             self.gateway.register(self.rpc)
+        # speculative verifier: stamps creator verdicts at ingress and
+        # verifies endorsement sets while the orderer cuts the block —
+        # only a gateway-hosting peer sees transactions pre-ordering
+        if self.gateway is not None and self.verify_cache is not None:
+            from fabric_tpu.verify_plane import SpeculativeVerifier
+            self.speculative = SpeculativeVerifier(
+                self.verify_cache, lambda: self.provider,
+                self._channel_msps)
 
         # tx tracing + flight recorder: on by default for nodes (the
         # import-time default stays off so libraries/bench pay nothing);
@@ -592,6 +620,12 @@ class PeerNode:
             # gateway shares the peer process and ops surface)
             if self.gateway is not None:
                 self.gateway.register_ops(self.ops)
+            # GET /verify_plane: verdict-cache economics + speculative
+            # worker state
+            if self.verify_cache is not None:
+                from fabric_tpu import verify_plane as _vp
+                _vp.register_ops(self.ops, self.verify_cache,
+                                 spec=self.speculative)
 
         # SLO plane: GET /slo + /slo/alerts, burn-rate alerting over the
         # metrics registry; config/env via the `slo` sub-dict
@@ -763,6 +797,15 @@ class PeerNode:
         return all(ch.deliver_healthy for ch in self.channels.values())
 
     # -- wiring helpers ------------------------------------------------------
+
+    def _channel_msps(self, channel_id: str):
+        """Live MSP set for the speculative verifier's item derivation —
+        resolved through the channel bundle at every use so MSP rotations
+        reach speculation the same instant they reach the gate."""
+        ch = self.channels.get(channel_id)
+        if ch is None:
+            return {}
+        return ch.bundle_source.current().msps
 
     def _make_contract(self, cc_cfg: dict):
         kind = cc_cfg.get("contract", "asset_demo")
@@ -946,6 +989,8 @@ class PeerNode:
         if self.ops is not None:
             self.ops.start()
         self._started = True
+        if self.speculative is not None:
+            self.speculative.start()
         if self.gateway is not None:
             self.gateway.start()
         for ch in self.channels.values():
@@ -958,6 +1003,8 @@ class PeerNode:
         self._stop.set()
         if self.gateway is not None:
             self.gateway.stop()
+        if self.speculative is not None:
+            self.speculative.stop()
         self.rpc.stop()
         if getattr(self, "cc_support", None) is not None:
             self.cc_support.stop()      # kills external chaincode processes

@@ -137,6 +137,14 @@ def collect_node(addr: str, timeout: float = 2.0) -> dict:
     row["queue_depth"] = _sum(metrics.get("provider_dispatch_queue_depth"))
     row["breakers_open"] = _sum(metrics.get("gateway_orderer_breaker_open"))
     row["faults_fired"] = _sum(metrics.get("fault_injected_total"))
+    # verify-once plane: cache hit rate over all lookups, and the
+    # rolling fraction of committed verify items whose verdicts were
+    # speculatively cached before the block arrived
+    vh = _sum(metrics.get("verify_cache_hits_total"))
+    vm = _sum(metrics.get("verify_cache_misses_total"))
+    row["vcache"] = vh / (vh + vm) if (vh + vm) else None
+    spec = [v for _, v in metrics.get("speculative_coverage_frac", ())]
+    row["spec"] = (sum(spec) / len(spec)) if spec else None
 
     try:
         doc = _get_json(addr, "/spans/stats", timeout)
@@ -209,8 +217,9 @@ def _fmt_devices(devs) -> str:
 
 
 _COLS = ("NODE", "HT", "TX/S", "COLLECT", "DISP", "GATE", "COMMIT",
-         "OCC", "DEV", "OVLP", "QD", "BRKR", "FAULTS", "SLO", "HEALTH")
-_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 4, 5, 7, 12, 8)
+         "OCC", "DEV", "OVLP", "VCACHE", "SPEC", "QD", "BRKR", "FAULTS",
+         "SLO", "HEALTH")
+_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 4, 5, 7, 12, 8)
 
 # --sort column -> row key; None values sort last, numeric descending
 # (the interesting rows — hottest, furthest ahead, most alerting — rise)
@@ -219,6 +228,7 @@ _SORT_KEYS = {
     "ovlp": "overlap", "qd": "queue_depth", "brkr": "breakers_open",
     "faults": "faults_fired", "slo": "slo_alerting", "height": "height",
     "rate": "rate", "occupancy": "occupancy", "dev": "devices",
+    "vcache": "vcache", "spec": "spec",
 }
 
 
@@ -268,6 +278,7 @@ def render(rows: List[dict]) -> str:
             _fmt_pair(r.get("gate")), _fmt_pair(r.get("commit")),
             _fmt_pct(r.get("occupancy")), _fmt_devices(r.get("devices")),
             _fmt_pct(r.get("overlap")),
+            _fmt_pct(r.get("vcache")), _fmt_pct(r.get("spec")),
             f"{r.get('queue_depth', 0):.0f}",
             f"{r.get('breakers_open', 0):.0f}",
             faults, slo, str(r.get("health", "?")))

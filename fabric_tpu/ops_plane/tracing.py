@@ -514,22 +514,36 @@ class Tracer:
     # -- export -------------------------------------------------------------
 
     def export_chrome(self, trace_id: str,
-                      follow_links: bool = True) -> Optional[dict]:
-        """Chrome trace-event JSON for one trace (+ one level of linked
-        traces), loadable in Perfetto / chrome://tracing."""
+                      follow_links: bool = True,
+                      max_traces: int = 16) -> Optional[dict]:
+        """Chrome trace-event JSON for one trace plus the transitive
+        closure of its linked traces (bounded by `max_traces`),
+        loadable in Perfetto / chrome://tracing.  Transitive: a client
+        request links its block trace, which links the speculative
+        verify traces that pre-verified its signatures — all of them
+        belong in one picture."""
         rec = self.recorder.get(trace_id)
         if rec is None:
             return None
         records = [rec]
         if follow_links:
             seen = {trace_id}
-            for span in rec["spans"]:
-                for linked in span["attributes"].get("links", ()):
-                    if linked not in seen:
-                        seen.add(linked)
-                        lrec = self.recorder.get(linked)
-                        if lrec is not None:
-                            records.append(lrec)
+            frontier = [rec]
+            while frontier and len(records) < max_traces:
+                nxt = []
+                for r in frontier:
+                    for span in r["spans"]:
+                        for linked in span["attributes"].get("links", ()):
+                            if linked in seen:
+                                continue
+                            seen.add(linked)
+                            lrec = self.recorder.get(linked)
+                            if lrec is not None:
+                                records.append(lrec)
+                                nxt.append(lrec)
+                            if len(records) >= max_traces:
+                                break
+                frontier = nxt
         events = []
         tids: Dict[str, int] = {}
         for r in records:

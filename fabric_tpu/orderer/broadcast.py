@@ -39,18 +39,20 @@ class BroadcastHandler:
     def __init__(self, registrar):
         self.registrar = registrar
 
-    def handle(self, env: Envelope) -> BroadcastResponse:
+    def handle(self, env: Envelope,
+               attest: Optional[str] = None) -> BroadcastResponse:
         resp = None
         with tracing.tracer.start_span("orderer.broadcast",
                                        require_parent=True) as span:
-            resp = self._handle_inner(env, span)
+            resp = self._handle_inner(env, span, attest)
             if span.recording:
                 span.set_attribute("status", resp.status)
                 if resp.status != STATUS_SUCCESS:
                     span.status = "ERROR"
         return resp
 
-    def _handle_inner(self, env: Envelope, span) -> BroadcastResponse:
+    def _handle_inner(self, env: Envelope, span,
+                      attest: Optional[str] = None) -> BroadcastResponse:
         try:
             channel_id = env.header().channel_header.channel_id
         except Exception:
@@ -63,7 +65,7 @@ class BroadcastHandler:
             return BroadcastResponse(STATUS_NOT_FOUND,
                                      f"unknown channel {channel_id!r}")
         try:
-            cls = support.processor.process(env)
+            cls = support.processor.process(env, attest=attest)
         except MsgProcessorError as e:
             return BroadcastResponse(STATUS_FORBIDDEN, str(e))
         try:
@@ -81,7 +83,9 @@ class BroadcastHandler:
 
     def handle_batch(
             self, envs: Sequence[Envelope],
-            tps: Optional[Sequence[str]] = None) -> List[BroadcastResponse]:
+            tps: Optional[Sequence[str]] = None,
+            attests: Optional[Sequence[str]] = None
+    ) -> List[BroadcastResponse]:
         """Ingest a coalesced batch in one call (the gateway's admission
         queue ships these).  Envelopes are independent — each routes by
         its own channel header and gets its own response, exactly as if
@@ -90,12 +94,16 @@ class BroadcastHandler:
 
         `tps`, when given, aligns a traceparent with each envelope: the
         gateway batches many client txs into one frame, so per-tx trace
-        context rides next to the envelopes instead of on the frame."""
+        context rides next to the envelopes instead of on the frame.
+        `attests` aligns the gateway's verdict attestations the same
+        way (verify-once plane; the caller decides whether the sender
+        was authenticated enough for these to be honoured)."""
         out = []
         for i, env in enumerate(envs):
             ctx = None
             if tps and i < len(tps) and tps[i]:
                 ctx = tracing.tracer.context_from(tps[i])
+            attest = attests[i] if attests and i < len(attests) else None
             with tracing.tracer.activate(ctx):
-                out.append(self.handle(env))
+                out.append(self.handle(env, attest=attest))
         return out

@@ -54,13 +54,24 @@ class StandardChannelProcessor:
     def __init__(self, channel_id: str, msps: Dict[str, object], provider,
                  writers_policy: SignaturePolicy,
                  absolute_max_bytes: int = 10 * 1024 * 1024,
-                 now=None, bundle_source=None):
+                 now=None, bundle_source=None, verify_cache=None,
+                 trust_attestations: bool = False):
         self.channel_id = channel_id
         self._static_msps = msps
         self._static_writers = writers_policy
         self._static_max_bytes = absolute_max_bytes
         self.provider = provider
         self.bundle_source = bundle_source
+        # verify-once plane: when a VerdictCache is attached, the sig
+        # filter's batch_verify consults/extends it (duplicate
+        # submissions and retried batches stop re-verifying), and — with
+        # trust_attestations — a gateway's verdict attestation seeds it
+        # so the orderer's device verify is skipped entirely.  The
+        # attestation is only honoured when the transport authenticated
+        # the submitting peer AND the attested digest matches the item
+        # this orderer derives itself from the envelope.
+        self.verify_cache = verify_cache
+        self.trust_attestations = bool(trust_attestations)
         self._now = now or (lambda: datetime.datetime.now(datetime.timezone.utc))
 
     # -- live config resolution (channelconfig bundle when attached) --------
@@ -90,9 +101,15 @@ class StandardChannelProcessor:
 
     @property
     def evaluator(self):
-        return PolicyEvaluator(self.msps, self.provider)
+        provider = self.provider
+        if self.verify_cache is not None:
+            from fabric_tpu.verify_plane import CachingProvider
+            provider = CachingProvider(provider, self.verify_cache,
+                                       site="orderer")
+        return PolicyEvaluator(self.msps, provider)
 
-    def process(self, env: Envelope, raw_size: Optional[int] = None) -> MsgClass:
+    def process(self, env: Envelope, raw_size: Optional[int] = None,
+                attest: Optional[str] = None) -> MsgClass:
         """Admit or raise. Returns the message class for routing.
 
         The envelope header is decoded ONCE here and threaded through the
@@ -118,6 +135,15 @@ class StandardChannelProcessor:
             raise MsgProcessorError(
                 f"message larger than AbsoluteMaxBytes "
                 f"({self.absolute_max_bytes})")
+        if self.verify_cache is not None:
+            if self.bundle_source is not None:
+                try:
+                    self.verify_cache.set_epoch(
+                        self.bundle_source.current().sequence)
+                except Exception:
+                    pass
+            if attest and self.trust_attestations:
+                self._accept_attestation(env, sh.creator, attest)
         self._sig_filter(env, sh.creator)
         if cls is MsgClass.CONFIG and self.bundle_source is not None:
             # config-plane validation BEFORE ordering (reference:
@@ -133,6 +159,32 @@ class StandardChannelProcessor:
         return cls
 
     # -- individual rules ---------------------------------------------------
+
+    def _accept_attestation(self, env: Envelope, creator: bytes,
+                            attest: str) -> None:
+        """Seed the verdict cache from a gateway's verdict attestation.
+
+        The gateway already ran this creator signature on its device and
+        sends the cache-key digest of the VerifyItem it verified.  This
+        orderer re-derives the item from the envelope it actually holds
+        — identity from ITS msps, payload/signature from the wire bytes
+        — and only accepts the attestation when the digests are
+        bit-identical, so a forged or mismatched attestation can never
+        vouch for different bytes than the ones being admitted.  Policy
+        evaluation, expiry, and config checks still run live below."""
+        try:
+            from fabric_tpu.verify_plane import item_digest
+            ident = deserialize_from_msps(self.msps, creator)
+            if ident is None:
+                return
+            item = ident.verify_item(env.payload, env.signature)
+            if item_digest(item).hex() != attest:
+                return
+            self.verify_cache.put(item, True)
+            from fabric_tpu.verify_plane.cache import _m
+            _m()["attested"].add(1)
+        except Exception:
+            pass
 
     def _expiration(self, creator: bytes) -> None:
         """expiration.go — reject envelopes signed with an expired cert."""

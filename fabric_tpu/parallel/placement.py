@@ -31,6 +31,7 @@ bookkeeping and returns a provider; device work stays inside it.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Optional
 
 from fabric_tpu.parallel import mesh as meshmod
@@ -40,7 +41,9 @@ class PlacementScheduler:
     def __init__(self, devices=None, provider_factory=None,
                  wrap: Optional[Callable] = None,
                  rebalance_ratio: float = 2.0,
-                 ewma_alpha: float = 0.3):
+                 ewma_alpha: float = 0.3,
+                 idle_halflife_s: float = 30.0,
+                 clock: Optional[Callable[[], float]] = None):
         """`provider_factory(mesh) -> Provider` builds the per-span
         provider (a single-device provider when the span is one chip);
         `wrap(provider) -> provider` optionally decorates each one once
@@ -59,8 +62,11 @@ class PlacementScheduler:
         self.wrap = wrap
         self.rebalance_ratio = float(rebalance_ratio)
         self.ewma_alpha = float(ewma_alpha)
+        self.idle_halflife_s = float(idle_halflife_s)
+        self._clock = clock if clock is not None else time.monotonic
         self._lock = threading.Lock()
         self._demand = {}          # channel -> EWMA of reported batch sizes
+        self._last_report = {}     # channel -> clock() of last demand report
         self._carve_demand = {}    # demand snapshot the current carve used
         self._assign = {}          # channel -> (lo, size)
         self._providers = {}       # (lo, size) -> wrapped provider
@@ -108,6 +114,27 @@ class PlacementScheduler:
         except Exception:
             pass
 
+    def _decay_idle(self, now: float) -> None:
+        """Halve a quiet channel's EWMA every `idle_halflife_s` it goes
+        without reporting demand.  Without this a channel that went
+        silent kept the demand of its last busy flush forever, pinning
+        its device span until some OTHER channel's registration forced a
+        recarve; with it, sustained silence drifts the demand past
+        `rebalance_ratio` and the next flush on any channel releases the
+        span back to the busy ones."""
+        hl = self.idle_halflife_s
+        if hl <= 0:
+            return
+        for ch, last in self._last_report.items():
+            steps = int((now - last) // hl)
+            if steps <= 0:
+                continue
+            d = self._demand.get(ch)
+            if d is not None and d > 1e-6:
+                self._demand[ch] = max(d * 0.5 ** steps, 1e-6)
+            # advance by whole half-lives so decay never compounds per call
+            self._last_report[ch] = last + steps * hl
+
     def _drifted(self) -> bool:
         for ch, d in self._demand.items():
             base = self._carve_demand.get(ch)
@@ -127,14 +154,18 @@ class PlacementScheduler:
         it feeds the EWMA that sizes the next carve.  Registration of a
         new channel always recarves; otherwise only ratio drift does."""
         with self._lock:
+            now = self._clock()
             a = self.ewma_alpha
             prev = self._demand.get(channel_id)
             if demand is not None and demand > 0:
                 self._demand[channel_id] = (
                     float(demand) if prev is None
                     else (1 - a) * prev + a * float(demand))
+                self._last_report[channel_id] = now
             elif prev is None:
                 self._demand[channel_id] = 1.0
+                self._last_report[channel_id] = now
+            self._decay_idle(now)
             new_channel = channel_id not in self._assign
             if new_channel or (self._drifted() and self._would_resize()):
                 self._recarve()

@@ -37,6 +37,9 @@ python tests/smoke_chaos.py
 echo "== telemetry + SLO probe (/metrics, /slo, /gateway, node.top) =="
 python tests/smoke_metrics.py
 
+echo "== verify-once probe (speculative coverage, zero cache rejects) =="
+python tests/smoke_verify_once.py
+
 echo "== native streamed-window probe (C tail/gate vs Python mirror) =="
 python tests/smoke_window.py
 

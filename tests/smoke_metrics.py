@@ -76,6 +76,14 @@ def main() -> int:
                             # device-labeled lane telemetry is live on a
                             # real node (eager CPU path: no compiles)
                             "bccsp": "JAXTPU",
+                            # keep this probe's load profile fixed: the
+                            # speculative verifier's extra dispatches
+                            # oversubscribe a 1-core host when every
+                            # verify is an eager CPU call (endorse
+                            # fan-out then times out).  The verify-once
+                            # plane has its own probe
+                            # (smoke_verify_once.py, SW peers).
+                            "verify_once": {"enabled": False},
                             "slo": {"sample_interval_s": 0.2,
                                     "short_window_s": 2.0,
                                     "long_window_s": 6.0}},

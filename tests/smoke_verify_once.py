@@ -1,0 +1,144 @@
+"""Smoke probe for the verify-once plane (called by smoke.sh).
+
+Boots the minimal 3-node ChaosNet (1 raft orderer, SW peers), pushes
+transactions through the gateway, then asserts on the LIVE topology:
+
+  - the gateway peer's speculative verifier actually overlapped
+    verification with ordering: `speculative_coverage_frac` > 0 on its
+    /metrics surface (commit-time gate degraded to cache lookups),
+  - zero `verify_cache_rejects_total` anywhere — on a clean run no MAC
+    or epoch rejection may fire (a reject here means the cache plane is
+    poisoning itself),
+  - /verify_plane serves the cache snapshot (owner, hit/miss economics,
+    speculative dispatch count),
+  - node.top renders the VCACHE / SPEC columns for the topology.
+
+The peers verify on the SW provider on purpose: the verify-once plane
+is provider-agnostic (the cache sits in front of whatever
+batch_verify the node carries), and the speculative worker's extra
+dispatches oversubscribe a 1-core CI host when every verify is an
+eager JAXTPU-on-CPU call — endorse fan-out RPCs then time out and the
+probe measures the host, not the plane.  Device-labeled telemetry is
+smoke_metrics.py's job.
+
+Named smoke_* (not test_*) on purpose: this is a script for the shell
+gate, not a pytest module.
+"""
+
+import json
+import sys
+import tempfile
+import urllib.request
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.config import BatchConfig
+from fabric_tpu.node import top
+from fabric_tpu.protocol.txflags import ValidationCode
+from fabric_tpu.testing import ChaosNet
+
+
+def _fail(msg) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _series_values(text, name):
+    """All sample values of a metric family from exposition text."""
+    vals = []
+    for ln in text.splitlines():
+        if ln.startswith(name) and not ln.startswith("#"):
+            head = ln.split(" ")[0]
+            if head == name or head.startswith(name + "{"):
+                vals.append(float(ln.rsplit(" ", 1)[1]))
+    return vals
+
+
+def main() -> int:
+    init_factories(FactoryOpts(default="SW"))
+    with tempfile.TemporaryDirectory() as base:
+        net = ChaosNet(
+            base, n_orderers=1, peer_orgs=["Org1", "Org2"],
+            peers_per_org=1,
+            batch=BatchConfig(max_message_count=4, timeout_s=0.05),
+            gateway_cfg={"linger_s": 0.002, "max_batch": 8,
+                         "broadcast_deadline_s": 30.0,
+                         "rpc_timeout_s": 30.0},
+            peer_overrides={"ops_port": 0, "bccsp": "SW"},
+            orderer_overrides={"ops_port": 0})
+        net.start()
+        try:
+            gw = net.client("Org1", timeout=60.0, call_timeout=180.0)
+            try:
+                for i in range(8):
+                    code, _ = gw.submit_transaction(
+                        "assets", "create", [b"vo%d" % i, b"v"],
+                        commit_timeout_s=60.0)
+                    if code != int(ValidationCode.VALID):
+                        return _fail(f"tx {i} code {code}")
+            finally:
+                gw.close()
+
+            def get(addr, path, raw=False):
+                with urllib.request.urlopen(
+                        "http://%s:%d%s" % (addr[0], addr[1], path),
+                        timeout=5) as r:
+                    body = r.read().decode()
+                    return body if raw else json.loads(body)
+
+            # the Org1 peer hosts the gateway the client used: its
+            # speculative verifier must have pre-verified the in-flight
+            # txs, so commit-time coverage is live and positive
+            gw_peer = net.peers()[0]
+            text = get(gw_peer.ops.addr, "/metrics", raw=True)
+            cov = _series_values(text, "speculative_coverage_frac")
+            if not cov or max(cov) <= 0.0:
+                return _fail(f"speculative_coverage_frac not live/positive:"
+                             f" {cov!r}")
+            hits = _series_values(text, "verify_cache_hits_total")
+            if not hits or sum(hits) <= 0:
+                return _fail(f"no verify-cache hits on the gateway peer: "
+                             f"{hits!r}")
+
+            # zero rejects anywhere: a clean run must never trip the
+            # MAC / staleness gates
+            for node in net.peers() + net.orderers():
+                t = get(node.ops.addr, "/metrics", raw=True)
+                rej = sum(_series_values(t, "verify_cache_rejects_total"))
+                if rej:
+                    return _fail(f"cache rejects on a clean run "
+                                 f"({node.ops.addr}): {rej}")
+
+            # the ops route serves the cache economics
+            vp = get(gw_peer.ops.addr, "/verify_plane")
+            for k in ("owner", "size", "capacity", "epoch", "hits_total",
+                      "misses_total", "rejects_total", "coverage_frac",
+                      "speculative", "speculative_dispatched"):
+                if k not in vp:
+                    return _fail(f"/verify_plane missing {k}: {vp}")
+            if not vp["speculative"]:
+                return _fail(f"gateway peer lacks speculative verifier: "
+                             f"{vp}")
+
+            # node.top surfaces the plane for the whole topology
+            targets = ["%s:%d" % n.ops.addr[:2]
+                       for n in net.peers() + net.orderers()]
+            rows = [top.collect_node(t) for t in targets]
+            frame = top.render(rows)
+            for col in ("VCACHE", "SPEC"):
+                if col not in frame:
+                    return _fail(f"top frame missing {col}:\n{frame}")
+            gw_row = rows[0]
+            if gw_row.get("spec") is None or gw_row["spec"] <= 0.0:
+                return _fail(f"top SPEC not positive on gateway peer: "
+                             f"{gw_row}")
+
+            print(f"OK: 8 txs VALID; coverage={max(cov):.2f} "
+                  f"hits={int(sum(hits))} rejects=0; /verify_plane live; "
+                  f"top shows VCACHE/SPEC")
+            return 0
+        finally:
+            net.stop_all()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -97,5 +97,30 @@ def test_factory_gate(sw):
         init_factories(FactoryOpts(default="HSM"))
 
 
+def test_factory_degrade_defaults_on_under_jaxtpu():
+    from fabric_tpu.bccsp.degrade import DegradingProvider
+
+    # auto (degrade=None): the TPU provider gets the breaker + SW
+    # fallback by default — losing the accelerator must not stop commits
+    p = init_factories(FactoryOpts(default="JAXTPU"))
+    assert isinstance(p, DegradingProvider)
+    assert p.backend == "jaxtpu"            # healthy: primary fronts
+
+    # auto: SW needs no fallback-to-SW wrapper
+    p = init_factories(FactoryOpts(default="SW"))
+    assert not isinstance(p, DegradingProvider)
+
+    # the escape hatch: explicit False means fail-stop
+    p = init_factories(FactoryOpts(default="JAXTPU", degrade=False))
+    assert not isinstance(p, DegradingProvider)
+
+
+def test_degrading_provider_delegates_primary_attributes():
+    from fabric_tpu.bccsp.degrade import DegradingProvider
+    primary = JaxTpuProvider()
+    deg = DegradingProvider(primary, SoftwareProvider())
+    assert deg.stats is primary.stats       # bench reads provider.stats
+
+
 def test_empty_batch(tpu):
     assert tpu.batch_verify([]).shape == (0,)

@@ -171,6 +171,52 @@ def test_scheduler_spans_disjoint_after_rebalance():
     assert lo <= 8
 
 
+def test_scheduler_idle_channel_decays_and_releases_span():
+    clock = [0.0]
+    ps = _scheduler(idle_halflife_s=10.0, clock=lambda: clock[0])
+    for _ in range(20):
+        ps.provider_for("a", demand=100)
+        ps.provider_for("b", demand=100)
+        ps.provider_for("quiet", demand=3000)
+    assert ps.snapshot()["channels"]["quiet"]["devices"] == 4
+    # "quiet" goes silent; a and b keep flushing.  After enough
+    # half-lives its EWMA decays past the rebalance ratio and a busy
+    # flush recarves WITHOUT any new channel registering, handing the
+    # wide span to a busy channel.
+    for _ in range(10):
+        clock[0] += 10.0
+        ps.provider_for("a", demand=100)
+        ps.provider_for("b", demand=100)
+    snap = ps.snapshot()
+    assert snap["channels"]["quiet"]["demand_ewma"] < 100.0
+    assert snap["channels"]["quiet"]["devices"] == 2
+    assert snap["channels"]["a"]["devices"] == 4
+
+
+def test_scheduler_decay_is_idempotent_within_a_halflife():
+    clock = [0.0]
+    ps = _scheduler(idle_halflife_s=10.0, clock=lambda: clock[0])
+    ps.provider_for("a", demand=100)
+    ps.provider_for("b", demand=100)
+    clock[0] += 15.0
+    # many calls inside one elapsed window must decay "b" exactly once
+    for _ in range(50):
+        ps.provider_for("a", demand=100)
+    assert ps.snapshot()["channels"]["b"]["demand_ewma"] == \
+        pytest.approx(50.0)
+
+
+def test_scheduler_decay_disabled_with_nonpositive_halflife():
+    clock = [0.0]
+    ps = _scheduler(idle_halflife_s=0.0, clock=lambda: clock[0])
+    ps.provider_for("a", demand=100)
+    ps.provider_for("b", demand=100)
+    clock[0] += 1e6
+    ps.provider_for("a", demand=100)
+    assert ps.snapshot()["channels"]["b"]["demand_ewma"] == \
+        pytest.approx(100.0)
+
+
 def test_scheduler_wrap_applied_once_per_span():
     wrapped = []
 

@@ -1,0 +1,568 @@
+"""Verify-once plane: signed verdict cache + speculative verification.
+
+Safety gates (the ISSUE's hard requirements):
+  - a poisoned or stale cache entry can NEVER turn into a skipped
+    verification (MAC tamper / sig substitution / revoked identity /
+    eviction all force full re-verification);
+  - cache-on and cache-off validation produce bit-identical TxFlags
+    over adversarial corpora, on every collect path (deep C tail,
+    classic C walker, pure Python);
+  - verify-count telemetry shows at most ONE device verification per
+    unique (identity, signature) pair per node.
+"""
+import random
+
+import numpy as np
+import pytest
+
+from fabric_tpu.bccsp.factory import init_factories, FactoryOpts
+from fabric_tpu.committer import Committer, PolicyRegistry, TxValidator
+from fabric_tpu.ledger import KVLedger, LedgerConfig
+from fabric_tpu.msp import CachedMSP
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.policy import parse_policy
+from fabric_tpu.protocol import (Envelope, KVRead, KVWrite, NsRwSet,
+                                 ValidationCode, TxRwSet, Version, build)
+from fabric_tpu.protocol.types import Block, BlockHeader, BlockMetadata
+from fabric_tpu.verify_plane import (CachingProvider, SpeculativeVerifier,
+                                     VerdictCache, derive_items, item_digest)
+from fabric_tpu.verify_plane.cache import _m
+
+
+@pytest.fixture(scope="module", autouse=True)
+def sw_provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture()
+def orgs():
+    return DevOrg("Org1"), DevOrg("Org2")
+
+
+def _msps(*orgs):
+    return {o.mspid: CachedMSP(o.msp()) for o in orgs}
+
+
+def rw(reads=(), writes=(), ns="cc"):
+    return TxRwSet((NsRwSet(ns, reads=tuple(reads), writes=tuple(writes)),))
+
+
+def make_tx(org1, org2, rwset=None, endorsers=None, creator=None,
+            nonce=None):
+    endorsers = endorsers or [org1.new_identity("e1"),
+                              org2.new_identity("e2")]
+    return build.endorser_tx(
+        "ch", "cc", "1.0", rwset or rw(writes=[KVWrite("k", b"v")]),
+        creator or org1.new_identity("client"), endorsers, nonce=nonce)
+
+
+def make_block(envs, number=0):
+    data = [e if isinstance(e, (bytes, bytearray)) else e.serialize()
+            for e in envs]
+    return Block(BlockHeader(number, b"p", b"d"), data, BlockMetadata())
+
+
+def creator_item(env, msps):
+    creators, _ = derive_items(env.serialize(), "ch", msps)
+    assert len(creators) == 1
+    return creators[0]
+
+
+def counts():
+    m = _m()
+    return {"hits": m["hits"].total(), "misses": m["misses"].total(),
+            "rejects": m["rejects"].total(),
+            "mac": m["rejects"].value(reason="mac"),
+            "stale": m["rejects"].value(reason="stale"),
+            "evictions": m["evictions"].total(),
+            "device": m["device"].total(), "dupes": m["dupes"].total(),
+            "attested": m["attested"].total()}
+
+
+def delta(before, after):
+    return {k: after[k] - before[k] for k in before}
+
+
+class CountingProvider:
+    """Delegating provider that records every device dispatch."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.batches = []
+        self.name = inner.name
+
+    def batch_verify(self, items):
+        items = list(items)
+        self.batches.append(items)
+        return self.inner.batch_verify(items)
+
+    def batch_verify_async(self, items):
+        items = list(items)
+        self.batches.append(items)
+        resolve = self.inner.batch_verify_async(items)
+        return resolve
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+    @property
+    def dispatched(self):
+        return sum(len(b) for b in self.batches)
+
+
+# -- cache semantics ---------------------------------------------------------
+
+
+def test_cache_roundtrip_and_sign_of_verdict(orgs, sw_provider):
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    cache = VerdictCache(capacity=16)
+    it = creator_item(make_tx(org1, org2), msps)
+    assert cache.get(it) is None                    # cold miss
+    cache.put(it, True)
+    assert cache.get(it) is True
+    cache.put(it, False)                            # overwrite
+    assert cache.get(it) is False
+    assert len(cache) == 1
+
+
+def test_mac_tamper_never_silently_accepted(orgs, sw_provider):
+    """THE hard gate: flipping a cached verdict bit (the stored MAC no
+    longer matches) must read as a miss — the poisoned verdict can
+    never be served — and the entry is dropped so the next fill
+    re-verifies on the device."""
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    cache = VerdictCache(capacity=16)
+
+    # a tx whose creator signature is BROKEN: honest verdict is False
+    env = make_tx(org1, org2)
+    env = Envelope(env.payload, env.signature[:-2] + b"\x00\x01")
+    it = creator_item(env, msps)
+    cache.put(it, False)
+
+    # attacker flips the verdict bit in place; without the per-node
+    # secret they cannot recompute the MAC
+    d = item_digest(it)
+    mac, verdict, epoch, trace = cache._data[d]
+    cache._data[d] = (mac, True, epoch, trace)
+
+    before = counts()
+    assert cache.get(it) is None                    # NOT True — rejected
+    assert d not in cache._data                     # hard-dropped
+    moved = delta(before, counts())
+    assert moved["mac"] == 1 and moved["hits"] == 0
+
+    # end to end: the commit gate re-verifies and still flags the tx
+    validator = TxValidator("ch", msps, sw_provider,
+                            _policies(), verify_cache=cache)
+    res = validator.validate(make_block([env]))
+    assert res.flags.codes() == [int(ValidationCode.BAD_CREATOR_SIGNATURE)]
+
+
+def test_entry_from_another_node_rejected(orgs, sw_provider):
+    """Entries MAC'd under a different node's secret (a copied/injected
+    cache state) fail verification here."""
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    theirs, ours = VerdictCache(capacity=4), VerdictCache(capacity=4)
+    it = creator_item(make_tx(org1, org2), msps)
+    theirs.put(it, True)
+    d = item_digest(it)
+    ours._data[d] = theirs._data[d]
+    assert ours.get(it) is None
+    assert d not in ours._data
+
+
+def test_sig_substitution_changes_cache_key(orgs, sw_provider):
+    """A signature swapped after a verdict was cached produces a
+    different cache key: the stale verdict is unreachable, the new
+    signature gets its own device verification."""
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    cache = VerdictCache(capacity=16)
+    env = make_tx(org1, org2)
+    cache.put(creator_item(env, msps), True)
+
+    swapped = Envelope(env.payload, env.signature[:-2] + b"\x00\x01")
+    it2 = creator_item(swapped, msps)
+    assert cache.get(it2) is None
+
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    validator = TxValidator("ch", msps, inner, _policies(),
+                            verify_cache=cache)
+    res = validator.validate(make_block([swapped]))
+    assert res.flags.codes() == [int(ValidationCode.BAD_CREATOR_SIGNATURE)]
+    assert inner.dispatched > 0                     # really re-verified
+
+
+def test_epoch_bump_invalidates_cached_verdicts(orgs, sw_provider):
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    cache = VerdictCache(capacity=16)
+    it = creator_item(make_tx(org1, org2), msps)
+    cache.put(it, True)
+    cache.set_epoch(1)                   # config update: CRL / CA rotation
+    before = counts()
+    assert cache.get(it) is None
+    assert delta(before, counts())["stale"] == 1
+    assert len(cache) == 0
+    cache.put(it, True)                  # re-verified under the new epoch
+    assert cache.get(it) is True
+
+
+def test_lru_bound_and_eviction_counter(orgs, sw_provider):
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    cache = VerdictCache(capacity=4)
+    items = [creator_item(make_tx(org1, org2), msps) for _ in range(7)]
+    before = counts()
+    for it in items:
+        cache.put(it, True)
+    assert len(cache) == 4
+    assert delta(before, counts())["evictions"] == 3
+    assert cache.get(items[0]) is None              # evicted: plain miss
+    assert cache.get(items[-1]) is True
+
+
+def test_peek_skips_counters_and_lru(orgs, sw_provider):
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    cache = VerdictCache(capacity=16)
+    it = creator_item(make_tx(org1, org2), msps)
+    cache.put(it, True)
+    before = counts()
+    assert cache.peek(it) is True
+    assert cache.peek(creator_item(make_tx(org1, org2), msps)) is None
+    assert delta(before, counts()) == {k: 0 for k in before}
+
+
+# -- caching provider --------------------------------------------------------
+
+
+def test_caching_provider_dispatches_each_item_once(orgs, sw_provider):
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    envs = [make_tx(org1, org2) for _ in range(4)]
+    items = [creator_item(e, msps) for e in envs]
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    p = CachingProvider(inner, VerdictCache(capacity=16), site="orderer")
+
+    out1 = p.batch_verify(items)
+    assert out1.all() and inner.dispatched == 4
+    out2 = p.batch_verify(items)                    # all cached
+    np.testing.assert_array_equal(out1, out2)
+    assert inner.dispatched == 4                    # no new device work
+    # partial overlap: only the new item hits the device
+    extra = creator_item(make_tx(org1, org2), msps)
+    out3 = p.batch_verify(items[:2] + [extra])
+    assert out3.all() and inner.dispatched == 5
+
+
+def test_caching_provider_async_all_hit_path(orgs, sw_provider):
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    items = [creator_item(make_tx(org1, org2), msps) for _ in range(3)]
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    p = CachingProvider(inner, VerdictCache(capacity=16), site="commit")
+    assert p.batch_verify_async(items)().all()
+    resolve = p.batch_verify_async(items)
+    assert inner.dispatched == 3
+    assert resolve().all()
+
+
+# -- differential fuzz: cache-on == cache-off --------------------------------
+
+
+def _policies():
+    p = PolicyRegistry()
+    p.set_policy("cc", parse_policy("AND('Org1.member', 'Org2.member')"))
+    return p
+
+
+def _adversarial_corpus(org1, org2, rng, n=24):
+    """Serialized envelopes mixing valid txs, broken creator sigs,
+    broken endorsements, intra-corpus duplicates, truncations and junk
+    — every class the verify plane could get wrong."""
+    raws = []
+    for i in range(n):
+        kind = rng.randrange(8)
+        if kind == 0 and raws:
+            raws.append(rng.choice(raws))           # duplicate txid
+            continue
+        env = make_tx(org1, org2,
+                      rw(reads=[KVRead("r", Version(0, 1))],
+                         writes=[KVWrite(f"k{rng.random()}", b"v")]))
+        raw = env.serialize()
+        if kind == 1:
+            raw = Envelope(env.payload,
+                           env.signature[:-2] + b"\x00\x01").serialize()
+        elif kind == 2:                             # Org1-only endorsement
+            raw = make_tx(org1, org2,
+                          endorsers=[org1.new_identity("e")]).serialize()
+        elif kind == 3 and len(raw) > 8:
+            raw = raw[:rng.randrange(4, len(raw))]  # truncated
+        elif kind == 4:
+            raw = rng.randbytes(rng.randrange(0, 40))   # junk
+        raws.append(raw)
+    return raws
+
+
+def _run_blocks(validator, blocks):
+    flags = []
+    for i, raws in enumerate(blocks):
+        res = validator.validate(make_block(raws, number=i))
+        flags.append(res.flags.codes())
+    return flags
+
+
+def _mode(validator, mode):
+    from fabric_tpu.committer import txvalidator as tv
+    if mode == "python":
+        validator.force_python_collect = True
+    return validator
+
+
+@pytest.mark.parametrize("mode", ["native", "python"])
+def test_differential_fuzz_cache_on_equals_cache_off(orgs, sw_provider,
+                                                     mode):
+    """Same corpora, same blocks, three runs: cache-off, cache-on, and
+    cache-on with a 3-entry cache (evictions mid-block).  All three
+    must produce bit-identical TxFlags, on the native and pure-Python
+    collect paths."""
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    for seed in (7, 19, 40):
+        rng = random.Random(seed)
+        blocks = [_adversarial_corpus(org1, org2, rng) for _ in range(3)]
+        # the same envelope appears in two different blocks too
+        blocks[2] = blocks[2] + [blocks[0][0]]
+
+        def run(cache):
+            v = _mode(TxValidator("ch", msps, sw_provider, _policies(),
+                                  verify_cache=cache), mode)
+            return _run_blocks(v, blocks)
+
+        off = run(None)
+        on = run(VerdictCache(capacity=4096))
+        tiny = run(VerdictCache(capacity=3))
+        assert off == on == tiny, f"verdict fork at seed {seed} ({mode})"
+
+
+def test_cached_verdict_cannot_vouch_for_revoked_identity(orgs,
+                                                          sw_provider):
+    """Identity validity is judged live at the gate: a True signature
+    verdict cached while an org was trusted must not keep its txs valid
+    after the org is dropped (CRL / config revocation between ingress
+    and commit)."""
+    org1, org2 = orgs
+    both = _msps(org1, org2)
+    env = make_tx(org1, org2)
+    cache = VerdictCache(capacity=64)
+
+    v1 = TxValidator("ch", both, sw_provider, _policies(),
+                     verify_cache=cache)
+    assert v1.validate(make_block([env])).flags.codes() == [
+        int(ValidationCode.VALID)]
+
+    # org2 revoked; same shared cache, fresh validator state
+    only1 = _msps(org1)
+    for with_cache in (cache, None):
+        v2 = TxValidator("ch", only1, sw_provider, _policies(),
+                         verify_cache=with_cache)
+        assert v2.validate(make_block([env])).flags.codes() == [
+            int(ValidationCode.ENDORSEMENT_POLICY_FAILURE)]
+
+
+def test_verify_once_telemetry_one_device_verify_per_item(orgs,
+                                                          sw_provider):
+    """≤ 1 device verification per unique (identity, signature) pair:
+    re-validating the same envelopes dispatches nothing new, and the
+    duplicate-device-verification counter stays flat."""
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    envs = [make_tx(org1, org2) for _ in range(6)]
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    validator = TxValidator("ch", msps, inner, _policies(),
+                            verify_cache=VerdictCache(capacity=4096))
+    before = counts()
+    validator.validate(make_block(envs, number=0))
+    first = inner.dispatched
+    assert first > 0
+    validator.validate(make_block(envs, number=1))
+    assert inner.dispatched == first                # zero new device work
+    assert delta(before, counts())["dupes"] == 0
+
+
+# -- speculative verification ------------------------------------------------
+
+
+def test_derive_items_match_commit_time_keys(orgs, sw_provider):
+    """The speculative path's item derivation must be bit-identical to
+    the committer's — otherwise cache keys never match at commit.
+    Proven transitively: stamping an envelope at ingress makes the
+    commit-time validation of that envelope fully cache-served."""
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    envs = [make_tx(org1, org2) for _ in range(5)]
+    cache = VerdictCache(capacity=4096)
+    spec = SpeculativeVerifier(cache, lambda: sw_provider,
+                               lambda cid: msps)
+    attests = spec.stamp(envs, ["ch"] * len(envs))
+    assert all(a for a in attests)                  # creator verdicts in
+    # drain the endorsement queue synchronously (worker not started)
+    while spec._queue:
+        spec._verify_batch(spec._queue.popleft(), stage="overlap")
+
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    validator = TxValidator("ch", msps, inner, _policies(),
+                            verify_cache=cache)
+    res = validator.validate(make_block(envs))
+    assert res.flags.codes() == [int(ValidationCode.VALID)] * 5
+    assert inner.dispatched == 0        # commit degraded to cache lookups
+    assert cache.coverage.frac() == 1.0
+
+
+def test_speculative_worker_fills_cache_in_background(orgs, sw_provider):
+    import time
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    envs = [make_tx(org1, org2) for _ in range(3)]
+    cache = VerdictCache(capacity=4096)
+    spec = SpeculativeVerifier(cache, lambda: sw_provider,
+                               lambda cid: msps).start()
+    try:
+        spec.stamp(envs, ["ch"] * 3)
+        deadline = time.time() + 5.0
+        want = 3 * 3                    # creator + 2 endorsements each
+        while len(cache) < want and time.time() < deadline:
+            time.sleep(0.02)
+        assert len(cache) == want
+        assert spec.dispatched >= 6     # endorsements went via the worker
+    finally:
+        spec.stop()
+
+
+def test_structurally_invalid_envelope_stamps_nothing(orgs, sw_provider):
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    cache = VerdictCache(capacity=64)
+    spec = SpeculativeVerifier(cache, lambda: sw_provider,
+                               lambda cid: msps)
+
+    class FakeEnv:
+        def serialize(self):
+            return b"\xde\xad"
+
+    attests = spec.stamp([FakeEnv()], ["ch"])
+    assert attests == [""] and len(cache) == 0
+
+
+# -- orderer attestation trust ----------------------------------------------
+
+
+def _processor(org, provider, cache, trust):
+    from fabric_tpu.orderer.msgprocessor import StandardChannelProcessor
+    return StandardChannelProcessor(
+        "ch", {"Org1": CachedMSP(org.msp())}, provider,
+        parse_policy("OR('Org1.member')"),
+        verify_cache=cache, trust_attestations=trust)
+
+
+def _order_env(org):
+    rwset = TxRwSet((NsRwSet("cc", writes=(KVWrite("k", b"v"),)),))
+    return build.endorser_tx("ch", "cc", "1.0", rwset,
+                             org.new_identity("client"),
+                             [org.new_identity("e")])
+
+
+def test_attestation_skips_orderer_device_verify(sw_provider):
+    org = DevOrg("Org1")
+    env = _order_env(org)
+    msps = {"Org1": CachedMSP(org.msp())}
+    it = creator_item(env, msps)
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True)
+    before = counts()
+    proc.process(env, attest=item_digest(it).hex())
+    assert inner.dispatched == 0        # admission served from the cache
+    assert delta(before, counts())["attested"] == 1
+
+
+def test_forged_attestation_is_ignored(sw_provider):
+    """An attestation whose digest does not match the item the orderer
+    derives ITSELF from the wire bytes seeds nothing — the device
+    verify runs as if no attestation came."""
+    org = DevOrg("Org1")
+    env = _order_env(org)
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True)
+    before = counts()
+    proc.process(env, attest="ab" * 32)
+    assert inner.dispatched == 1
+    assert delta(before, counts())["attested"] == 0
+
+
+def test_attestation_cannot_vouch_for_tampered_envelope(sw_provider):
+    """Replaying a VALID attestation digest next to an envelope with a
+    swapped signature: the orderer derives the item from the bytes it
+    holds, digests differ, the tampered envelope is fully verified and
+    rejected."""
+    from fabric_tpu.orderer.msgprocessor import MsgProcessorError
+    org = DevOrg("Org1")
+    env = _order_env(org)
+    msps = {"Org1": CachedMSP(org.msp())}
+    good_digest = item_digest(creator_item(env, msps)).hex()
+    tampered = Envelope(env.payload, env.signature[:-2] + b"\x00\x01")
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True)
+    with pytest.raises(MsgProcessorError):
+        proc.process(tampered, attest=good_digest)
+    assert inner.dispatched == 1
+
+
+def test_attestation_ignored_when_trust_disabled(sw_provider):
+    org = DevOrg("Org1")
+    env = _order_env(org)
+    msps = {"Org1": CachedMSP(org.msp())}
+    it = creator_item(env, msps)
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=False)
+    proc.process(env, attest=item_digest(it).hex())
+    assert inner.dispatched == 1
+
+
+def test_orderer_resubmission_served_from_cache(sw_provider):
+    """Even without attestations, a client retry (same envelope twice
+    through broadcast) verifies on the device exactly once."""
+    org = DevOrg("Org1")
+    env = _order_env(org)
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=False)
+    proc.process(env)
+    proc.process(env)
+    assert inner.dispatched == 1
+
+
+# -- ops surface -------------------------------------------------------------
+
+
+def test_verify_plane_ops_route(orgs, sw_provider):
+    from fabric_tpu import verify_plane
+
+    routes = {}
+
+    class FakeOps:
+        def register_route(self, method, path, fn):
+            routes[(method, path)] = fn
+
+    cache = VerdictCache(capacity=8, owner="Org1")
+    spec = SpeculativeVerifier(cache, lambda: sw_provider, lambda cid: {})
+    verify_plane.register_ops(FakeOps(), cache, spec=spec,
+                              extra=lambda: {"trust_attestations": True})
+    code, out = routes[("GET", "/verify_plane")]("/verify_plane", None)
+    assert code == 200
+    assert out["owner"] == "Org1" and out["capacity"] == 8
+    assert out["speculative"] is True
+    assert out["trust_attestations"] is True
+    assert out["speculative_dispatched"] == 0
