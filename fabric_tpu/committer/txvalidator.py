@@ -508,12 +508,15 @@ class TxValidator:
         self._msps_snapshot = (self.bundle_source.current().msps
                                if self.bundle_source is not None else None)
         if self.verify_cache is not None and self.bundle_source is not None:
-            # pin the cache epoch to the config sequence: a config update
-            # (new CRL, rotated CA, policy change) invalidates every
-            # verdict minted under the previous sequence
+            # pin THIS channel's cache epoch to its config sequence: a
+            # config update (new CRL, rotated CA, policy change)
+            # invalidates every verdict minted under the previous
+            # sequence of this channel — the cache is shared per node,
+            # so other channels' entries must not flap with ours
             try:
                 self.verify_cache.set_epoch(
-                    self.bundle_source.current().sequence)
+                    self.bundle_source.current().sequence,
+                    scope=self.channel_id)
             except Exception:
                 pass
         try:
@@ -851,7 +854,8 @@ class TxValidator:
         for resolve, positions, sub in state["resolvers"]:
             out = resolve()
             if cache is not None:
-                cache.store(sub, out, site="commit")
+                cache.store(sub, out, site="commit",
+                            scope=self.channel_id)
             verdict[np.asarray(positions, dtype=np.intp)] = \
                 np.asarray(out, dtype=bool)
         self._note_coverage(state)
@@ -902,7 +906,8 @@ class TxValidator:
                 continue
             out = resolve()
             if cache is not None:
-                cache.store(chunk_keys, out, site="commit")
+                cache.store(chunk_keys, out, site="commit",
+                            scope=self.channel_id)
             verdict.update(
                 (k, bool(v)) for k, v in zip(chunk_keys, out))
         self._note_coverage(state)

@@ -54,6 +54,15 @@ def load_signing_identity(mspid: str, cert_pem: bytes, key_pem: bytes,
     return SigningIdentity(mspid, cert, SigningKey(scheme, key))
 
 
+def attestation_trust(vcfg: dict):
+    """(trust_attestations, attestors) from a `verify_once` config
+    sub-dict.  Trusting gateway verdict attestations is a security
+    decision, so it is OFF unless explicitly enabled — and useless
+    without an attestor allowlist naming who may vouch."""
+    return (bool(vcfg.get("trust_attestations", False)),
+            list(vcfg.get("attestors", [])))
+
+
 class OrdererNode:
     """One orderer process (library form; `main` wraps it)."""
 
@@ -65,13 +74,16 @@ class OrdererNode:
 
         # verify-once plane (on by default; `verify_once: {"enabled":
         # false}` opts out): duplicate/retried submissions stop
-        # re-verifying, and — with trust_attestations — a gateway's
-        # ingress verdict rides in so the SigFilter's device verify is
-        # skipped for attested envelopes from authenticated peers
+        # re-verifying.  Attestation trust is a SECURITY decision and
+        # is OFF by default: enabling it requires BOTH
+        # `trust_attestations: true` AND an explicit `attestors` list
+        # of {"mspid", "cert_fp"} bindings naming the gateway
+        # identities allowed to vouch — only attestations arriving on
+        # a transport handshake-authenticated as one of those
+        # identities skip the SigFilter's device verify.
         vcfg = dict(cfg.get("verify_once", {}))
         self.verify_cache = None
-        self._trust_attestations = bool(vcfg.get("trust_attestations",
-                                                 True))
+        self._trust_attestations, self._attestors = attestation_trust(vcfg)
         if vcfg.get("enabled", True):
             from fabric_tpu.verify_plane import VerdictCache
             self.verify_cache = VerdictCache(
@@ -175,7 +187,8 @@ class OrdererNode:
                 _vp.register_ops(
                     self.ops, self.verify_cache,
                     extra=lambda: {
-                        "trust_attestations": self._trust_attestations})
+                        "trust_attestations": self._trust_attestations,
+                        "attestors": len(self._attestors)})
             self.ops.register_route("GET", "/participation/v1/channels",
                                     self._rest_channels)
             # the ops server is PLAIN HTTP with no client auth, so the
@@ -296,6 +309,8 @@ class OrdererNode:
         if self.verify_cache is not None:
             support.processor.verify_cache = self.verify_cache
             support.processor.trust_attestations = self._trust_attestations
+            support.processor.attestors = \
+                support.processor._normalize_attestors(self._attestors)
         self.cluster.add_chain(cid, support.chain,
                                consenters=ch_consenters, peers=ch_peers)
         return support
@@ -361,12 +376,15 @@ class OrdererNode:
         """Gateway fan-in: many envelopes per RPC round trip.  Each is
         admitted independently; statuses/infos line up by index."""
         envs = [Envelope.deserialize(e) for e in body["envelopes"]]
-        # verdict attestations are only honoured from a transport-
-        # authenticated caller — an anonymous frame must never vouch
-        # for a signature this orderer would otherwise verify
+        # verdict attestations carry no authority of their own: the
+        # msgprocessor only honours them when the frame's handshake-
+        # verified sender identity is in the channel's configured
+        # attestor set, so the authenticated peer rides along as the
+        # vouching party
         attests = body.get("attests") if peer_identity is not None else None
         resps = self.broadcast.handle_batch(envs, tps=body.get("tps"),
-                                            attests=attests)
+                                            attests=attests,
+                                            attestor=peer_identity)
         leader = 0
         for r in resps:
             leader = getattr(r, "leader_hint", 0) or leader

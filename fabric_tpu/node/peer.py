@@ -587,7 +587,7 @@ class PeerNode:
             from fabric_tpu.verify_plane import SpeculativeVerifier
             self.speculative = SpeculativeVerifier(
                 self.verify_cache, lambda: self.provider,
-                self._channel_msps)
+                self._channel_msps, epoch_source=self._channel_epoch)
 
         # tx tracing + flight recorder: on by default for nodes (the
         # import-time default stays off so libraries/bench pay nothing);
@@ -806,6 +806,15 @@ class PeerNode:
         if ch is None:
             return {}
         return ch.bundle_source.current().msps
+
+    def _channel_epoch(self, channel_id: str) -> int:
+        """Config sequence for the speculative verifier's per-channel
+        cache-epoch pin — the same value the commit-time validator will
+        judge those entries against."""
+        ch = self.channels.get(channel_id)
+        if ch is None:
+            return 0
+        return ch.bundle_source.current().sequence
 
     def _make_contract(self, cc_cfg: dict):
         kind = cc_cfg.get("contract", "asset_demo")

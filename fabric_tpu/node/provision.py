@@ -188,6 +188,22 @@ def provision_network(base_dir: str, n_orderers: int = 3,
              f"'{o}.member'" for o in peer_orgs)}]
     collections = collections or []
 
+    # peer identities first: every peer hosts a gateway whose
+    # handshake-verified transport identity the orderers pin as a
+    # verdict-attestation attestor — trusting attestations is OFF by
+    # node default, so the dev provisioner opts in EXPLICITLY with the
+    # exact (mspid, cert sha256) bindings allowed to vouch
+    peer_list = []
+    idx = 0
+    for org_name in peer_orgs:
+        for j in range(peers_per_org):
+            peer_list.append((org_name, j, peer_ports[idx]))
+            idx += 1
+    peer_creds = {(o, j): p_orgs[o].issuer.issue(f"peer{j}@{o}")
+                  for o, j, _ in peer_list}
+    attestors = [{"mspid": o, "cert_fp": cert_fingerprint(c)}
+                 for (o, _), (c, _k) in peer_creds.items()]
+
     # orderers
     orderer_paths = []
     for i in range(n_orderers):
@@ -203,23 +219,19 @@ def provision_network(base_dir: str, n_orderers: int = 3,
                 "key_pem": _key_pem(key).decode(),
                 "channel_config_hex": cfg_hex,
                 "cluster": cluster, "data_dir": node_dir,
+                "verify_once": {"trust_attestations": True,
+                                "attestors": attestors},
             }, f)
         orderer_paths.append(path)
 
     # peers: each knows every OTHER peer's endpoint + org (privdata push,
     # discovery membership)
-    peer_list = []
-    idx = 0
-    for org_name in peer_orgs:
-        for j in range(peers_per_org):
-            peer_list.append((org_name, j, peer_ports[idx]))
-            idx += 1
     peer_paths = []
     for org_name, j, port in peer_list:
         org = p_orgs[org_name]
         node_dir = os.path.join(base_dir, f"peer{org_name}_{j}")
         os.makedirs(node_dir, exist_ok=True)
-        cert, key = org.issuer.issue(f"peer{j}@{org_name}")
+        cert, key = peer_creds[(org_name, j)]
         others = [["127.0.0.1", p, o] for (o, k, p) in peer_list
                   if (o, k) != (org_name, j)]
         path = os.path.join(base_dir, f"peer{org_name}_{j}.json")

@@ -40,11 +40,12 @@ class BroadcastHandler:
         self.registrar = registrar
 
     def handle(self, env: Envelope,
-               attest: Optional[str] = None) -> BroadcastResponse:
+               attest: Optional[str] = None,
+               attestor=None) -> BroadcastResponse:
         resp = None
         with tracing.tracer.start_span("orderer.broadcast",
                                        require_parent=True) as span:
-            resp = self._handle_inner(env, span, attest)
+            resp = self._handle_inner(env, span, attest, attestor)
             if span.recording:
                 span.set_attribute("status", resp.status)
                 if resp.status != STATUS_SUCCESS:
@@ -52,7 +53,8 @@ class BroadcastHandler:
         return resp
 
     def _handle_inner(self, env: Envelope, span,
-                      attest: Optional[str] = None) -> BroadcastResponse:
+                      attest: Optional[str] = None,
+                      attestor=None) -> BroadcastResponse:
         try:
             channel_id = env.header().channel_header.channel_id
         except Exception:
@@ -65,7 +67,8 @@ class BroadcastHandler:
             return BroadcastResponse(STATUS_NOT_FOUND,
                                      f"unknown channel {channel_id!r}")
         try:
-            cls = support.processor.process(env, attest=attest)
+            cls = support.processor.process(env, attest=attest,
+                                            attestor=attestor)
         except MsgProcessorError as e:
             return BroadcastResponse(STATUS_FORBIDDEN, str(e))
         try:
@@ -84,7 +87,8 @@ class BroadcastHandler:
     def handle_batch(
             self, envs: Sequence[Envelope],
             tps: Optional[Sequence[str]] = None,
-            attests: Optional[Sequence[str]] = None
+            attests: Optional[Sequence[str]] = None,
+            attestor=None
     ) -> List[BroadcastResponse]:
         """Ingest a coalesced batch in one call (the gateway's admission
         queue ships these).  Envelopes are independent — each routes by
@@ -96,8 +100,10 @@ class BroadcastHandler:
         gateway batches many client txs into one frame, so per-tx trace
         context rides next to the envelopes instead of on the frame.
         `attests` aligns the gateway's verdict attestations the same
-        way (verify-once plane; the caller decides whether the sender
-        was authenticated enough for these to be honoured)."""
+        way (verify-once plane); `attestor` is the frame's handshake-
+        verified sender identity — the msgprocessor only honours the
+        attestations when that identity is in the channel's configured
+        attestor set."""
         out = []
         for i, env in enumerate(envs):
             ctx = None
@@ -105,5 +111,6 @@ class BroadcastHandler:
                 ctx = tracing.tracer.context_from(tps[i])
             attest = attests[i] if attests and i < len(attests) else None
             with tracing.tracer.activate(ctx):
-                out.append(self.handle(env, attest=attest))
+                out.append(self.handle(env, attest=attest,
+                                       attestor=attestor))
         return out

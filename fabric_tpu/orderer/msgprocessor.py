@@ -55,7 +55,7 @@ class StandardChannelProcessor:
                  writers_policy: SignaturePolicy,
                  absolute_max_bytes: int = 10 * 1024 * 1024,
                  now=None, bundle_source=None, verify_cache=None,
-                 trust_attestations: bool = False):
+                 trust_attestations: bool = False, attestors=None):
         self.channel_id = channel_id
         self._static_msps = msps
         self._static_writers = writers_policy
@@ -65,13 +65,20 @@ class StandardChannelProcessor:
         # verify-once plane: when a VerdictCache is attached, the sig
         # filter's batch_verify consults/extends it (duplicate
         # submissions and retried batches stop re-verifying), and — with
-        # trust_attestations — a gateway's verdict attestation seeds it
-        # so the orderer's device verify is skipped entirely.  The
-        # attestation is only honoured when the transport authenticated
-        # the submitting peer AND the attested digest matches the item
-        # this orderer derives itself from the envelope.
+        # trust_attestations (OFF by default: an explicit trust
+        # decision) — an authorized gateway's verdict attestation seeds
+        # it so the orderer's device verify is skipped entirely.  The
+        # attestation digest itself is a public hash anyone can compute,
+        # so it carries no authority: it is only honoured when ALL of
+        # (a) the transport handshake authenticated the submitting
+        # peer, (b) that peer's (mspid, cert sha256) is in the
+        # configured attestor set — the integrity-protected channel
+        # makes the vouch unforgeable by third parties — and (c) the
+        # attested digest matches the item this orderer derives itself
+        # from the envelope bytes it holds.
         self.verify_cache = verify_cache
         self.trust_attestations = bool(trust_attestations)
+        self.attestors = self._normalize_attestors(attestors)
         self._now = now or (lambda: datetime.datetime.now(datetime.timezone.utc))
 
     # -- live config resolution (channelconfig bundle when attached) --------
@@ -99,17 +106,35 @@ class StandardChannelProcessor:
     def absolute_max_bytes(self, v):
         self._static_max_bytes = v
 
+    @staticmethod
+    def _normalize_attestors(attestors) -> frozenset:
+        """Attestor bindings -> frozenset of (mspid, cert sha256 hex).
+        Accepts {"mspid":..., "cert_fp":...} dicts or (mspid, fp)
+        pairs — the consenter-binding idiom: CN strings are forgeable
+        by any org's CA, the certificate hash is not."""
+        out = set()
+        for a in attestors or ():
+            if isinstance(a, dict):
+                mspid, fp = a.get("mspid"), a.get("cert_fp")
+            else:
+                mspid, fp = a
+            if mspid and fp:
+                out.add((str(mspid), str(fp).lower()))
+        return frozenset(out)
+
     @property
     def evaluator(self):
         provider = self.provider
         if self.verify_cache is not None:
             from fabric_tpu.verify_plane import CachingProvider
             provider = CachingProvider(provider, self.verify_cache,
-                                       site="orderer")
+                                       site="orderer",
+                                       scope=self.channel_id)
         return PolicyEvaluator(self.msps, provider)
 
     def process(self, env: Envelope, raw_size: Optional[int] = None,
-                attest: Optional[str] = None) -> MsgClass:
+                attest: Optional[str] = None,
+                attestor=None) -> MsgClass:
         """Admit or raise. Returns the message class for routing.
 
         The envelope header is decoded ONCE here and threaded through the
@@ -139,10 +164,12 @@ class StandardChannelProcessor:
             if self.bundle_source is not None:
                 try:
                     self.verify_cache.set_epoch(
-                        self.bundle_source.current().sequence)
+                        self.bundle_source.current().sequence,
+                        scope=self.channel_id)
                 except Exception:
                     pass
-            if attest and self.trust_attestations:
+            if (attest and self.trust_attestations
+                    and self._attestor_authorized(attestor)):
                 self._accept_attestation(env, sh.creator, attest)
         self._sig_filter(env, sh.creator)
         if cls is MsgClass.CONFIG and self.bundle_source is not None:
@@ -160,17 +187,37 @@ class StandardChannelProcessor:
 
     # -- individual rules ---------------------------------------------------
 
+    def _attestor_authorized(self, attestor) -> bool:
+        """Is this transport-authenticated identity allowed to vouch?
+
+        The attestation digest is a public hash — any submitter can
+        compute it over its own (possibly garbage) signature — so the
+        authority comes entirely from WHO delivered it: the handshake-
+        verified peer identity of the frame it rode in on, pinned here
+        by (mspid, cert sha256) against the operator-configured
+        attestor set.  No attestor set configured means nobody may
+        vouch."""
+        if attestor is None or not self.attestors:
+            return False
+        try:
+            from fabric_tpu.orderer.cluster import cert_fingerprint
+            binding = (attestor.mspid, cert_fingerprint(attestor.cert))
+        except Exception:
+            return False
+        return binding in self.attestors
+
     def _accept_attestation(self, env: Envelope, creator: bytes,
                             attest: str) -> None:
-        """Seed the verdict cache from a gateway's verdict attestation.
+        """Seed the verdict cache from an AUTHORIZED gateway's verdict
+        attestation (the caller already ran _attestor_authorized).
 
         The gateway already ran this creator signature on its device and
         sends the cache-key digest of the VerifyItem it verified.  This
         orderer re-derives the item from the envelope it actually holds
         — identity from ITS msps, payload/signature from the wire bytes
         — and only accepts the attestation when the digests are
-        bit-identical, so a forged or mismatched attestation can never
-        vouch for different bytes than the ones being admitted.  Policy
+        bit-identical, so a mismatched attestation can never vouch for
+        different bytes than the ones being admitted.  Policy
         evaluation, expiry, and config checks still run live below."""
         try:
             from fabric_tpu.verify_plane import item_digest
@@ -180,7 +227,7 @@ class StandardChannelProcessor:
             item = ident.verify_item(env.payload, env.signature)
             if item_digest(item).hex() != attest:
                 return
-            self.verify_cache.put(item, True)
+            self.verify_cache.put(item, True, scope=self.channel_id)
             from fabric_tpu.verify_plane.cache import _m
             _m()["attested"].add(1)
         except Exception:

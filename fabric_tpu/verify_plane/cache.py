@@ -13,16 +13,22 @@ Safety model (the part the differential fuzz gate enforces):
     (length-prefixed).  A signature swapped after a verdict was cached
     produces a DIFFERENT key — the stale verdict is simply never found.
   - Every entry carries an HMAC-SHA256 tag keyed by a per-node secret
-    (os.urandom, never persisted) over (key ‖ verdict ‖ epoch).  A
-    poisoned entry — verdict bit flipped, tag forged, entry copied from
-    another node — fails the MAC check and is dropped + re-verified;
-    a MAC failure can NEVER turn into a skipped verification.
-  - `epoch` tracks the channel config sequence.  A config update (new
-    CRL, rotated CA, policy change) bumps it; entries minted under an
-    older epoch read as stale and force re-verification.  This is
-    belt-and-suspenders: identity *validity* (MSP chain + CRL) and
-    policy evaluation are never cached — they always run live at the
-    gate — only the pure signature bit is.
+    (os.urandom, never persisted) over (key ‖ verdict ‖ scope ‖ epoch).
+    A poisoned entry — verdict bit flipped, tag forged, entry copied
+    from another node — fails the MAC check and is dropped +
+    re-verified; a MAC failure can NEVER turn into a skipped
+    verification.
+  - Epochs are PER SCOPE (the channel id): each entry records the
+    scope it was minted under and that scope's config sequence at mint
+    time, both under the MAC.  A config update (new CRL, rotated CA,
+    policy change) bumps only its own channel's epoch; entries minted
+    under an older sequence of that channel read as stale and force
+    re-verification, while the node's other channels' entries stay
+    live — one shared per-node cache never flaps between channels,
+    and two channels that happen to sit at the same sequence number
+    can never alias.  This is belt-and-suspenders: identity *validity*
+    (MSP chain + CRL) and policy evaluation are never cached — they
+    always run live at the gate — only the pure signature bit is.
   - The cache is bounded (LRU).  Eviction is silent and safe: a miss
     just means one more device verification.
 
@@ -145,9 +151,11 @@ class VerdictCache:
         self.owner = owner
         self._secret = secret or os.urandom(32)
         self._lock = threading.Lock()
-        # digest -> (mac16, verdict, epoch, trace_id)
+        # digest -> (mac16, verdict, scope, epoch, trace_id)
         self._data: "OrderedDict[bytes, tuple]" = OrderedDict()
-        self.epoch = 0
+        # scope (channel id) -> pinned config sequence; unregistered
+        # scopes mint/judge at 0
+        self._epochs: Dict[str, int] = {}
         # a speculative verifier feeds this cache (gates whether the
         # node reports speculative_coverage_frac at all)
         self.speculative_attached = False
@@ -155,22 +163,30 @@ class VerdictCache:
 
     # -- MAC ---------------------------------------------------------------
 
-    def _tag(self, digest: bytes, verdict: bool, epoch: int) -> bytes:
+    def _tag(self, digest: bytes, verdict: bool, scope: str,
+             epoch: int) -> bytes:
+        # scope last: every preceding field is fixed-width, so the
+        # variable-length channel id can never splice into them
         msg = digest + (b"\x01" if verdict else b"\x00") \
-            + int(epoch).to_bytes(8, "big")
+            + int(epoch).to_bytes(8, "big") + scope.encode()
         return hmac.new(self._secret, msg, hashlib.sha256).digest()[:16]
 
-    # -- epoch (config sequence) -------------------------------------------
+    # -- epochs (per-channel config sequence) ------------------------------
 
-    def set_epoch(self, epoch: int) -> None:
-        """Pin the cache to a config sequence; entries minted under any
-        other sequence become stale (identity/policy revision bump)."""
-        with self._lock:
-            self.epoch = int(epoch)
+    def _epoch_of(self, scope: str) -> int:
+        return self._epochs.get(scope, 0)
 
-    def bump_epoch(self) -> None:
+    def set_epoch(self, epoch: int, scope: str = "") -> None:
+        """Pin ONE scope (channel) to a config sequence; that scope's
+        entries minted under any other sequence become stale
+        (identity/policy revision bump).  Other scopes' entries are
+        untouched — the cache is shared per node, the epochs are not."""
         with self._lock:
-            self.epoch += 1
+            self._epochs[scope] = int(epoch)
+
+    def bump_epoch(self, scope: str = "") -> None:
+        with self._lock:
+            self._epochs[scope] = self._epochs.get(scope, 0) + 1
 
     # -- lookups -----------------------------------------------------------
 
@@ -189,13 +205,13 @@ class VerdictCache:
         with self._lock:
             ent = self._data.get(d)
             if ent is not None:
-                mac, verdict, epoch, trace = ent
-                if not hmac.compare_digest(mac, self._tag(d, verdict,
-                                                          epoch)):
+                mac, verdict, scope, epoch, trace = ent
+                if not hmac.compare_digest(
+                        mac, self._tag(d, verdict, scope, epoch)):
                     # poisoned entry: hard-drop, count, FULL re-verify
                     del self._data[d]
                     reason = REASON_MAC
-                elif epoch != self.epoch:
+                elif epoch != self._epoch_of(scope):
                     del self._data[d]
                     reason = REASON_STALE
                 else:
@@ -221,28 +237,31 @@ class VerdictCache:
             ent = self._data.get(d)
             if ent is None:
                 return None
-            mac, verdict, epoch, trace = ent
-            if epoch != self.epoch or not hmac.compare_digest(
-                    mac, self._tag(d, verdict, epoch)):
+            mac, verdict, scope, epoch, trace = ent
+            if epoch != self._epoch_of(scope) or not hmac.compare_digest(
+                    mac, self._tag(d, verdict, scope, epoch)):
                 return None
             return bool(verdict)
 
     # -- fills -------------------------------------------------------------
 
-    def put(self, item, verdict: bool, trace_id: str = "") -> bool:
+    def put(self, item, verdict: bool, trace_id: str = "",
+            scope: str = "") -> bool:
         """Record a verdict this node just computed (or, on the orderer,
-        accepted from an authenticated attestation).  Returns True when
-        the digest was already present with a valid entry — i.e. this
-        was a duplicate device verification."""
+        accepted from an authorized attestation), minted under `scope`'s
+        current epoch.  Returns True when the digest was already present
+        with a valid entry — i.e. this was a duplicate device
+        verification."""
         d = item_digest(item)
         verdict = bool(verdict)
         with self._lock:
+            epoch = self._epoch_of(scope)
             prev = self._data.pop(d, None)
             dup = prev is not None and hmac.compare_digest(
-                prev[0], self._tag(d, prev[1], prev[2])) \
-                and prev[2] == self.epoch
-            self._data[d] = (self._tag(d, verdict, self.epoch), verdict,
-                             self.epoch, str(trace_id))
+                prev[0], self._tag(d, prev[1], prev[2], prev[3])) \
+                and prev[3] == self._epoch_of(prev[2])
+            self._data[d] = (self._tag(d, verdict, scope, epoch), verdict,
+                             scope, epoch, str(trace_id))
             evicted = 0
             while len(self._data) > self.capacity:
                 self._data.popitem(last=False)
@@ -271,12 +290,13 @@ class VerdictCache:
         return miss, hits
 
     def store(self, items: Sequence, verdicts, site: str,
-              trace_id: str = "") -> None:
+              trace_id: str = "", scope: str = "") -> None:
         """Record a device dispatch's results and its economics: `items`
-        aligned with `verdicts`, all freshly verified at `site`."""
+        aligned with `verdicts`, all freshly verified at `site` on
+        behalf of channel `scope`."""
         dupes = 0
         for it, v in zip(items, verdicts):
-            if self.put(it, bool(v), trace_id=trace_id):
+            if self.put(it, bool(v), trace_id=trace_id, scope=scope):
                 dupes += 1
         note_device_verifications(len(items), site)
         if dupes:
@@ -306,9 +326,9 @@ class VerdictCache:
 
         with self._lock:
             size = len(self._data)
-            epoch = self.epoch
+            epochs = dict(self._epochs)
         return {"owner": self.owner, "size": size,
-                "capacity": self.capacity, "epoch": epoch,
+                "capacity": self.capacity, "epochs": epochs,
                 "speculative": self.speculative_attached,
                 "coverage_frac": round(self.coverage.frac(), 4),
                 "hits_total": total("hits"),
@@ -323,10 +343,12 @@ class CachingProvider:
     PolicyEvaluator path: SigFilter, block-signature checks), so every
     evaluate_signed_data transparently becomes verify-once."""
 
-    def __init__(self, inner, cache: VerdictCache, site: str):
+    def __init__(self, inner, cache: VerdictCache, site: str,
+                 scope: str = ""):
         self._inner = inner
         self._cache = cache
         self._site = site
+        self._scope = scope
 
     @property
     def name(self) -> str:
@@ -345,7 +367,7 @@ class CachingProvider:
         if miss:
             sub = [items[i] for i in miss]
             res = self._inner.batch_verify(sub)
-            self._cache.store(sub, res, self._site)
+            self._cache.store(sub, res, self._site, scope=self._scope)
             for i, v in zip(miss, res):
                 out[i] = bool(v)
         return out
@@ -361,11 +383,11 @@ class CachingProvider:
             return lambda: out
         sub = [items[i] for i in miss]
         resolve = self._inner.batch_verify_async(sub)
-        cache, site = self._cache, self._site
+        cache, site, scope = self._cache, self._site, self._scope
 
         def resolved():
             res = resolve()
-            cache.store(sub, res, site)
+            cache.store(sub, res, site, scope=scope)
             out = np.zeros(len(items), dtype=bool)
             for pos, v, _ in hits:
                 out[pos] = v

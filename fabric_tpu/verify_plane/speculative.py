@@ -85,15 +85,19 @@ class SpeculativeVerifier:
 
     `provider_source()` returns the node's verify provider (resolved
     per dispatch so degradation/placement swaps keep working);
-    `msps_source(channel_id)` returns the channel's live MSP set.
+    `msps_source(channel_id)` returns the channel's live MSP set;
+    `epoch_source(channel_id)`, when given, returns the channel's
+    config sequence so entries are minted under the same per-channel
+    epoch the commit gate will judge them against.
     """
 
     def __init__(self, cache: VerdictCache, provider_source,
-                 msps_source, max_queue: int = 4096):
+                 msps_source, max_queue: int = 4096, epoch_source=None):
         self.cache = cache
         self.provider_source = provider_source
         self.msps_source = msps_source
-        self._queue: deque = deque(maxlen=int(max_queue))
+        self.epoch_source = epoch_source
+        self._queue: deque = deque(maxlen=int(max_queue))   # (cid, items)
         self._cv = threading.Condition()
         self._stop = threading.Event()
         self._thread = threading.Thread(
@@ -127,24 +131,35 @@ class SpeculativeVerifier:
         thread has no ambient context, so without the link the
         speculative trace would be a disconnected root)."""
         per_env_items: List[List] = []
-        memo: dict = {}
+        memos: Dict[str, dict] = {}
+        for cid in set(channel_ids):
+            self._pin_epoch(cid)
         for env, cid in zip(envs, channel_ids):
             try:
                 creators, endorse = derive_items(
-                    env.serialize(), cid, self.msps_source(cid), memo)
+                    env.serialize(), cid, self.msps_source(cid),
+                    memos.setdefault(cid, {}))
             except Exception:
                 logger.debug("speculative derive failed", exc_info=True)
                 creators, endorse = [], []
             per_env_items.append(creators)
             if endorse:
                 with self._cv:
-                    self._queue.append(endorse)
+                    self._queue.append((cid, endorse))
                     self._cv.notify()
-        flat = [it for items in per_env_items for it in items]
-        if flat:
-            tid = self._verify_batch(flat, stage="ingress")
+        # one dispatch per channel: every verdict is minted under ITS
+        # channel's epoch (the scope the commit gate judges it by)
+        by_cid: Dict[str, List] = {}
+        for items, cid in zip(per_env_items, channel_ids):
+            by_cid.setdefault(cid, []).extend(items)
+        for cid, flat in by_cid.items():
+            if not flat:
+                continue
+            tid = self._verify_batch(flat, stage="ingress", scope=cid)
             if tid and spans:
-                for sp in spans:
+                for sp, sp_cid in zip(spans, channel_ids):
+                    if sp_cid != cid:
+                        continue
                     try:
                         sp.add_link(tid)
                     except Exception:
@@ -159,21 +174,32 @@ class SpeculativeVerifier:
 
     # -- the background half ----------------------------------------------
 
+    def _pin_epoch(self, cid: str) -> None:
+        """Align the cache's per-channel epoch with the channel's live
+        config sequence before minting under that scope."""
+        if self.epoch_source is None:
+            return
+        try:
+            self.cache.set_epoch(self.epoch_source(cid), scope=cid)
+        except Exception:
+            pass
+
     def _loop(self) -> None:
         while not self._stop.is_set():
             with self._cv:
                 while not self._queue and not self._stop.is_set():
                     self._cv.wait(0.2)
-                batch: List = []
+                batches: Dict[str, List] = {}
                 while self._queue:
-                    batch.extend(self._queue.popleft())
-            if batch:
+                    cid, items = self._queue.popleft()
+                    batches.setdefault(cid, []).extend(items)
+            for cid, batch in batches.items():
                 try:
-                    self._verify_batch(batch, stage="overlap")
+                    self._verify_batch(batch, stage="overlap", scope=cid)
                 except Exception:
                     logger.exception("speculative verify batch failed")
 
-    def _verify_batch(self, items, stage: str) -> str:
+    def _verify_batch(self, items, stage: str, scope: str = "") -> str:
         """Dispatch the not-yet-cached subset and stamp the verdicts,
         under a span whose trace id rides into the cache entries so the
         commit-time block trace can link back to the speculative work.
@@ -195,6 +221,6 @@ class SpeculativeVerifier:
             # with device wall time)
             out = self.provider_source().batch_verify_async(sub)()
             self.cache.store(sub, out, site="speculative",
-                             trace_id=trace_id)
+                             trace_id=trace_id, scope=scope)
             self.dispatched += len(sub)
         return trace_id

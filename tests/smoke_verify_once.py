@@ -110,7 +110,7 @@ def main() -> int:
 
             # the ops route serves the cache economics
             vp = get(gw_peer.ops.addr, "/verify_plane")
-            for k in ("owner", "size", "capacity", "epoch", "hits_total",
+            for k in ("owner", "size", "capacity", "epochs", "hits_total",
                       "misses_total", "rejects_total", "coverage_frac",
                       "speculative", "speculative_dispatched"):
                 if k not in vp:

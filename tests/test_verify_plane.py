@@ -144,8 +144,8 @@ def test_mac_tamper_never_silently_accepted(orgs, sw_provider):
     # attacker flips the verdict bit in place; without the per-node
     # secret they cannot recompute the MAC
     d = item_digest(it)
-    mac, verdict, epoch, trace = cache._data[d]
-    cache._data[d] = (mac, True, epoch, trace)
+    mac, verdict, scope, epoch, trace = cache._data[d]
+    cache._data[d] = (mac, True, scope, epoch, trace)
 
     before = counts()
     assert cache.get(it) is None                    # NOT True — rejected
@@ -209,6 +209,36 @@ def test_epoch_bump_invalidates_cached_verdicts(orgs, sw_provider):
     assert len(cache) == 0
     cache.put(it, True)                  # re-verified under the new epoch
     assert cache.get(it) is True
+
+
+def test_epoch_is_scoped_per_channel(orgs, sw_provider):
+    """One node-wide cache, many channels: a config bump on one channel
+    must stale only ITS entries — the other channels' verdicts stay
+    live (no epoch flapping), and two channels sitting at the SAME
+    sequence number never alias (bumping one cannot be masked by the
+    other's equal sequence)."""
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    cache = VerdictCache(capacity=16)
+    it_a = creator_item(make_tx(org1, org2), msps)
+    it_b = creator_item(make_tx(org1, org2), msps)
+    cache.set_epoch(3, scope="chA")
+    cache.set_epoch(3, scope="chB")      # same sequence number: no alias
+    cache.put(it_a, True, scope="chA")
+    cache.put(it_b, True, scope="chB")
+
+    # chA's config rotates; chB keeps validating between chA's blocks
+    cache.set_epoch(4, scope="chA")
+    before = counts()
+    assert cache.get(it_a) is None       # chA entry stale
+    assert cache.get(it_b) is True       # chB entry untouched
+    moved = delta(before, counts())
+    assert moved["stale"] == 1 and moved["hits"] == 1
+
+    # re-pinning chB to its own (unchanged) sequence must not
+    # invalidate anything — the old global-epoch flap
+    cache.set_epoch(3, scope="chB")
+    assert cache.get(it_b) is True
 
 
 def test_lru_bound_and_eviction_counter(orgs, sw_provider):
@@ -412,7 +442,8 @@ def test_derive_items_match_commit_time_keys(orgs, sw_provider):
     assert all(a for a in attests)                  # creator verdicts in
     # drain the endorsement queue synchronously (worker not started)
     while spec._queue:
-        spec._verify_batch(spec._queue.popleft(), stage="overlap")
+        cid, items = spec._queue.popleft()
+        spec._verify_batch(items, stage="overlap", scope=cid)
 
     inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
     validator = TxValidator("ch", msps, inner, _policies(),
@@ -461,32 +492,86 @@ def test_structurally_invalid_envelope_stamps_nothing(orgs, sw_provider):
 # -- orderer attestation trust ----------------------------------------------
 
 
-def _processor(org, provider, cache, trust):
+def _attestor_binding(ident):
+    from fabric_tpu.orderer.cluster import cert_fingerprint
+    return {"mspid": ident.mspid, "cert_fp": cert_fingerprint(ident.cert)}
+
+
+def _processor(org, provider, cache, trust, attestors=None):
     from fabric_tpu.orderer.msgprocessor import StandardChannelProcessor
     return StandardChannelProcessor(
         "ch", {"Org1": CachedMSP(org.msp())}, provider,
         parse_policy("OR('Org1.member')"),
-        verify_cache=cache, trust_attestations=trust)
+        verify_cache=cache, trust_attestations=trust,
+        attestors=attestors)
 
 
-def _order_env(org):
+def _order_env(org, creator=None):
     rwset = TxRwSet((NsRwSet("cc", writes=(KVWrite("k", b"v"),)),))
     return build.endorser_tx("ch", "cc", "1.0", rwset,
-                             org.new_identity("client"),
+                             creator or org.new_identity("client"),
                              [org.new_identity("e")])
 
 
 def test_attestation_skips_orderer_device_verify(sw_provider):
     org = DevOrg("Org1")
+    gw = org.new_identity("gateway")
     env = _order_env(org)
     msps = {"Org1": CachedMSP(org.msp())}
     it = creator_item(env, msps)
     inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
-    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True)
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True,
+                      attestors=[_attestor_binding(gw)])
     before = counts()
-    proc.process(env, attest=item_digest(it).hex())
+    proc.process(env, attest=item_digest(it).hex(), attestor=gw)
     assert inner.dispatched == 0        # admission served from the cache
     assert delta(before, counts())["attested"] == 1
+
+
+def test_self_attested_invalid_signature_rejected(sw_provider):
+    """THE forgery scenario: the attestation digest is a public hash, so
+    a submitter can always compute a CORRECT digest over its own
+    envelope — including one whose signature is garbage.  Because the
+    submitter is not an authorized attestor, the self-vouch seeds
+    nothing: the SigFilter device-verifies and rejects."""
+    from fabric_tpu.orderer.msgprocessor import MsgProcessorError
+    org = DevOrg("Org1")
+    gw = org.new_identity("gateway")
+    attacker = org.new_identity("attacker")
+    env = _order_env(org)
+    broken = Envelope(env.payload, env.signature[:-2] + b"\x00\x01")
+    msps = {"Org1": CachedMSP(org.msp())}
+    # the attacker computes the digest of the item the orderer itself
+    # will derive — bit-identical, so the digest check alone passes
+    self_attest = item_digest(creator_item(broken, msps)).hex()
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True,
+                      attestors=[_attestor_binding(gw)])
+    before = counts()
+    with pytest.raises(MsgProcessorError):
+        proc.process(broken, attest=self_attest, attestor=attacker)
+    assert inner.dispatched == 1        # really verified, not vouched
+    assert delta(before, counts())["attested"] == 0
+
+
+def test_attestation_requires_configured_attestor_set(sw_provider):
+    """No attestor set configured -> NOBODY may vouch, even with
+    trust_attestations on and a transport-authenticated sender; and an
+    unauthenticated frame (attestor=None) never vouches either."""
+    from fabric_tpu.orderer.msgprocessor import MsgProcessorError
+    org = DevOrg("Org1")
+    gw = org.new_identity("gateway")
+    env = _order_env(org)
+    broken = Envelope(env.payload, env.signature[:-2] + b"\x00\x01")
+    msps = {"Org1": CachedMSP(org.msp())}
+    self_attest = item_digest(creator_item(broken, msps)).hex()
+    for attestor, attestors in ((gw, None), (None, [_attestor_binding(gw)])):
+        inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+        proc = _processor(org, inner, VerdictCache(capacity=64),
+                          trust=True, attestors=attestors)
+        with pytest.raises(MsgProcessorError):
+            proc.process(broken, attest=self_attest, attestor=attestor)
+        assert inner.dispatched == 1
 
 
 def test_forged_attestation_is_ignored(sw_provider):
@@ -494,11 +579,13 @@ def test_forged_attestation_is_ignored(sw_provider):
     derives ITSELF from the wire bytes seeds nothing — the device
     verify runs as if no attestation came."""
     org = DevOrg("Org1")
+    gw = org.new_identity("gateway")
     env = _order_env(org)
     inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
-    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True)
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True,
+                      attestors=[_attestor_binding(gw)])
     before = counts()
-    proc.process(env, attest="ab" * 32)
+    proc.process(env, attest="ab" * 32, attestor=gw)
     assert inner.dispatched == 1
     assert delta(before, counts())["attested"] == 0
 
@@ -507,29 +594,49 @@ def test_attestation_cannot_vouch_for_tampered_envelope(sw_provider):
     """Replaying a VALID attestation digest next to an envelope with a
     swapped signature: the orderer derives the item from the bytes it
     holds, digests differ, the tampered envelope is fully verified and
-    rejected."""
+    rejected — even when the vouching identity IS authorized."""
     from fabric_tpu.orderer.msgprocessor import MsgProcessorError
     org = DevOrg("Org1")
+    gw = org.new_identity("gateway")
     env = _order_env(org)
     msps = {"Org1": CachedMSP(org.msp())}
     good_digest = item_digest(creator_item(env, msps)).hex()
     tampered = Envelope(env.payload, env.signature[:-2] + b"\x00\x01")
     inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
-    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True)
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True,
+                      attestors=[_attestor_binding(gw)])
     with pytest.raises(MsgProcessorError):
-        proc.process(tampered, attest=good_digest)
+        proc.process(tampered, attest=good_digest, attestor=gw)
     assert inner.dispatched == 1
 
 
 def test_attestation_ignored_when_trust_disabled(sw_provider):
     org = DevOrg("Org1")
+    gw = org.new_identity("gateway")
     env = _order_env(org)
     msps = {"Org1": CachedMSP(org.msp())}
     it = creator_item(env, msps)
     inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
-    proc = _processor(org, inner, VerdictCache(capacity=64), trust=False)
-    proc.process(env, attest=item_digest(it).hex())
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=False,
+                      attestors=[_attestor_binding(gw)])
+    proc.process(env, attest=item_digest(it).hex(), attestor=gw)
     assert inner.dispatched == 1
+
+
+def test_trust_attestations_defaults_off(sw_provider):
+    """The trust toggle is a security decision: both the processor and
+    the orderer node's config parser must default it OFF (and the
+    attestor allowlist to empty — nobody may vouch)."""
+    import inspect
+    from fabric_tpu.node.orderer import attestation_trust
+    from fabric_tpu.orderer.msgprocessor import StandardChannelProcessor
+    sig = inspect.signature(StandardChannelProcessor.__init__)
+    assert sig.parameters["trust_attestations"].default is False
+    assert attestation_trust({}) == (False, [])
+    trust, attestors = attestation_trust(
+        {"trust_attestations": True,
+         "attestors": [{"mspid": "Org1", "cert_fp": "ab" * 32}]})
+    assert trust is True and len(attestors) == 1
 
 
 def test_orderer_resubmission_served_from_cache(sw_provider):
