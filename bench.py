@@ -417,6 +417,81 @@ def bench_window(provider, n_tx: int, endorsers: int = 3,
     return rate, statistics.median(done), det
 
 
+def bench_commit_stage(n_tx: int = 300, n_blocks: int = 4) -> dict:
+    """Commit-stage MVCC throughput: serial oracle vs the wavefront
+    scheduler on the SAME pre-built block stream (signature gate
+    bypassed via pre-set flags — this isolates validate-and-prepare +
+    state/history apply), plus the early-abort analyzer's doom fraction
+    on a conflict-heavy stream.  Envelope construction (ECDSA signing)
+    happens outside the timed region."""
+    import random
+    import time as _time
+
+    from fabric_tpu.committer.parallel_commit import EarlyAbortAnalyzer
+    from fabric_tpu.ledger import KVLedger, LedgerConfig
+    from fabric_tpu.msp.ca import DevOrg
+    from fabric_tpu.protocol import (KVRead, KVWrite, NsRwSet, TxFlags,
+                                     TxRwSet, Version)
+    from fabric_tpu.protocol import build
+    from fabric_tpu.protocol.txflags import ValidationCode
+    from fabric_tpu.protocol.types import META_TXFLAGS
+
+    org = DevOrg("Org1")
+
+    def env_of(rwset):
+        return build.endorser_tx("ch", "cc", "1.0", rwset,
+                                 org.admin, [org.admin])
+
+    # low-conflict stream: disjoint keys, nil reads — wave width ~= block
+    low = []
+    for blk in range(n_blocks):
+        low.append([env_of(TxRwSet((NsRwSet(
+            "cc", reads=(KVRead(f"b{blk}t{t}", None),),
+            writes=(KVWrite(f"b{blk}t{t}", bytes([blk, t & 0xff])),)),)))
+            for t in range(n_tx)])
+
+    def commit_stream(parallel):
+        lg = KVLedger("ch", LedgerConfig(parallel_commit=parallel,
+                                         commit_workers=4))
+        t0 = _time.perf_counter()
+        for envs in low:
+            prev = (lg.blockstore.chain_info().current_hash
+                    if lg.height else b"\x00" * 32)
+            block = build.new_block(lg.height, prev, envs)
+            block.metadata.items[META_TXFLAGS] = TxFlags(
+                len(envs), ValidationCode.VALID).to_bytes()
+            lg.commit(block)
+        dt = _time.perf_counter() - t0
+        return lg, n_blocks * n_tx / dt
+
+    lg_s, rate_serial = commit_stream(False)
+    lg_p, rate_parallel = commit_stream(True)
+    assert lg_s.commit_hash == lg_p.commit_hash, \
+        "serial/parallel commit divergence in bench stream"
+    det = {
+        "commit_serial_txs_per_sec": round(rate_serial, 1),
+        "commit_parallel_txs_per_sec": round(rate_parallel, 1),
+        "commit_parallel_speedup": round(rate_parallel / rate_serial, 2),
+        "commit_last_waves": lg_p._commit_scheduler.last_waves,
+        "commit_last_max_wave_width": lg_p._commit_scheduler.last_max_width,
+    }
+
+    # conflicted stream: bogus-version readers the analyzer can doom
+    rng = random.Random(11)
+    conflicted = []
+    for t in range(n_tx):
+        stale = rng.random() < 0.4
+        ver = Version(9, 9) if stale else None
+        conflicted.append(env_of(TxRwSet((NsRwSet(
+            "cc", reads=(KVRead(f"c{t}", ver),),
+            writes=(KVWrite(f"c{t}", b"x"),)),))))
+    prev = lg_p.blockstore.chain_info().current_hash
+    block = build.new_block(lg_p.height, prev, conflicted)
+    doomed = EarlyAbortAnalyzer(lg_p.statedb, "ch").doomed(block)
+    det["early_abort_frac"] = round(len(doomed) / n_tx, 3)
+    return det
+
+
 def _kernel_name() -> str:
     import jax
     if jax.default_backend() == "cpu":
@@ -647,6 +722,17 @@ def main():
                     "for a virtual-mesh dry run")
         except Exception as exc:
             detail["window_sharded_error"] = str(exc)[:200]
+
+    # -- commit-stage MVCC: serial oracle vs wavefront scheduler -------------
+    # (ISSUE 8 proof point: same block stream through both planes, with
+    # the early-abort doom fraction on a conflicted stream.  Pure host
+    # work — no device involved — so the number is honest on any box.)
+    if os.environ.get("BENCH_SKIP_COMMIT") != "1":
+        try:
+            commit_tx = int(os.environ.get("BENCH_COMMIT_TXS", "300"))
+            detail.update(bench_commit_stage(n_tx=commit_tx))
+        except Exception as exc:
+            detail["commit_stage_error"] = str(exc)[:200]
 
     # -- batching economics (same source as the live /metrics surface) -------
     # bench and the node dashboard must agree on occupancy/pad-waste, so

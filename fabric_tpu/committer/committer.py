@@ -69,6 +69,12 @@ class Committer:
             if span.recording:
                 span.set_attribute("valid",
                                    result.final_flags.valid_count())
+                sched = getattr(self.ledger, "_commit_scheduler", None)
+                if sched is not None:
+                    span.set_attribute("mvcc_waves", sched.last_waves)
+                    span.set_attribute("mvcc_edges", sched.last_edges)
+                    span.set_attribute("mvcc_max_wave_width",
+                                       sched.last_max_width)
             return result
 
     def _store_block_inner(self, block: Block) -> BlockCommitResult:

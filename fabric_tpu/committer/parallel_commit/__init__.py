@@ -1,0 +1,16 @@
+"""Parallel MVCC commit plane.
+
+The serial block-ordered MVCC walk (fabric_tpu/ledger/mvcc.py) stays the
+oracle; this package replaces it at commit time with a dependency-graph
+scheduler that validates non-conflicting transactions concurrently while
+preserving bit-identical flags, update batch, and history writes — plus
+an early-abort analyzer the txvalidator consults to skip device dispatch
+for transactions that are already doomed by a preceding same-block write.
+"""
+
+from .earlyabort import EarlyAbortAnalyzer
+from .graph import ConflictGraph, TxFootprint, footprint_of
+from .scheduler import ParallelCommitScheduler
+
+__all__ = ["ConflictGraph", "TxFootprint", "footprint_of",
+           "ParallelCommitScheduler", "EarlyAbortAnalyzer"]

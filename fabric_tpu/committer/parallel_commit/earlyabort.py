@@ -1,0 +1,119 @@
+"""Early-abort analysis: doom MVCC losers before device dispatch.
+
+A transaction reading (ns, key) at version V can only survive the MVCC
+pass if the version it observes at validation time equals V.  What it
+can possibly observe is bounded before any signature work happens:
+
+    M = {committed version of (ns, key)}
+      ∪ {Version(block, j) : j < tx, j puts (ns, key) in this block}
+      ∪ {None               if any j < tx deletes (ns, key)}
+
+— the committed version if no preceding in-block writer lands, or one of
+the preceding writers' versions if one does.  M is computed as a
+SUPERSET of the observable set (writers that will themselves fail the
+gate are still included — that only enlarges M and suppresses dooming),
+so V ∉ M proves the tx loses MVCC no matter which txs turn out valid.
+Such a tx is flagged MVCC_READ_CONFLICT by the txvalidator before its
+VerifyItems are ever enqueued.
+
+Scope guards (all conservative — any doubt means "doom nothing"):
+  - only endorser txs that parse cleanly; parse failures stay on the
+    BAD_RWSET path;
+  - txs with range queries are never doomed (interval phantoms depend
+    on which writers land);
+  - the committed version must be exactly the pre-block state:
+    statedb.savepoint == block_num - 1, which holds under the standard
+    Committer.store_block driver (validate runs strictly after the
+    previous block's state commit).  A pipelined driver that begins
+    block N+1 before block N's state lands fails the guard and gets no
+    early aborts for that block — never a wrong flag.
+
+Consensus note: the final flag byte of a doomed tx is MVCC_READ_CONFLICT
+even when the skipped signature gate would have said BAD_CREATOR_
+SIGNATURE / ENDORSEMENT_POLICY_FAILURE — the tx is invalid either way,
+but the byte feeds the commit hash, so `parallel_commit.early_abort`
+must be configured uniformly across peers of a channel (README
+"Parallel commit").
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Set, Tuple
+
+from fabric_tpu.protocol import Envelope
+from fabric_tpu.protocol.txflags import ValidationCode
+
+from fabric_tpu.ledger.mvcc import parse_endorser_tx
+from fabric_tpu.ledger.statedb import StateDB
+
+
+class EarlyAbortAnalyzer:
+    """Bound to one channel's state DB; stateless across blocks."""
+
+    def __init__(self, statedb: StateDB, channel_id: str = ""):
+        self.statedb = statedb
+        self.channel_id = channel_id
+
+    def doomed(self, block) -> Dict[int, ValidationCode]:
+        """tx_num -> MVCC_READ_CONFLICT for txs that cannot win MVCC.
+        Empty when the savepoint guard fails (see module docstring)."""
+        db = self.statedb
+        blk = int(block.header.number)
+        sp = db.savepoint
+        if (-1 if sp is None else sp) != blk - 1:
+            return {}
+
+        doomed: Dict[int, ValidationCode] = {}
+        puts: Dict[Tuple[str, str], Set[Tuple[int, int]]] = {}
+        deleted: Set[Tuple[str, str]] = set()
+        committed_memo: Dict[Tuple[str, str],
+                             Optional[Tuple[int, int]]] = {}
+
+        def committed(k: Tuple[str, str]) -> Optional[Tuple[int, int]]:
+            if k not in committed_memo:
+                vv = db.get(k[0], k[1])
+                committed_memo[k] = (None if vv is None else
+                                     (vv.version.block_num,
+                                      vv.version.tx_num))
+            return committed_memo[k]
+
+        for tx_num, raw in enumerate(block.data):
+            try:
+                parsed = parse_endorser_tx(Envelope.deserialize(raw))
+            except Exception:
+                continue
+            if parsed is None:
+                continue
+            _txid, rwset = parsed
+            if any(ns_rw.range_queries for ns_rw in rwset.ns_rwsets):
+                continue                 # ranges: never doomed here
+            dead = False
+            for ns_rw in rwset.ns_rwsets:
+                ns = ns_rw.namespace
+                for read in ns_rw.reads:
+                    k = (ns, read.key)
+                    v = read.version
+                    vt = None if v is None else (v.block_num, v.tx_num)
+                    if vt == committed(k):
+                        continue
+                    if vt is None:
+                        if k in deleted:
+                            continue
+                    elif vt in puts.get(k, ()):
+                        continue
+                    dead = True
+                    break
+                if dead:
+                    break
+            if dead:
+                doomed[tx_num] = ValidationCode.MVCC_READ_CONFLICT
+                continue                 # a doomed tx's writes never land
+            for ns_rw in rwset.ns_rwsets:
+                ns = ns_rw.namespace
+                for w in ns_rw.writes:
+                    k = (ns, w.key)
+                    if w.is_delete:
+                        deleted.add(k)
+                    else:
+                        puts.setdefault(k, set()).add((blk, tx_num))
+        return doomed

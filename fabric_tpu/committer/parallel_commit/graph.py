@@ -1,0 +1,129 @@
+"""Per-block read/write-set conflict graph + wavefront leveling.
+
+Two transactions conflict when they touch a common (ns, key) and at
+least one of them writes it — ww, wr (an earlier write feeding a later
+read), and rw (an earlier read that a later write must not overtake:
+waves reorder execution across tx order, so a later tx's write may be
+applied to the working batch before an earlier tx validates unless an
+edge orders them).  Range queries are pinned conservatively to their
+namespace key-interval [start_key, end_key) (end_key "" = unbounded):
+any write landing inside the interval, before or after the querying tx,
+gets an edge.
+
+Edges only ever point from a lower tx_num to a higher one, so the graph
+is a DAG by construction; `level[j] = 1 + max(level[preds])` partitions
+the block into waves — every transaction in a wave is independent of
+every other, and all of a transaction's conflicting predecessors sit in
+strictly earlier waves.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Sequence, Set, Tuple
+
+EDGE_KINDS = ("ww", "wr", "rw", "range")
+
+
+@dataclass
+class TxFootprint:
+    """The MVCC-relevant key touches of one parsed endorser tx."""
+    tx_num: int
+    reads: Set[Tuple[str, str]] = field(default_factory=set)
+    writes: Set[Tuple[str, str]] = field(default_factory=set)
+    # (ns, start_key, end_key); end_key "" = unbounded
+    ranges: List[Tuple[str, str, str]] = field(default_factory=list)
+
+
+def footprint_of(tx_num: int, rwset) -> TxFootprint:
+    fp = TxFootprint(tx_num)
+    for ns_rw in rwset.ns_rwsets:
+        ns = ns_rw.namespace
+        for r in ns_rw.reads:
+            fp.reads.add((ns, r.key))
+        for w in ns_rw.writes:
+            fp.writes.add((ns, w.key))
+        for rq in ns_rw.range_queries:
+            fp.ranges.append((ns, rq.start_key, rq.end_key))
+    return fp
+
+
+def _in_interval(key: str, start_key: str, end_key: str) -> bool:
+    """Same interval semantics as mvcc._merged_range: [start, end),
+    falsy end_key = scan to the end of the namespace."""
+    return key >= start_key and (not end_key or key < end_key)
+
+
+class ConflictGraph:
+    """Built once per block from the participating tx footprints
+    (block order).  Exposes `preds` (tx_num -> conflicting lower
+    tx_nums), `waves` (lists of tx_nums, block-ordered within each
+    wave), and per-kind deduplicated `edge_counts`."""
+
+    def __init__(self, footprints: Sequence[TxFootprint]):
+        self.preds: Dict[int, Set[int]] = {fp.tx_num: set()
+                                           for fp in footprints}
+        self.edge_counts: Dict[str, int] = {k: 0 for k in EDGE_KINDS}
+        self._seen_pairs: Set[Tuple[int, int]] = set()
+        self._build(footprints)
+        self.waves: List[List[int]] = self._level(footprints)
+
+    def _edge(self, a: int, b: int, kind: str) -> None:
+        if a == b:
+            return
+        lo, hi = (a, b) if a < b else (b, a)
+        if (lo, hi) in self._seen_pairs:
+            return
+        self._seen_pairs.add((lo, hi))
+        self.preds[hi].add(lo)
+        self.edge_counts[kind] += 1
+
+    def _build(self, footprints: Sequence[TxFootprint]) -> None:
+        # per-key chains: a writer links to the previous writer (ww) and
+        # to every reader since it (rw); a reader links to the previous
+        # writer (wr).  Transitivity through levels covers the rest.
+        last_writer: Dict[Tuple[str, str], int] = {}
+        readers_since: Dict[Tuple[str, str], List[int]] = {}
+        all_writes: Dict[str, List[Tuple[str, int]]] = {}   # ns -> [(key, tx)]
+        for fp in footprints:
+            tx = fp.tx_num
+            for k in fp.reads:
+                if k not in fp.writes:        # read-write handled below
+                    w = last_writer.get(k)
+                    if w is not None:
+                        self._edge(w, tx, "wr")
+                    readers_since.setdefault(k, []).append(tx)
+            for k in fp.writes:
+                w = last_writer.get(k)
+                if w is not None:
+                    self._edge(w, tx, "ww" if k not in fp.reads else "wr")
+                elif k in fp.reads:
+                    pass                      # first toucher, no pred
+                for r in readers_since.pop(k, ()):
+                    self._edge(r, tx, "rw")
+                last_writer[k] = tx
+                all_writes.setdefault(k[0], []).append((k[1], tx))
+        # range intervals vs every overlapping write, both directions
+        for fp in footprints:
+            for ns, start_key, end_key in fp.ranges:
+                for key, wtx in all_writes.get(ns, ()):
+                    if _in_interval(key, start_key, end_key):
+                        self._edge(fp.tx_num, wtx, "range")
+
+    def _level(self, footprints: Sequence[TxFootprint]) -> List[List[int]]:
+        level: Dict[int, int] = {}
+        by_level: Dict[int, List[int]] = {}
+        for fp in footprints:                 # block order -> preds done
+            lv = 1 + max((level[p] for p in self.preds[fp.tx_num]),
+                         default=0)
+            level[fp.tx_num] = lv
+            by_level.setdefault(lv, []).append(fp.tx_num)
+        return [by_level[lv] for lv in sorted(by_level)]
+
+    @property
+    def n_edges(self) -> int:
+        return len(self._seen_pairs)
+
+    @property
+    def max_wave_width(self) -> int:
+        return max((len(w) for w in self.waves), default=0)

@@ -1,0 +1,196 @@
+"""Wavefront-parallel MVCC validation + batched prepare.
+
+Drop-in replacement for `mvcc.validate_and_prepare_batch` (same
+signature, same mutation contract on `flags`, same return value — the
+differential tests in tests/test_parallel_commit.py hold it to
+bit-identity against the serial oracle):
+
+  1. parse every still-valid tx once (BAD_RWSET parity with the oracle's
+     lazy walk — parsing is state-independent, so hoisting it is exact);
+  2. build the block's conflict graph and partition it into waves
+     (graph.py): every tx's conflicting predecessors sit in strictly
+     earlier waves;
+  3. validate each wave's txs concurrently against the shared working
+     batch — the batch is only ever mutated BETWEEN waves (valid writes
+     applied in tx order), so wave workers see a frozen snapshot that,
+     for the keys and ranges in their own footprint, is exactly the
+     state the serial walk would have shown them;
+  4. rebuild the returned UpdateBatch + history list in strict tx order
+     from the per-tx write lists, so even dict insertion order matches
+     the oracle's output literally.
+
+Thread safety: wave workers only call UpdateBatch.get / .items() and
+StateDB reads (lock-guarded); TxFlags is written by the coordinating
+thread only.
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Tuple
+
+from fabric_tpu.protocol import Version
+from fabric_tpu.protocol.txflags import TxFlags, ValidationCode
+
+from fabric_tpu.ledger.mvcc import (
+    _validate_range_query,
+    _validate_read,
+    parse_endorser_tx,
+)
+from fabric_tpu.ledger.statedb import StateDB, UpdateBatch
+
+from .graph import ConflictGraph, footprint_of
+
+_WIDTH_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                  1024.0, float("inf"))
+
+
+def _validate_tx(db: StateDB, batch: UpdateBatch, rwset) -> Optional[int]:
+    """One tx's MVCC check against a frozen batch — the exact walk order
+    of the oracle's inner loop (per ns_rw: reads, then range queries;
+    first failure decides the code)."""
+    for ns_rw in rwset.ns_rwsets:
+        ns = ns_rw.namespace
+        for read in ns_rw.reads:
+            if not _validate_read(db, batch, ns, read):
+                return int(ValidationCode.MVCC_READ_CONFLICT)
+        for rq in ns_rw.range_queries:
+            if not _validate_range_query(db, batch, ns, rq):
+                return int(ValidationCode.PHANTOM_READ_CONFLICT)
+    return None
+
+
+class ParallelCommitScheduler:
+    """One per ledger (channel); owns the worker pool."""
+
+    def __init__(self, max_workers: int = 4, channel_id: str = ""):
+        self.max_workers = max(1, int(max_workers))
+        self.channel_id = channel_id
+        self._pool: Optional[ThreadPoolExecutor] = None
+        # last-block stats, surfaced by the committer
+        self.last_waves = 0
+        self.last_edges = 0
+        self.last_max_width = 0
+
+    def _executor(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=self.max_workers,
+                thread_name_prefix=f"mvcc-{self.channel_id}")
+        return self._pool
+
+    def close(self) -> None:
+        pool, self._pool = self._pool, None
+        if pool is not None:
+            pool.shutdown(wait=False)
+
+    # -- the entry point (signature-compatible with the serial oracle) ------
+
+    def validate_and_prepare_batch(
+            self, db: StateDB, block_num: int, envelopes, flags: TxFlags,
+    ) -> Tuple[UpdateBatch, List[Tuple[int, str, str, str, bytes, bool]]]:
+        from fabric_tpu.ops_plane import tracing
+
+        # pass 0: parse still-valid txs once (oracle's lazy-parse parity)
+        parsed: List[Tuple[int, str, object, list]] = []
+        for tx_num, env in enumerate(envelopes):
+            if env is None or not flags.is_valid(tx_num):
+                continue
+            try:
+                p = parse_endorser_tx(env)
+            except Exception:
+                flags.set(tx_num, ValidationCode.BAD_RWSET)
+                continue
+            if p is None:
+                continue                    # config txs etc.
+            txid, rwset = p
+            writes = [(ns_rw.namespace, w.key, w.value, w.is_delete)
+                      for ns_rw in rwset.ns_rwsets for w in ns_rw.writes]
+            parsed.append((tx_num, txid, rwset, writes))
+
+        t0 = time.perf_counter()
+        graph = ConflictGraph(
+            [footprint_of(tx_num, rwset)
+             for tx_num, _txid, rwset, _w in parsed])
+        t1 = time.perf_counter()
+        tracing.tracer.record_span(
+            "mvcc.graph", t0, t1,
+            attributes={"block": int(block_num), "txs": len(parsed),
+                        "edges": graph.n_edges,
+                        "waves": len(graph.waves)})
+
+        by_tx = {tx_num: (txid, rwset, writes)
+                 for tx_num, txid, rwset, writes in parsed}
+        working = UpdateBatch()
+        valid: Dict[int, bool] = {}
+        pool = (self._executor()
+                if self.max_workers > 1 and graph.max_wave_width > 1
+                else None)
+        for wave in graph.waves:
+            tw = time.perf_counter()
+            if pool is not None and len(wave) > 1:
+                codes = list(pool.map(
+                    lambda tx: _validate_tx(db, working, by_tx[tx][1]),
+                    wave))
+            else:
+                codes = [_validate_tx(db, working, by_tx[tx][1])
+                         for tx in wave]
+            # apply this wave's outcomes in tx order, between waves only
+            for tx, code in zip(wave, codes):
+                if code is not None:
+                    flags.set(tx, ValidationCode(code))
+                    valid[tx] = False
+                    continue
+                valid[tx] = True
+                version = Version(block_num, tx)
+                for ns, key, value, is_delete in by_tx[tx][2]:
+                    if is_delete:
+                        working.delete(ns, key, version)
+                    else:
+                        working.put(ns, key, value, version)
+            tracing.tracer.record_span(
+                "mvcc.wave", tw, time.perf_counter(),
+                attributes={"block": int(block_num), "width": len(wave)})
+
+        # final batch + history rebuilt in strict tx order: literal
+        # (insertion-order included) identity with the serial oracle
+        batch = UpdateBatch()
+        history: List[Tuple[int, str, str, str, bytes, bool]] = []
+        for tx_num, txid, _rwset, writes in parsed:
+            if not valid.get(tx_num, False):
+                continue
+            version = Version(block_num, tx_num)
+            for ns, key, value, is_delete in writes:
+                if is_delete:
+                    batch.delete(ns, key, version)
+                else:
+                    batch.put(ns, key, value, version)
+                history.append((tx_num, txid, ns, key, value, is_delete))
+
+        self.last_waves = len(graph.waves)
+        self.last_edges = graph.n_edges
+        self.last_max_width = graph.max_wave_width
+        self._observe(graph)
+        return batch, history
+
+    def _observe(self, graph: ConflictGraph) -> None:
+        try:
+            from fabric_tpu.ops_plane import registry
+            ch = self.channel_id
+            edges = registry.counter(
+                "commit_graph_edges_total",
+                "MVCC conflict-graph edges by kind")
+            for kind, n in graph.edge_counts.items():
+                if n:
+                    edges.add(n, kind=kind, channel=ch)
+            registry.counter(
+                "commit_graph_waves_total",
+                "MVCC wavefront count").add(len(graph.waves), channel=ch)
+            width = registry.histogram(
+                "commit_graph_wave_width",
+                "txs per MVCC validation wave", buckets=_WIDTH_BUCKETS)
+            for wave in graph.waves:
+                width.observe(float(len(wave)), channel=ch)
+        except Exception:
+            pass

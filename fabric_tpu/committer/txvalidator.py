@@ -188,7 +188,8 @@ class TxValidator:
                  ledger_has_txid=None, bundle_source=None,
                  sbe_lookup=None,
                  validation_plugin: str = "DefaultValidation",
-                 provider_source=None, verify_cache=None):
+                 provider_source=None, verify_cache=None,
+                 early_abort=None):
         self.channel_id = channel_id
         self._static_msps = msps
         self._provider = provider
@@ -229,6 +230,12 @@ class TxValidator:
         # block are also pruned: a replay of the same or an earlier
         # block (catch-up, crash recovery) is not a duplicate of itself.
         self._inflight_txids: List[Tuple[int, Dict[str, int]]] = []
+        # parallel-commit early abort (parallel_commit.EarlyAbortAnalyzer
+        # or None): txs provably doomed to MVCC_READ_CONFLICT by a
+        # preceding same-block write are flagged during pass 1 and their
+        # VerifyItems never reach the device — don't burn verify slots
+        # on txs that lose MVCC anyway
+        self.early_abort = early_abort
         # live pipeline-economics window (overlap gauge for the SLO plane)
         self._econ = _PipelineEconomics()
 
@@ -274,6 +281,32 @@ class TxValidator:
 
     # -- pass 1: structural + collect ---------------------------------------
 
+    def _doomed_txs(self, block: Block) -> Optional[dict]:
+        """tx_num -> MVCC_READ_CONFLICT from the early-abort analyzer,
+        or None when unwired / guard-failed / analyzer error.  Never
+        lets an analysis failure take the block down — early abort is a
+        pure optimization; the MVCC pass remains authoritative."""
+        if self.early_abort is None:
+            return None
+        try:
+            doomed = self.early_abort.doomed(block)
+        except Exception:
+            logger.exception("early-abort analysis failed; skipping")
+            return None
+        return doomed or None
+
+    def _note_early_aborts(self, n: int) -> None:
+        if not n:
+            return
+        try:
+            from fabric_tpu.ops_plane import registry
+            registry.counter(
+                "commit_graph_early_aborts_total",
+                "txs flagged MVCC_READ_CONFLICT before device dispatch"
+            ).add(n, channel=self.channel_id)
+        except Exception:
+            pass
+
     def _deserialize(self, ident_bytes: bytes) -> Optional[Identity]:
         from fabric_tpu.msp import deserialize_from_msps
         return deserialize_from_msps(self.msps, ident_bytes)
@@ -303,7 +336,7 @@ class TxValidator:
                          seen_txids: Dict[str, int],
                          items: Dict[VerifyItem, None],
                          memo: dict, n_txs: int = 1,
-                         has_txid=None) -> Optional[_TxWork]:
+                         has_txid=None, doomed=None) -> Optional[_TxWork]:
         """Pass-1 tail for one tx whose structural walk ran in either
         front walker — C (native/fastcollect.c) or the Python mirror
         (committer/collect_py.py).  One consumer tail for both walkers
@@ -342,6 +375,15 @@ class TxValidator:
 
         if txtype == 0 and n_txs != 1:
             flags.set(tx_num, ValidationCode.INVALID_CONFIG_TRANSACTION)
+            return None
+
+        # early abort: a tx the analyzer proved cannot win MVCC is
+        # flagged NOW, after txid registration (later duplicates of its
+        # txid must still read DUPLICATE_TXID) and before any identity
+        # resolution or VerifyItem interning — its signatures never
+        # reach the device
+        if doomed is not None and tx_num in doomed:
+            flags.set(tx_num, doomed[tx_num])
             return None
 
         # creator identity: deserialize + chain-validate, memoized per
@@ -558,6 +600,8 @@ class TxValidator:
             and not self.ledger_has_txid(next(iter(m)))]
         carry = [m for _, m in self._inflight_txids]
 
+        doomed = self._doomed_txs(block)
+
         use_fast = (_fastcollect is not None
                     and not getattr(self, "force_python_collect", False))
         if (use_fast and self.sbe_lookup is None
@@ -565,7 +609,7 @@ class TxValidator:
             # deep native tail: SBE needs the classic tail's per-tx
             # written-keys bookkeeping, so key-level endorsement keeps
             # the C-walker + Python-tail path
-            return self._begin_deep(block, num, carry)
+            return self._begin_deep(block, num, carry, doomed)
 
         flags = TxFlags(n)
 
@@ -647,15 +691,20 @@ class TxValidator:
             lambda t: any(t in s for s in carry)
             or self.ledger_has_txid(t)))
         memo: dict = {}
+        n_aborted = 0
         for tx_num, rec in enumerate(recs):
             work = self._collect_tx_fast(tx_num, rec, flags, seen_txids,
                                          items, memo, n_txs=n,
-                                         has_txid=has_txid)
+                                         has_txid=has_txid, doomed=doomed)
+            if work is None and doomed is not None and tx_num in doomed \
+                    and flags.flag(tx_num) == ValidationCode.MVCC_READ_CONFLICT:
+                n_aborted += 1
             if work is not None:
                 works.append(work)
             if (tx_num + 1) % chunk == 0:
                 flush()
         flush()
+        self._note_early_aborts(n_aborted)
         self._inflight_txids.append((num, seen_txids))
         collect_s = time.perf_counter() - t0
         self._econ.note_collect(t0, t0 + collect_s)
@@ -675,7 +724,8 @@ class TxValidator:
                 "collect_s": collect_s, "cache_hits": hit_n,
                 "cache_misses": miss_n}
 
-    def _begin_deep(self, block: Block, num: int, carry: list) -> dict:
+    def _begin_deep(self, block: Block, num: int, carry: list,
+                    doomed=None) -> dict:
         """Deep native pass 1: the C walker consumes its own tuples
         (fastcollect digest/assemble) — txid dedup, creator/endorser
         memo slot assignment, and flat dispatch-ordered VerifyItem
@@ -693,6 +743,26 @@ class TxValidator:
             oracle = None          # unwired: skip the per-tx call in C
         codes, seen_txids, works, creators, endorsers = _fastcollect.digest(
             block.data, self.channel_id, carry, oracle)
+        if doomed:
+            # early abort on the deep path: DROP the work tuple (assemble
+            # interns every work's items regardless of its code, and gate
+            # overwrites the code of any planned tx — filtering is the
+            # only insertion point that keeps the tx off the device AND
+            # out of the gate) and stamp the code.  Only txs still clean
+            # after the structural walk are doomed; a structural code
+            # (dup txid etc.) wins, matching the classic tail's ordering.
+            not_validated = int(ValidationCode.NOT_VALIDATED)
+            n_aborted = 0
+            kept = []
+            for w in works:
+                tx = w[0]
+                if tx in doomed and codes[tx] == not_validated:
+                    codes[tx] = int(doomed[tx])
+                    n_aborted += 1
+                else:
+                    kept.append(w)
+            works = kept
+            self._note_early_aborts(n_aborted)
         # one MSP resolution per unique identity (the whole-block analogue
         # of the classic tail's (0,creator)/(1,endorser) memo dicts)
         c_ents = [self._resolve_creator(b) for b in creators]

@@ -65,9 +65,21 @@ class HistoryDB:
             self._apply(block_num, writes)
 
     def _apply(self, block_num, writes) -> None:
+        # group the block's writes per key first, then extend each
+        # key's list ONCE — one dict probe per touched key instead of
+        # one per write (walk order within a key is preserved, so query
+        # order is unchanged)
+        grouped: Dict[Tuple[str, str], List[KeyMod]] = {}
         for tx_num, txid, ns, key, value, is_delete in writes:
-            self._index.setdefault((ns, key), []).append(
+            grouped.setdefault((ns, key), []).append(
                 KeyMod(block_num, tx_num, txid, value, is_delete))
+        index = self._index
+        for k, mods in grouped.items():
+            prev = index.get(k)
+            if prev is None:
+                index[k] = mods
+            else:
+                prev.extend(mods)
         self._savepoint = block_num
 
     def get_history(self, ns: str, key: str) -> List[KeyMod]:

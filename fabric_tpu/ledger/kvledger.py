@@ -74,6 +74,13 @@ class LedgerConfig:
     root: Optional[str] = None          # None = fully in-memory
     enable_history: bool = True
     snapshot_every: int = 256
+    # parallel MVCC commit plane (committer/parallel_commit/): wavefront
+    # scheduler replaces the serial validate_and_prepare_batch walk —
+    # bit-identical output, enforced differentially.  Must be configured
+    # uniformly across the peers of a channel only as an operational
+    # convention (the OUTPUT is identical; only timing differs).
+    parallel_commit: bool = False
+    commit_workers: int = 4
 
 
 @dataclass
@@ -105,6 +112,15 @@ class KVLedger:
                           if self.config.enable_history else None)
         self._commit_hash = b"\x00" * 32
         self.last_stats = CommitStats()
+        self._commit_scheduler = None
+        if self.config.parallel_commit:
+            # function-level import: ledger <- committer.parallel_commit
+            # <- ledger.mvcc would otherwise cycle at module load
+            from fabric_tpu.committer.parallel_commit import (
+                ParallelCommitScheduler)
+            self._commit_scheduler = ParallelCommitScheduler(
+                max_workers=self.config.commit_workers,
+                channel_id=channel_id)
         self._recover()
 
     # -- recovery (recovery.go) --------------------------------------------
@@ -147,11 +163,36 @@ class KVLedger:
         if state_has_it:
             history = _history_writes_from_flags(envelopes, flags)
         else:
-            batch, history = validate_and_prepare_batch(
-                self.statedb, num, envelopes, flags)
+            batch, history = self._validate_and_prepare(
+                num, envelopes, flags)
             self.statedb.apply_updates(batch, num)
         if self.historydb is not None:
             self.historydb.commit(num, history)  # savepoint-guarded, idempotent
+
+    def _validate_and_prepare(self, num: int, envelopes, flags: TxFlags):
+        """MVCC pass: the wavefront scheduler when parallel_commit is
+        on, the serial oracle otherwise — identical output either way."""
+        if self._commit_scheduler is not None:
+            return self._commit_scheduler.validate_and_prepare_batch(
+                self.statedb, num, envelopes, flags)
+        return validate_and_prepare_batch(self.statedb, num,
+                                          envelopes, flags)
+
+    _APPLY_BUCKETS = (1.0, 4.0, 16.0, 64.0, 256.0, 1024.0, 4096.0,
+                      16384.0, float("inf"))
+
+    def _observe_apply(self, n_state: int, n_history: int) -> None:
+        try:
+            from fabric_tpu.ops_plane import registry
+            h = registry.histogram(
+                "commit_graph_apply_batch_size",
+                "coalesced per-block apply sizes (keys / history rows)",
+                buckets=self._APPLY_BUCKETS)
+            h.observe(float(n_state), db="state", channel=self.channel_id)
+            h.observe(float(n_history), db="history",
+                      channel=self.channel_id)
+        except Exception:
+            pass
 
     # -- commit (kv_ledger.go:425-508) -------------------------------------
 
@@ -183,8 +224,8 @@ class KVLedger:
         envelopes = _safe_envelopes(block)
 
         t0 = time.perf_counter()
-        batch, history = validate_and_prepare_batch(
-            self.statedb, block.header.number, envelopes, flags)
+        batch, history = self._validate_and_prepare(
+            block.header.number, envelopes, flags)
         stats.state_validation_s = time.perf_counter() - t0
         stats.valid_txs = flags.valid_count()
         # MVCC may have flipped more flags — write the final bitmap back
@@ -210,6 +251,7 @@ class KVLedger:
             self.historydb.commit(block.header.number, history)
             stats.history_commit_s = time.perf_counter() - t0
 
+        self._observe_apply(len(batch), len(history))
         self.last_stats = stats
         logger.info(
             "[%s] committed block %d: %d/%d valid | validation=%.1fms "

@@ -380,7 +380,19 @@ class StateDB:
                 if self._batches_since_snapshot >= self.snapshot_every:
                     self._write_snapshot()
 
+    # below this many updates the per-key bisect path wins; above it the
+    # coalesced one-pass merge of _sorted_keys is O(N + B log B) instead
+    # of O(B * N) list insert/pop churn
+    _BATCH_APPLY_MIN = 64
+
     def _apply_in_memory(self, batch: UpdateBatch, block_num: int) -> None:
+        if len(batch) >= self._BATCH_APPLY_MIN:
+            self._apply_batched(batch)
+        else:
+            self._apply_per_key(batch)
+        self._savepoint = block_num
+
+    def _apply_per_key(self, batch: UpdateBatch) -> None:
         ns_indexed = {n for (n, _f) in self._indexes}
         for (ns, key), vv in batch.items():
             k = (ns, key)
@@ -407,7 +419,54 @@ class StateDB:
                             idx.remove(key)
                         else:
                             idx.put(key, doc.get(f))
-        self._savepoint = block_num
+
+    def _apply_batched(self, batch: UpdateBatch) -> None:
+        """One coalesced pass: mutate _data/_FieldIndexes per key, then
+        rebuild _sorted_keys with a single merge of the surviving keys
+        and the sorted set of newly-added ones."""
+        ns_indexed = {n for (n, _f) in self._indexes}
+        removed = set()
+        added = set()
+        data = self._data
+        for k, vv in batch.items():
+            ns, key = k
+            if vv is None:
+                if k in data:
+                    del data[k]
+                    removed.add(k)
+                if ns in ns_indexed:
+                    for (n, f), idx in self._indexes.items():
+                        if n == ns:
+                            idx.remove(key)
+            else:
+                if k not in data:
+                    added.add(k)
+                data[k] = vv
+                if ns in ns_indexed:
+                    doc = _doc_of(vv.value)
+                    for (n, f), idx in self._indexes.items():
+                        if n != ns:
+                            continue
+                        if doc is None:
+                            idx.remove(key)
+                        else:
+                            idx.put(key, doc.get(f))
+        if not removed and not added:
+            return
+        new_keys = sorted(added)
+        merged: List[Tuple[str, str]] = []
+        append = merged.append
+        i = 0
+        n_new = len(new_keys)
+        for k in self._sorted_keys:
+            if k in removed:
+                continue
+            while i < n_new and new_keys[i] < k:
+                append(new_keys[i])
+                i += 1
+            append(k)
+        merged.extend(new_keys[i:])
+        self._sorted_keys = merged
 
     # -- persistence --------------------------------------------------------
 

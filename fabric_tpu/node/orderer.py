@@ -84,6 +84,13 @@ class OrdererNode:
         vcfg = dict(cfg.get("verify_once", {}))
         self.verify_cache = None
         self._trust_attestations, self._attestors = attestation_trust(vcfg)
+        # attest_deliver (opt-in): ride this orderer's own admission
+        # verdicts back to committing peers on the deliver stream, so a
+        # creator signature verified once at SigFilter need not be
+        # re-dispatched at any peer's commit gate.  Emitting digests is
+        # harmless by itself — whether a peer HONOURS them is the
+        # peer's own trust_attestations + attestor-allowlist decision.
+        self._attest_deliver = bool(vcfg.get("attest_deliver", False))
         if vcfg.get("enabled", True):
             from fabric_tpu.verify_plane import VerdictCache
             self.verify_cache = VerdictCache(
@@ -399,9 +406,31 @@ class OrdererNode:
         if body.get("signed_data"):
             s = body["signed_data"]
             sd = SignedData(s["data"], s["identity"], s["signature"])
-        for block in self.deliver.deliver(body["channel"], seek, sd,
+        cid = body["channel"]
+        attesting = (self._attest_deliver and self.verify_cache is not None)
+        msps = None
+        if attesting:
+            support = self.registrar.get(cid)
+            src = (getattr(support, "bundle_source", None)
+                   or self.bundle_source) if support is not None \
+                else self.bundle_source
+            try:
+                msps = src.current().msps
+            except Exception:
+                msps = None
+        for block in self.deliver.deliver(cid, seek, sd,
                                           timeout_s=body.get("timeout_s", 30)):
-            yield {"block": block.serialize()}
+            out = {"block": block.serialize()}
+            if attesting and msps is not None:
+                from fabric_tpu.verify_plane import attest_block
+                try:
+                    attests = attest_block(self.verify_cache, block, cid,
+                                           msps)
+                    if attests is not None:
+                        out["attests"] = attests
+                except Exception:
+                    pass
+            yield out
 
     def _rpc_status(self, body: dict, peer_identity) -> dict:
         from fabric_tpu.orderer import raft as raftmod

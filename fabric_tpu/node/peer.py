@@ -109,6 +109,11 @@ class RemoteDeliver:
         self._rr = 0
 
     def deliver(self, channel_id, seek, signed=None, timeout_s: int = 10):
+        """Yields (block, attests, sender) — `attests` is the orderer's
+        optional per-envelope verdict-attestation list (verify_plane/
+        attest.py) and `sender` the handshake-verified identity of the
+        orderer connection it rode in on; both None when the orderer
+        sends plain blocks."""
         last = None
         payload = b"seek:%s" % channel_id.encode()
         sd = {"data": payload, "identity": self.signer.serialize(),
@@ -119,12 +124,14 @@ class RemoteDeliver:
                 conn = connect(tuple(addr), self.signer, self.msps,
                                timeout=3.0)
                 try:
+                    sender = getattr(conn.channel, "peer_identity", None)
                     for item in conn.call_stream("deliver", {
                             "channel": channel_id, "start": seek.start,
                             "stop": seek.stop, "behavior": seek.behavior,
                             "timeout_s": int(timeout_s),
                             "signed_data": sd}):
-                        yield Block.deserialize(item["block"])
+                        yield (Block.deserialize(item["block"]),
+                               item.get("attests"), sender)
                     self._rr = (self._rr + k) % len(self.orderers)
                     return
                 finally:
@@ -233,8 +240,25 @@ class PeerChannel:
         self.bundle_source = BundleSource(Bundle(channel_cfg),
                                           config_height=config_height)
         self.msps = self.bundle_source.current().msps
-        self.ledger = KVLedger(self.channel_id,
-                               LedgerConfig(root=f"{ch_dir}/ledger"))
+        # parallel MVCC commit plane (committer/parallel_commit).  The
+        # early_abort sub-knob defaults to the plane's enabled state;
+        # NOTE it must be uniform across a channel's peers — a doomed
+        # tx's flag byte is MVCC_READ_CONFLICT even where the skipped
+        # signature gate would have said otherwise, and flags feed the
+        # commit hash (see parallel_commit/earlyabort.py).
+        pc_cfg = dict(node.cfg.get("parallel_commit", {}))
+        self.ledger = KVLedger(
+            self.channel_id,
+            LedgerConfig(root=f"{ch_dir}/ledger",
+                         parallel_commit=bool(pc_cfg.get("enabled", False)),
+                         commit_workers=int(pc_cfg.get("max_workers", 4))))
+        early_abort = None
+        if pc_cfg.get("early_abort", pc_cfg.get("enabled", False)):
+            from fabric_tpu.committer.parallel_commit import (
+                EarlyAbortAnalyzer,
+            )
+            early_abort = EarlyAbortAnalyzer(self.ledger.statedb,
+                                             self.channel_id)
 
         cfg = node.cfg
         self.policies = LifecyclePolicyProvider(self.ledger.statedb)
@@ -275,7 +299,8 @@ class PeerChannel:
             bundle_source=self.bundle_source,
             sbe_lookup=statedb_lookup(self.ledger.statedb),
             provider_source=provider_source,
-            verify_cache=node.verify_cache)
+            verify_cache=node.verify_cache,
+            early_abort=early_abort)
         self.committer = Committer(self.ledger, self.validator,
                                    bundle_source=self.bundle_source,
                                    provider=ch_provider,
@@ -383,6 +408,27 @@ class PeerChannel:
 
     # -- deliver / commit loop ------------------------------------------
 
+    def _seed_attestations(self, block, attests, sender) -> None:
+        """Seed the node's verdict cache from an orderer's deliver-time
+        admission attestations (verify_plane/attest.py).  A no-op
+        unless this peer explicitly trusts attestations AND the
+        deliver stream's handshake-verified sender is in the attestor
+        allowlist; every digest is re-derived from our own envelope
+        bytes before acceptance."""
+        cache = self.node.verify_cache
+        if cache is None or not self.node._attestor_authorized(sender):
+            return
+        from fabric_tpu.verify_plane import accept_block_attestations
+        try:
+            # mint under the channel's live config sequence — the same
+            # epoch the commit-time validator will judge against
+            cache.set_epoch(self.bundle_source.current().sequence,
+                            scope=self.channel_id)
+            accept_block_attestations(cache, block, attests,
+                                      self.channel_id, self.msps)
+        except Exception:
+            logger.debug("attestation seeding failed", exc_info=True)
+
     def _deliver_loop(self) -> None:
         from fabric_tpu.orderer.deliver import SeekInfo
         backoff = 0.2
@@ -391,7 +437,7 @@ class PeerChannel:
             height = self.ledger.height
             try:
                 got = 0
-                for block in self.deliver_client.deliver(
+                for block, attests, sender in self.deliver_client.deliver(
                         self.channel_id,
                         SeekInfo(start=height, stop=height + 31,
                                  behavior="block_until_ready"),
@@ -403,6 +449,8 @@ class PeerChannel:
                                        "verification; dropping window",
                                        block.header.number)
                         break
+                    if attests:
+                        self._seed_attestations(block, attests, sender)
                     # through the gossip state plane: fans out to peers
                     # and drains strictly in block order
                     self.gossip.state.add_block(block)
@@ -474,6 +522,16 @@ class PeerNode:
             self.verify_cache = VerdictCache(
                 capacity=int(vcfg.get("capacity", 65536)),
                 owner=self.mspid)
+        # deliver-time attestation trust (the orderer->peer direction of
+        # the gateway->orderer scheme in orderer/msgprocessor.py): OFF
+        # unless `trust_attestations: true` AND an explicit `attestors`
+        # allowlist of {"mspid", "cert_fp"} bindings names the orderer
+        # identities allowed to vouch for creator-signature verdicts.
+        from fabric_tpu.orderer.msgprocessor import StandardChannelProcessor
+        self._trust_attestations = bool(
+            vcfg.get("trust_attestations", False))
+        self._attestors = StandardChannelProcessor._normalize_attestors(
+            vcfg.get("attestors"))
 
         channel_cfg = ChannelConfig.deserialize(
             bytes.fromhex(cfg["channel_config_hex"]))
@@ -806,6 +864,23 @@ class PeerNode:
         if ch is None:
             return {}
         return ch.bundle_source.current().msps
+
+    def _attestor_authorized(self, sender) -> bool:
+        """Is this transport-authenticated orderer identity allowed to
+        vouch for creator-signature verdicts?  Same rule as the
+        orderer's gateway-attestation gate (msgprocessor.py): trust
+        must be explicitly enabled, and the sender's (mspid, cert
+        sha256) binding must be in the configured allowlist — no
+        allowlist means nobody may vouch."""
+        if (not self._trust_attestations or sender is None
+                or not self._attestors):
+            return False
+        try:
+            from fabric_tpu.orderer.cluster import cert_fingerprint
+            binding = (sender.mspid, cert_fingerprint(sender.cert))
+        except Exception:
+            return False
+        return binding in self._attestors
 
     def _channel_epoch(self, channel_id: str) -> int:
         """Config sequence for the speculative verifier's per-channel

@@ -220,9 +220,17 @@ def provision_network(base_dir: str, n_orderers: int = 3,
                 "channel_config_hex": cfg_hex,
                 "cluster": cluster, "data_dir": node_dir,
                 "verify_once": {"trust_attestations": True,
-                                "attestors": attestors},
+                                "attestors": attestors,
+                                "attest_deliver": True},
             }, f)
         orderer_paths.append(path)
+
+    # the reverse direction: peers pin the orderer identities so the
+    # admission-verdict digests riding deliver frames are honoured —
+    # again an explicit dev-provisioner opt-in, off by node default
+    orderer_attestors = [{"mspid": "OrdererOrg",
+                          "cert_fp": cert_fingerprint(c)}
+                         for c, _k in creds]
 
     # peers: each knows every OTHER peer's endpoint + org (privdata push,
     # discovery membership)
@@ -247,6 +255,8 @@ def provision_network(base_dir: str, n_orderers: int = 3,
                 "chaincodes": chaincodes,
                 "collections": collections,
                 "data_dir": node_dir,
+                "verify_once": {"trust_attestations": True,
+                                "attestors": orderer_attestors},
             }, f)
         peer_paths.append(path)
 

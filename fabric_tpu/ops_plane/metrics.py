@@ -76,6 +76,19 @@ class Counter:
         with self._lock:
             return sum(self._values.values())
 
+    def total_by(self, label: str) -> Dict[str, float]:
+        """Totals grouped by one label's value (per-channel SLO source);
+        label sets without `label` are skipped — they can't be
+        attributed to any group."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            for k, v in self._values.items():
+                lv = dict(k).get(label)
+                if lv is None:
+                    continue
+                out[lv] = out.get(lv, 0.0) + v
+        return out
+
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]
@@ -110,6 +123,20 @@ class Gauge:
         """Snapshot of every label set (SLO breaker-fraction source)."""
         with self._lock:
             return dict(self._values)
+
+    def mean_by(self, label: str) -> Dict[str, float]:
+        """Per-label-value means (per-channel SLO source); label sets
+        without `label` are skipped."""
+        acc: Dict[str, List[float]] = {}
+        with self._lock:
+            for k, v in self._values.items():
+                lv = dict(k).get(label)
+                if lv is None:
+                    continue
+                a = acc.setdefault(lv, [0.0, 0.0])
+                a[0] += v
+                a[1] += 1.0
+        return {lv: s / n for lv, (s, n) in acc.items()}
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
@@ -154,6 +181,23 @@ class Histogram:
                 for i, c in enumerate(per_key):
                     counts[i] += c
             return counts, sum(self._sum.values()), sum(self._n.values())
+
+    def state_by(self, label: str) -> Dict[str, Tuple[List[int], float, int]]:
+        """Per-label-value (bucket counts, sum, n) — the `state()` shape
+        grouped by one label (per-channel SLO quantiles); label sets
+        without `label` are skipped."""
+        acc: Dict[str, list] = {}
+        with self._lock:
+            for k, counts in self._counts.items():
+                lv = dict(k).get(label)
+                if lv is None:
+                    continue
+                a = acc.setdefault(lv, [[0] * len(self.buckets), 0.0, 0])
+                for i, c in enumerate(counts):
+                    a[0][i] += c
+                a[1] += self._sum[k]
+                a[2] += self._n[k]
+        return {lv: (c, s, n) for lv, (c, s, n) in acc.items()}
 
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",

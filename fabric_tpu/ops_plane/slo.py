@@ -18,6 +18,14 @@ code never pays more than it already does for the registry.
 
 Node wiring: the `slo` sub-dict of the local config (peer and orderer),
 env-overridable as FABRIC_TPU_<ROLE>_SLO__<KEY> (localconfig tiering).
+
+Per-channel objectives: `slo: {per_channel: ["commit_p99_s"]}` expands
+the named objective into a channel-grouped template — one independent
+instance (own windows, own burn state, own alert) per observed
+`channel` label value, named `commit_p99_s_by_channel[<ch>]`, so one
+slow channel pages without being averaged away by its quiet neighbours.
+The aggregated original keeps running unchanged.  An objective may also
+carry `per: <label>` directly to group by any other label.
 """
 
 from __future__ import annotations
@@ -101,6 +109,19 @@ class SloEvaluator:
         merged = {k: dict(v) for k, v in DEFAULT_OBJECTIVES.items()}
         for name, o in (cfg.get("objectives") or {}).items():
             merged.setdefault(name, {}).update(o or {})
+        # `per_channel: [names]` templates: each named objective (after
+        # the merge above) also gets a channel-expanded variant that
+        # evaluates — and alerts — once per observed `channel` label
+        # value, so one slow channel pages as `commit_p99_s_by_channel
+        # [ch]` without drowning in the aggregate.  The aggregated
+        # original keeps running unchanged.  An objective may also
+        # carry `per: <label>` directly.
+        for name in cfg.get("per_channel") or ():
+            base = merged.get(name)
+            if base is None:
+                raise ValueError(
+                    f"slo per_channel names unknown objective {name!r}")
+            merged[f"{name}_by_channel"] = dict(base, per="channel")
         for name, o in merged.items():
             if o.get("enabled", True) is False:
                 continue
@@ -126,21 +147,39 @@ class SloEvaluator:
 
     # -- sampling ------------------------------------------------------------
 
+    @staticmethod
+    def _snap_key(o: dict) -> str:
+        """Snapshot key: the metric name, suffixed with the grouping
+        label for `per` objectives so an aggregated and a per-channel
+        objective over the SAME metric coexist in one sample."""
+        per = o.get("per")
+        return f"{o['metric']}|{per}" if per else o["metric"]
+
     def _capture(self) -> dict:
         snap: dict = {}
         for o in self.objectives.values():
-            name = o["metric"]
-            if name in snap:
+            key = self._snap_key(o)
+            if key in snap:
                 continue
-            m = self.registry.get(name)
+            m = self.registry.get(o["metric"])
+            per = o.get("per")
+            if per:
+                # grouped snapshot: {label value -> classic-shape state}
+                if isinstance(m, Histogram):
+                    snap[key] = ("h*", m.buckets, m.state_by(per))
+                elif isinstance(m, Counter):
+                    snap[key] = ("c*", m.total_by(per))
+                elif isinstance(m, Gauge):
+                    snap[key] = ("g*", m.mean_by(per))
+                continue
             if isinstance(m, Histogram):
-                snap[name] = ("h", m.buckets, m.state())
+                snap[key] = ("h", m.buckets, m.state())
             elif isinstance(m, Counter):
-                snap[name] = ("c", m.total())
+                snap[key] = ("c", m.total())
             elif isinstance(m, Gauge):
                 vals = m.values()
-                snap[name] = ("g", (sum(vals.values()) / len(vals))
-                              if vals else None)
+                snap[key] = ("g", (sum(vals.values()) / len(vals))
+                             if vals else None)
         return snap
 
     def sample(self, now: Optional[float] = None) -> None:
@@ -151,40 +190,67 @@ class SloEvaluator:
 
     # -- windowed values -----------------------------------------------------
 
+    def _select(self, o: dict, group: Optional[str]):
+        """Entry accessor for one sample dict: classic objectives read
+        the metric's aggregate tuple; `per` instances project their
+        group's slice out of the grouped snapshot into the same
+        ("h"/"c"/"g", ...) shape so the windowing below is shared."""
+        key = self._snap_key(o)
+        if group is None:
+            return lambda p: p.get(key)
+
+        def sel(p):
+            ent = p.get(key)
+            if ent is None:
+                return None
+            if ent[0] == "h*":
+                st = ent[2].get(group)
+                return None if st is None else ("h", ent[1], st)
+            if ent[0] == "c*":
+                v = ent[1].get(group)
+                return None if v is None else ("c", v)
+            if ent[0] == "g*":
+                v = ent[1].get(group)
+                return None if v is None else ("g", v)
+            return None
+        return sel
+
     def _window_value(self, o: dict, samples: list, now: float,
-                      window_s: float) -> Optional[float]:
-        metric, src = o["metric"], o["source"]
+                      window_s: float,
+                      group: Optional[str] = None) -> Optional[float]:
+        src = o["source"]
+        sel = self._select(o, group)
         if src == "gauge_mean":
-            vals = [p[metric][1] for t, p in samples
-                    if now - window_s < t <= now and metric in p
-                    and p[metric][0] == "g" and p[metric][1] is not None]
+            vals = [sel(p)[1] for t, p in samples
+                    if now - window_s < t <= now and sel(p) is not None
+                    and sel(p)[0] == "g" and sel(p)[1] is not None]
             return (sum(vals) / len(vals)) if vals else None
         # delta sources: newest sample vs the newest sample at/before
         # the window start (falling back to the oldest we have)
-        present = [(t, p) for t, p in samples if metric in p]
+        present = [(t, sel(p)) for t, p in samples if sel(p) is not None]
         if len(present) < 2:
             return None
-        t1, p1 = present[-1]
+        t1, e1 = present[-1]
         base = None
-        for t, p in present:
+        for t, e in present:
             if t <= now - window_s:
-                base = (t, p)
+                base = (t, e)
             else:
                 break
-        t0, p0 = base if base is not None else present[0]
+        t0, e0 = base if base is not None else present[0]
         span = t1 - t0
         if span <= 0.0 or span < self.min_coverage * window_s:
             return None
         if src == "counter_rate":
-            if p0[metric][0] != "c" or p1[metric][0] != "c":
+            if e0[0] != "c" or e1[0] != "c":
                 return None
-            return max(0.0, p1[metric][1] - p0[metric][1]) / span
+            return max(0.0, e1[1] - e0[1]) / span
         if src == "histogram_quantile":
-            if p0[metric][0] != "h" or p1[metric][0] != "h":
+            if e0[0] != "h" or e1[0] != "h":
                 return None
-            buckets = p1[metric][1]
-            c0, _, n0 = p0[metric][2]
-            c1, _, n1 = p1[metric][2]
+            buckets = e1[1]
+            c0, _, n0 = e0[2]
+            c1, _, n1 = e1[2]
             n = n1 - n0
             if n <= 0:
                 return None
@@ -202,68 +268,103 @@ class SloEvaluator:
 
     # -- evaluation + alert state machine ------------------------------------
 
+    def _observed_groups(self, o: dict, samples: list) -> List[str]:
+        """Every label value a `per` objective's metric was seen with in
+        the current sample set (union across samples, so a group that
+        just went quiet still evaluates its long window)."""
+        key = self._snap_key(o)
+        groups: set = set()
+        for _, p in samples:
+            ent = p.get(key)
+            if ent is None:
+                continue
+            groups.update(ent[2] if ent[0] == "h*" else ent[1])
+        return sorted(groups)
+
+    def _eval_one(self, name: str, o: dict, samples: list, now: float,
+                  group: Optional[str] = None) -> dict:
+        short_s = float(o.get("short_window_s", self.short_window_s))
+        long_s = float(o.get("long_window_s", self.long_window_s))
+        bt = float(o.get("burn_threshold", self.burn_threshold))
+        kind = o["kind"]
+        thr = float(o["threshold"])
+        vs = self._window_value(o, samples, now, short_s, group=group)
+        vl = self._window_value(o, samples, now, long_s, group=group)
+        bs = _burn(kind, vs, thr)
+        bl = _burn(kind, vl, thr)
+        with self._lock:
+            st = self._states.setdefault(
+                name, {"state": "no_data", "since": time.time()})
+            prev = st["state"]
+            if prev == "alerting":
+                # hysteresis: only a clearly-healthy SHORT window
+                # clears; no-data holds the alert (absence of
+                # evidence is not recovery)
+                if bs is not None and bs < bt * self.clear_ratio:
+                    st["state"] = "ok"
+                    st["since"] = time.time()
+                    self._clear_alert(name, o, vs, bs, bl)
+            else:
+                if bs is not None and bl is not None \
+                        and bs >= bt and bl >= bt:
+                    st["state"] = "alerting"
+                    st["since"] = time.time()
+                    self._fire_alert(name, o, vs, bs, bl)
+                elif bs is None and bl is None:
+                    if prev != "no_data":
+                        st["state"] = "no_data"
+                        st["since"] = time.time()
+                elif prev != "ok":
+                    st["state"] = "ok"
+                    st["since"] = time.time()
+            state = st["state"]
+            since = st["since"]
+        status = {
+            "name": name, "kind": kind, "source": o["source"],
+            "metric": o["metric"], "threshold": thr,
+            "help": o.get("help", ""),
+            "windows": {"short_s": short_s, "long_s": long_s},
+            "burn_threshold": bt,
+            "value_short": vs, "value_long": vl,
+            "burn_short": bs, "burn_long": bl,
+            "state": state, "since": since}
+        if o.get("per"):
+            status["per"] = o["per"]
+            status["group"] = group
+        return status
+
     def evaluate(self, now: Optional[float] = None) -> List[dict]:
         now = self._clock() if now is None else now
         with self._lock:
             samples = list(self._samples)
         statuses: List[dict] = []
         for name, o in self.objectives.items():
-            short_s = float(o.get("short_window_s", self.short_window_s))
-            long_s = float(o.get("long_window_s", self.long_window_s))
-            bt = float(o.get("burn_threshold", self.burn_threshold))
-            kind = o["kind"]
-            thr = float(o["threshold"])
-            vs = self._window_value(o, samples, now, short_s)
-            vl = self._window_value(o, samples, now, long_s)
-            bs = _burn(kind, vs, thr)
-            bl = _burn(kind, vl, thr)
-            with self._lock:
-                st = self._states[name]
-                prev = st["state"]
-                if prev == "alerting":
-                    # hysteresis: only a clearly-healthy SHORT window
-                    # clears; no-data holds the alert (absence of
-                    # evidence is not recovery)
-                    if bs is not None and bs < bt * self.clear_ratio:
-                        st["state"] = "ok"
-                        st["since"] = time.time()
-                        self._clear_alert(name, vs, bs, bl)
-                else:
-                    if bs is not None and bl is not None \
-                            and bs >= bt and bl >= bt:
-                        st["state"] = "alerting"
-                        st["since"] = time.time()
-                        self._fire_alert(name, o, vs, bs, bl)
-                    elif bs is None and bl is None:
-                        if prev != "no_data":
-                            st["state"] = "no_data"
-                            st["since"] = time.time()
-                    elif prev != "ok":
-                        st["state"] = "ok"
-                        st["since"] = time.time()
-                state = st["state"]
-                since = st["since"]
-            statuses.append({
-                "name": name, "kind": kind, "source": o["source"],
-                "metric": o["metric"], "threshold": thr,
-                "help": o.get("help", ""),
-                "windows": {"short_s": short_s, "long_s": long_s},
-                "burn_threshold": bt,
-                "value_short": vs, "value_long": vl,
-                "burn_short": bs, "burn_long": bl,
-                "state": state, "since": since})
+            if not o.get("per"):
+                statuses.append(self._eval_one(name, o, samples, now))
+                continue
+            # per-label objective: one independent instance (own windows,
+            # own alert state, own /slo row) per observed label value
+            groups = self._observed_groups(o, samples)
+            if not groups:
+                statuses.append(self._eval_one(name, o, samples, now))
+                continue
+            for g in groups:
+                statuses.append(self._eval_one(
+                    f"{name}[{g}]", o, samples, now, group=g))
         with self._lock:
             self._last_status = statuses
         return statuses
 
-    def _alert_attrs(self, name, value, bs, bl) -> dict:
-        o = self.objectives[name]
+    def _alert_attrs(self, name, o, value, bs, bl) -> dict:
+        # `o` is passed in (not looked up) because per-label instance
+        # names like "commit_p99_s_by_channel[ch1]" are not objective
+        # keys — they share their template's config
         return {"objective": name, "metric": o["metric"],
                 "kind": o["kind"], "threshold": float(o["threshold"]),
                 "value": value, "burn_short": bs, "burn_long": bl}
 
     def _fire_alert(self, name, o, value, bs, bl) -> None:
-        rec = dict(self._alert_attrs(name, value, bs, bl),
+        rec = dict(self._alert_attrs(name, o, value, bs, bl),
                    state="firing", fired_at=time.time())
         self._active[name] = rec
         self._history.append(rec)
@@ -277,10 +378,10 @@ class SloEvaluator:
         except Exception:
             pass
         jlog(logger, "slo.alert_fired", level=logging.WARNING,
-             **self._alert_attrs(name, value, bs, bl))
-        self._trace_alert("slo.alert_fired", name, value, bs, bl)
+             **self._alert_attrs(name, o, value, bs, bl))
+        self._trace_alert("slo.alert_fired", name, o, value, bs, bl)
 
-    def _clear_alert(self, name, value, bs, bl) -> None:
+    def _clear_alert(self, name, o, value, bs, bl) -> None:
         rec = self._active.pop(name, None)
         if rec is not None:
             rec["state"] = "resolved"
@@ -292,16 +393,16 @@ class SloEvaluator:
         except Exception:
             pass
         jlog(logger, "slo.alert_cleared",
-             **self._alert_attrs(name, value, bs, bl))
-        self._trace_alert("slo.alert_cleared", name, value, bs, bl)
+             **self._alert_attrs(name, o, value, bs, bl))
+        self._trace_alert("slo.alert_cleared", name, o, value, bs, bl)
 
-    def _trace_alert(self, event, name, value, bs, bl) -> None:
+    def _trace_alert(self, event, name, o, value, bs, bl) -> None:
         """Alert transitions land in the trace stream as a `slo.alert`
         root span carrying an event annotation — the evaluator thread
         has no ambient request context, so it roots its own trace."""
         try:
             from . import tracing
-            attrs = self._alert_attrs(name, value, bs, bl)
+            attrs = self._alert_attrs(name, o, value, bs, bl)
             with tracing.tracer.start_span("slo.alert", attributes=attrs):
                 tracing.event(event, **attrs)
         except Exception:

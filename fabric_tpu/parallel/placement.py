@@ -9,8 +9,11 @@ batches to the full mesh would serialize them through one compiled
 program while 7/8 of each tile sits empty on light channels.
 
 `PlacementScheduler` instead assigns each channel a **disjoint
-contiguous device span** sized from its observed queue depth (EWMA of
-the per-flush batch sizes the validator reports via `demand`):
+contiguous device span** sized from its observed pressure (EWMA of the
+per-flush batch sizes the validator reports via `demand`, plus the
+process-global `provider_dispatch_queue_depth` backlog at report time —
+a flush landing behind unresolved device work signals more pressure
+than its batch size alone):
 
   - shares are powers of two (`mesh.allocate_devices`), so the padded
     bucket series — and therefore the compiled-program set — is stable
@@ -135,6 +138,23 @@ class PlacementScheduler:
             # advance by whole half-lives so decay never compounds per call
             self._last_report[ch] = last + steps * hl
 
+    @staticmethod
+    def _queue_backlog() -> float:
+        """Process-global `provider_dispatch_queue_depth` — device
+        dispatches enqueued but not yet resolved.  A flush that lands
+        while earlier dispatches are still in flight is under-reporting
+        pressure if only its own batch size counts, so the backlog is
+        folded into the demand sample (the gauge is process-global; the
+        reporting channel is the one currently contending with it)."""
+        try:
+            from fabric_tpu.ops_plane import registry
+            g = registry.gauge(
+                "provider_dispatch_queue_depth",
+                "device dispatches enqueued, not yet resolved")
+            return max(0.0, sum(g.values().values()))
+        except Exception:
+            return 0.0
+
     def _drifted(self) -> bool:
         for ch, d in self._demand.items():
             base = self._carve_demand.get(ch)
@@ -158,9 +178,10 @@ class PlacementScheduler:
             a = self.ewma_alpha
             prev = self._demand.get(channel_id)
             if demand is not None and demand > 0:
+                sample = float(demand) + self._queue_backlog()
                 self._demand[channel_id] = (
-                    float(demand) if prev is None
-                    else (1 - a) * prev + a * float(demand))
+                    sample if prev is None
+                    else (1 - a) * prev + a * sample)
                 self._last_report[channel_id] = now
             elif prev is None:
                 self._demand[channel_id] = 1.0

@@ -7,13 +7,15 @@ See cache.py for the safety model and speculative.py for the
 ordering-overlap half.
 """
 
+from .attest import accept_block_attestations, attest_block
 from .cache import (CachingProvider, CoverageWindow, VerdictCache,
                     item_digest, note_device_verifications)
 from .speculative import SpeculativeVerifier, derive_items
 
 __all__ = ["CachingProvider", "CoverageWindow", "VerdictCache",
            "item_digest", "note_device_verifications",
-           "SpeculativeVerifier", "derive_items", "register_ops"]
+           "SpeculativeVerifier", "derive_items", "register_ops",
+           "attest_block", "accept_block_attestations"]
 
 
 def register_ops(ops, cache: VerdictCache, spec=None, extra=None) -> None:

@@ -46,6 +46,9 @@ python tests/smoke_window.py
 echo "== sharded mesh window probe (8 virtual devices, divergence gate) =="
 python tests/smoke_mesh.py
 
+echo "== parallel commit probe (wavefront vs serial oracle, two-stack gate) =="
+python tests/smoke_parallel_commit.py
+
 echo "== non-slow test subset =="
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 echo "OK: smoke passed"

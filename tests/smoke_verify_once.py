@@ -108,6 +108,19 @@ def main() -> int:
                     return _fail(f"cache rejects on a clean run "
                                  f"({node.ops.addr}): {rej}")
 
+            # the reverse attestation direction: the provisioner pins
+            # orderer identities on every peer, so the admission-verdict
+            # digests riding deliver frames must have been honoured on
+            # EVERY peer — including the one that never saw the gateway
+            # traffic firsthand
+            for node in net.peers():
+                t = get(node.ops.addr, "/metrics", raw=True)
+                att = sum(_series_values(
+                    t, "verify_plane_attested_skips_total"))
+                if att <= 0:
+                    return _fail(f"no deliver attestations honoured on "
+                                 f"peer {node.ops.addr}")
+
             # the ops route serves the cache economics
             vp = get(gw_peer.ops.addr, "/verify_plane")
             for k in ("owner", "size", "capacity", "epochs", "hits_total",

@@ -413,3 +413,77 @@ def test_profiling_disabled_by_default():
             assert e.code == 404
     finally:
         ops.stop()
+
+
+def test_slo_per_channel_instance_fires_independently():
+    """`per_channel: ["commit_p99_s"]` expands one alert instance per
+    observed channel label; only the slow channel's instance fires, the
+    quiet channel and the aggregated original are judged separately."""
+    reg = MetricsRegistry()
+    h = reg.histogram("validation_duration_seconds",
+                      buckets=(0.1, 1.0, 5.0, float("inf")))
+    ev = _slo_eval(reg, per_channel=["commit_p99_s"],
+                   objectives={"commit_p99_s": {"threshold": 1.0}})
+    t = 0.0
+    for _ in range(12):
+        for _ in range(5):
+            h.observe(0.05, channel="fast")
+            h.observe(3.0, channel="slow")    # p99 over threshold
+        ev.sample(t)
+        ev.evaluate(t)
+        t += 1.0
+    sts = {s["name"]: s for s in ev.evaluate(t)}
+    slow = sts["commit_p99_s_by_channel[slow]"]
+    fast = sts["commit_p99_s_by_channel[fast]"]
+    assert slow["state"] == "alerting" and slow["group"] == "slow"
+    assert slow["value_short"] == pytest.approx(5.0)
+    assert fast["state"] == "ok"
+    assert fast["value_short"] == pytest.approx(0.1)
+    # the aggregated original keeps its own (blended) judgement
+    assert "commit_p99_s" in sts
+    active = {a["objective"] for a in ev.alerts_snapshot()["active"]}
+    assert "commit_p99_s_by_channel[slow]" in active
+    assert "commit_p99_s_by_channel[fast]" not in active
+
+
+def test_slo_per_channel_no_observations_is_no_data():
+    reg = MetricsRegistry()
+    reg.histogram("validation_duration_seconds",
+                  buckets=(0.1, 1.0, 5.0, float("inf")))
+    ev = _slo_eval(reg, per_channel=["commit_p99_s"])
+    t = 0.0
+    for _ in range(6):
+        ev.sample(t)
+        ev.evaluate(t)
+        t += 1.0
+    sts = {s["name"]: s for s in ev.evaluate(t)}
+    assert sts["commit_p99_s_by_channel"]["state"] == "no_data"
+
+
+def test_slo_per_channel_unknown_template_rejected():
+    from fabric_tpu.ops_plane.slo import SloEvaluator
+    with pytest.raises(ValueError, match="unknown objective"):
+        SloEvaluator({"per_channel": ["nope"]}, registry=MetricsRegistry())
+
+
+def test_metrics_grouped_snapshots():
+    reg = MetricsRegistry()
+    c = reg.counter("x_total")
+    c.add(2.0, channel="a")
+    c.add(3.0, channel="a", phase="p")
+    c.add(5.0, channel="b")
+    c.add(7.0)                                   # unattributed: skipped
+    assert c.total_by("channel") == {"a": 5.0, "b": 5.0}
+    g = reg.gauge("x_gauge")
+    g.set(1.0, channel="a", slot="1")
+    g.set(3.0, channel="a", slot="2")
+    g.set(9.0, channel="b")
+    assert g.mean_by("channel") == {"a": 2.0, "b": 9.0}
+    h = reg.histogram("x_seconds", buckets=(1.0, float("inf")))
+    h.observe(0.5, channel="a", phase="p1")
+    h.observe(2.0, channel="a", phase="p2")
+    h.observe(0.5, channel="b")
+    by = h.state_by("channel")
+    assert by["a"][0] == [1, 1] and by["a"][2] == 2
+    assert by["a"][1] == pytest.approx(2.5)
+    assert by["b"][0] == [1, 0] and by["b"][2] == 1

@@ -1,0 +1,406 @@
+"""Parallel MVCC commit plane: differential bit-identity + early abort.
+
+The wavefront scheduler (committer/parallel_commit/) claims LITERAL
+output identity with the serial oracle `mvcc.validate_and_prepare_batch`
+— same flag bytes, same UpdateBatch content *in the same insertion
+order*, same history tuple sequence.  Every corpus here is run three
+ways (serial oracle, scheduler with 4 workers, scheduler with 1 worker)
+and the outputs compared exactly.  The early-abort analyzer is held to
+its invariant the other way round: wiring it must change NOTHING about
+the final flags/state, only how many VerifyItems reach the device.
+"""
+import random
+
+import pytest
+
+from fabric_tpu.bccsp.factory import init_factories, FactoryOpts
+from fabric_tpu.committer import Committer, PolicyRegistry, TxValidator
+from fabric_tpu.committer.parallel_commit import (EarlyAbortAnalyzer,
+                                                  ParallelCommitScheduler)
+from fabric_tpu.ledger import KVLedger, LedgerConfig, StateDB, UpdateBatch
+from fabric_tpu.ledger.mvcc import validate_and_prepare_batch
+from fabric_tpu.msp import CachedMSP
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.ops_plane import registry
+from fabric_tpu.policy import parse_policy
+from fabric_tpu.protocol import (Envelope, KVRead, KVWrite, NsRwSet, TxFlags,
+                                 TxRwSet, ValidationCode, Version)
+from fabric_tpu.protocol import build
+from fabric_tpu.protocol.types import META_TXFLAGS, RangeQueryInfo
+
+
+@pytest.fixture(scope="module", autouse=True)
+def sw_provider():
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture(scope="module")
+def org():
+    return DevOrg("Org1")
+
+
+def tx(org, rwset):
+    return build.endorser_tx("ch", "cc", "1.0", rwset, org.admin, [org.admin])
+
+
+def rw(reads=(), writes=(), ns="cc", rqs=()):
+    return TxRwSet((NsRwSet(ns, reads=tuple(reads), writes=tuple(writes),
+                            range_queries=tuple(rqs)),))
+
+
+def seeded_db(n_keys=20):
+    """Committed state k00..k{n-1} = b"v<i>" at Version(1, i)."""
+    db = StateDB()
+    b = UpdateBatch()
+    for i in range(n_keys):
+        b.put("cc", f"k{i:02d}", b"v%d" % i, Version(1, i))
+    db.apply_updates(b, 1)
+    return db
+
+
+def _norm(flags, batch, history):
+    """Comparable snapshot; batch.items() order included on purpose —
+    the scheduler promises insertion-order identity, not just set
+    identity."""
+    items = [(k, None if vv is None else
+              (vv.value, vv.version.block_num, vv.version.tx_num))
+             for k, vv in batch.items()]
+    return flags.to_bytes(), items, list(history)
+
+
+def three_way(envs, block_num=2, db_factory=seeded_db, pre=()):
+    """Run serial oracle vs scheduler(4) vs scheduler(1) on fresh DBs
+    and assert bit-identical outputs.  `pre` = [(tx_num, code)] applied
+    to the flags before the pass (simulates gate failures)."""
+    outs = []
+    for workers in (None, 4, 1):
+        db = db_factory()
+        flags = TxFlags(len(envs), ValidationCode.VALID)
+        for t, code in pre:
+            flags.set(t, code)
+        if workers is None:
+            batch, history = validate_and_prepare_batch(
+                db, block_num, envs, flags)
+        else:
+            sched = ParallelCommitScheduler(max_workers=workers,
+                                            channel_id="t")
+            try:
+                batch, history = sched.validate_and_prepare_batch(
+                    db, block_num, envs, flags)
+            finally:
+                sched.close()
+        outs.append(_norm(flags, batch, history))
+    assert outs[0] == outs[1], "serial vs 4-worker diverged"
+    assert outs[0] == outs[2], "serial vs 1-worker diverged"
+    return outs[0]
+
+
+# -- adversarial corpora ------------------------------------------------------
+
+def test_corpus_ww_chains_same_key(org):
+    """Write-write chains on one key force a serial wave ordering; the
+    read-your-predecessor variants exercise the frozen-batch snapshot."""
+    v10 = Version(1, 0)
+    envs = [
+        tx(org, rw(reads=[KVRead("k00", v10)],
+                   writes=[KVWrite("k00", b"a")])),           # valid
+        tx(org, rw(reads=[KVRead("k00", v10)],
+                   writes=[KVWrite("k00", b"b")])),           # stale: tx0 won
+        tx(org, rw(reads=[KVRead("k00", Version(2, 0))],
+                   writes=[KVWrite("k00", b"c")])),           # reads tx0's put
+        tx(org, rw(reads=[KVRead("k00", Version(2, 2))])),    # reads tx2's put
+        tx(org, rw(reads=[KVRead("k00", Version(2, 1))])),    # tx1 lost: stale
+    ]
+    flags, items, history = three_way(envs)
+    assert list(flags) == [0, 11, 0, 0, 11]
+    assert items[-1][1][0] == b"c"
+    assert [h[0] for h in history] == [0, 2]
+
+
+def test_corpus_range_phantoms(org):
+    """Interval phantoms created and destroyed by in-block writes, with
+    both itr_exhausted polarities."""
+    def rec(i):
+        return KVRead(f"k{i:02d}", Version(1, i))
+    rq_full = RangeQueryInfo("k05", "k08", True, (rec(5), rec(6), rec(7)))
+    rq_open = RangeQueryInfo("k05", "k08", False, (rec(5), rec(6)))
+    envs = [
+        tx(org, rw(rqs=[rq_full], writes=[KVWrite("z0", b"1")])),  # valid
+        tx(org, rw(writes=[KVWrite("k06", b"new")])),              # in interval
+        tx(org, rw(rqs=[rq_full], writes=[KVWrite("z1", b"1")])),  # phantom
+        tx(org, rw(writes=[KVWrite("k09", b"x")])),                # outside
+        tx(org, rw(rqs=[RangeQueryInfo("k10", "k12", True, (rec(10), rec(11)))],
+                   writes=[KVWrite("z2", b"1")])),                 # valid
+        tx(org, rw(writes=[], reads=[],
+                   rqs=[rq_open])),       # prefix mismatch: k06 rewritten
+        tx(org, rw(writes=[KVWrite("k05", b"", True)])),   # delete start key
+        tx(org, rw(rqs=[RangeQueryInfo("k10", "k12", False, (rec(10),))],
+                   writes=[KVWrite("z3", b"1")])),  # non-exhausted prefix ok
+    ]
+    flags, _items, _history = three_way(envs)
+    assert list(flags) == [0, 0, 12, 0, 0, 12, 0, 0]
+
+
+def test_corpus_delete_then_read(org):
+    envs = [
+        tx(org, rw(writes=[KVWrite("k03", b"", True)])),        # delete
+        tx(org, rw(reads=[KVRead("k03", Version(1, 3))])),      # stale: deleted
+        tx(org, rw(reads=[KVRead("k03", None)],
+                   writes=[KVWrite("k03", b"back")])),          # sees delete
+        tx(org, rw(reads=[KVRead("k03", Version(2, 2))])),      # sees re-put
+    ]
+    flags, _items, history = three_way(envs)
+    assert list(flags) == [0, 11, 0, 0]
+    assert [(h[0], h[5]) for h in history] == [(0, True), (2, False)]
+
+
+def test_corpus_parse_failures_config_and_gate_skips(org):
+    """Garbage bytes -> BAD_RWSET; config txs carry no rwset and are
+    skipped; gate-invalid txs are never state-validated (their writes
+    must not land even when they would win MVCC)."""
+    cfg_env = build.signed_envelope("config", "ch", {"data": b"{}"},
+                                    org.admin)
+    envs = [
+        tx(org, rw(writes=[KVWrite("k01", b"won")])),
+        Envelope(b"\xde\xad\xbe\xef", b""),                     # parse bomb
+        cfg_env,
+        tx(org, rw(writes=[KVWrite("k01", b"gate-loser")])),    # pre-flagged
+        tx(org, rw(reads=[KVRead("k01", Version(2, 0))])),      # sees tx0 only
+    ]
+    flags, items, _history = three_way(
+        envs, pre=[(3, ValidationCode.ENDORSEMENT_POLICY_FAILURE)])
+    assert list(flags) == [0, 22, 0, 10, 0]
+    assert dict(items)[("cc", "k01")][0] == b"won"
+
+
+def test_corpus_all_conflict_and_no_conflict(org):
+    # 100% conflict: everyone reads a version nobody ever wrote
+    bogus = [tx(org, rw(reads=[KVRead(f"k{i:02d}", Version(9, 9))],
+                        writes=[KVWrite(f"k{i:02d}", b"x")]))
+             for i in range(8)]
+    flags, items, history = three_way(bogus)
+    assert list(flags) == [11] * 8 and not items and not history
+    # 0% conflict: disjoint keys, correct versions -> single wide wave
+    clean = [tx(org, rw(reads=[KVRead(f"k{i:02d}", Version(1, i))],
+                        writes=[KVWrite(f"n{i}", b"y")]))
+             for i in range(8)]
+    flags, items, _history = three_way(clean)
+    assert list(flags) == [0] * 8 and len(items) == 8
+
+
+def test_differential_fuzz_random_blocks(org):
+    """Seeded random blocks mixing stale/fresh/nil reads, puts, deletes
+    and range queries — the scheduler must track the oracle bit-for-bit
+    at every worker count."""
+    keys = [f"k{i:02d}" for i in range(12)]
+    for seed in range(25):
+        rng = random.Random(seed)
+        envs = []
+        for _t in range(rng.randrange(1, 10)):
+            reads, writes, rqs = [], [], []
+            for _ in range(rng.randrange(0, 3)):
+                k = rng.choice(keys)
+                ver = rng.choice([Version(1, int(k[1:])), Version(7, 7), None])
+                reads.append(KVRead(k, ver))
+            for _ in range(rng.randrange(0, 3)):
+                k = rng.choice(keys)
+                if rng.random() < 0.25:
+                    writes.append(KVWrite(k, b"", True))
+                else:
+                    writes.append(KVWrite(k, rng.randbytes(4)))
+            if rng.random() < 0.3:
+                lo, hi = sorted(rng.sample(range(12), 2))
+                recs = tuple(KVRead(f"k{i:02d}", Version(1, i))
+                             for i in range(lo, hi))
+                rqs.append(RangeQueryInfo(f"k{lo:02d}", f"k{hi:02d}",
+                                          rng.random() < 0.5, recs))
+            envs.append(tx(org, rw(reads=reads, writes=writes, rqs=rqs)))
+        three_way(envs)
+
+
+# -- full-pipeline identity ---------------------------------------------------
+
+def _pipeline_blocks(org):
+    """Two blocks with conflicts, deletes and a range query.  Built ONCE
+    — endorser_tx mints fresh txids/signatures per call, so both ledgers
+    must see the same bytes for commit hashes to be comparable."""
+    b0 = [tx(org, rw(writes=[KVWrite(f"k{i}", b"v%d" % i)]))
+          for i in range(6)]
+    b1 = [
+        tx(org, rw(reads=[KVRead("k0", Version(0, 0))],
+                   writes=[KVWrite("k0", b"w")])),
+        tx(org, rw(reads=[KVRead("k0", Version(0, 0))],
+                   writes=[KVWrite("k0", b"lose")])),
+        tx(org, rw(writes=[KVWrite("k1", b"", True)])),
+        tx(org, rw(reads=[KVRead("k1", None)])),                # sees delete
+        tx(org, rw(rqs=[RangeQueryInfo(
+            "k2", "k5", True,
+            (KVRead("k2", Version(0, 2)), KVRead("k3", Version(0, 3)),
+             KVRead("k4", Version(0, 4))))],
+            writes=[KVWrite("k9", b"rq")])),
+    ]
+    return b0, b1
+
+
+def test_kvledger_parallel_matches_serial_commit_hash(org):
+    b0, b1 = _pipeline_blocks(org)
+    results = []
+    for parallel in (False, True):
+        lg = KVLedger("ch", LedgerConfig(parallel_commit=parallel,
+                                         commit_workers=4))
+        for envs in (b0, b1):
+            prev = (lg.blockstore.chain_info().current_hash
+                    if lg.height else b"\x00" * 32)
+            block = build.new_block(lg.height, prev, envs)
+            flags = TxFlags(len(envs), ValidationCode.VALID)
+            block.metadata.items[META_TXFLAGS] = flags.to_bytes()
+            lg.commit(block)
+        state = {k: lg.get_state("cc", k)
+                 for k in [f"k{i}" for i in range(10)]}
+        hist = [(m.value, m.is_delete) for m in lg.get_history("cc", "k0")]
+        results.append((lg.commit_hash, state, hist))
+    assert results[0] == results[1]
+    assert results[1][1]["k0"] == b"w" and results[1][1]["k1"] is None
+
+
+# -- early abort --------------------------------------------------------------
+
+def _block_of(envs, number=2, prev=b"\x00" * 32):
+    return build.new_block(number, prev, envs)
+
+
+def test_early_abort_analyzer_doom_set(org):
+    db = seeded_db()
+    envs = [
+        tx(org, rw(reads=[KVRead("k00", Version(9, 9))])),      # bogus: doomed
+        tx(org, rw(writes=[KVWrite("k01", b"x")])),
+        tx(org, rw(reads=[KVRead("k01", Version(2, 1))])),      # in-block put
+        tx(org, rw(reads=[KVRead("k01", Version(1, 1))])),      # committed
+        tx(org, rw(writes=[KVWrite("k02", b"", True)])),
+        tx(org, rw(reads=[KVRead("k02", None)])),               # sees delete
+        tx(org, rw(reads=[KVRead("k02", Version(8, 8))])),      # doomed
+        tx(org, rw(reads=[KVRead("k03", Version(9, 9))],
+                   rqs=[RangeQueryInfo("k0", "k1", True, ())])),  # rq: spared
+        tx(org, rw(reads=[KVRead("nope", None)])),              # nil ok
+    ]
+    block = _block_of(envs)
+    block.data.append(b"\xba\xad")        # unparsable: skipped, not fatal
+    analyzer = EarlyAbortAnalyzer(db, "ch")
+    assert analyzer.doomed(block) == {
+        0: ValidationCode.MVCC_READ_CONFLICT,
+        6: ValidationCode.MVCC_READ_CONFLICT}
+
+
+def test_early_abort_savepoint_guard(org):
+    """A pipelined driver validating block N+2 against state at N must
+    get NO early aborts — wrong flags are worse than missed savings."""
+    db = seeded_db()                      # savepoint == 1
+    doomed_env = tx(org, rw(reads=[KVRead("k00", Version(9, 9))]))
+    analyzer = EarlyAbortAnalyzer(db, "ch")
+    assert analyzer.doomed(_block_of([doomed_env], number=5)) == {}
+    assert analyzer.doomed(_block_of([doomed_env], number=2)) != {}
+
+
+def test_early_abort_doomed_writes_never_mask_later_reads(org):
+    """A doomed tx's writes must not enter M for later readers: tx1
+    reading the doomed tx0's would-be put version is itself doomed."""
+    db = seeded_db()
+    envs = [
+        tx(org, rw(reads=[KVRead("k00", Version(9, 9))],
+                   writes=[KVWrite("k05", b"never")])),
+        tx(org, rw(reads=[KVRead("k05", Version(2, 0))])),
+    ]
+    doomed = EarlyAbortAnalyzer(db, "ch").doomed(_block_of(envs))
+    assert sorted(doomed) == [0, 1]
+
+
+class CountingProvider:
+    """Delegating provider recording every device dispatch."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.name = inner.name
+        self.n_items = 0
+
+    def batch_verify(self, items):
+        items = list(items)
+        self.n_items += len(items)
+        return self.inner.batch_verify(items)
+
+    def batch_verify_async(self, items):
+        items = list(items)
+        self.n_items += len(items)
+        return self.inner.batch_verify_async(items)
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
+def _committer(sw_provider, org1, early_abort: bool):
+    msps = {org1.mspid: CachedMSP(org1.msp())}
+    policies = PolicyRegistry()
+    policies.set_policy("cc", parse_policy("OR('Org1.member','Org2.member')"))
+    ledger = KVLedger("ch", LedgerConfig())
+    counting = CountingProvider(sw_provider)
+    ea = EarlyAbortAnalyzer(ledger.statedb, "ch") if early_abort else None
+    validator = TxValidator("ch", msps, counting, policies, early_abort=ea)
+    return Committer(ledger, validator), counting
+
+
+@pytest.mark.parametrize("force_py", [True, False],
+                         ids=["classic", "deep"])
+def test_committer_early_abort_flag_parity_and_fewer_dispatches(
+        sw_provider, force_py):
+    """With early abort wired: identical final flags, state and commit
+    hash; strictly fewer VerifyItems on the device; counter bumped."""
+    org1 = DevOrg("Org1")
+
+    def mk(rwset):
+        return build.endorser_tx("ch", "cc", "1.0", rwset,
+                                 org1.new_identity("c"),
+                                 [org1.new_identity("e")])
+
+    def rws(reads=(), writes=()):
+        return TxRwSet((NsRwSet("cc", reads=tuple(reads),
+                                writes=tuple(writes)),))
+
+    # shared envelope bytes across both worlds (fresh-signature gotcha)
+    b0 = [mk(rws(writes=[KVWrite("a", b"1"), KVWrite("b", b"2")]))]
+    b1 = [
+        mk(rws(reads=[KVRead("a", Version(9, 9))],
+               writes=[KVWrite("a", b"doomed")])),       # provably dead
+        mk(rws(reads=[KVRead("a", Version(0, 0))],
+               writes=[KVWrite("a", b"3")])),            # valid
+        mk(rws(reads=[KVRead("b", Version(0, 0))])),     # valid
+    ]
+    counter = registry.counter("commit_graph_early_aborts_total")
+    outs = []
+    for early in (False, True):
+        committer, counting = _committer(sw_provider, org1, early)
+        v = committer.validator
+        v.force_python_collect = force_py
+        try:
+            before = counter.value(channel="ch")
+            for envs in (b0, b1):
+                lg = committer.ledger
+                prev = (lg.blockstore.chain_info().current_hash
+                        if lg.height else b"\x00" * 32)
+                committer.store_block(build.new_block(lg.height, prev, envs))
+            aborts = counter.value(channel="ch") - before
+            flags = TxFlags.from_bytes(
+                committer.ledger.blockstore.get_by_number(1)
+                .metadata.items[META_TXFLAGS])
+            outs.append((flags.codes(), committer.ledger.commit_hash,
+                         committer.ledger.get_state("cc", "a"),
+                         counting.n_items, aborts))
+        finally:
+            v.force_python_collect = False
+    (codes0, hash0, a0, items0, aborts0), \
+        (codes1, hash1, a1, items1, aborts1) = outs
+    assert codes0 == codes1 == [int(ValidationCode.MVCC_READ_CONFLICT),
+                                int(ValidationCode.VALID),
+                                int(ValidationCode.VALID)]
+    assert hash0 == hash1 and a0 == a1 == b"3"
+    assert aborts0 == 0 and aborts1 == 1
+    # the doomed tx's creator+endorser items never reached the device
+    assert items1 < items0

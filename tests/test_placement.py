@@ -308,3 +308,23 @@ def test_mesh_pad_rounds_to_mesh_multiple():
     padded = p._pad(arrays, 130)
     b = padded[0].shape[-1]
     assert b % 8 == 0 and b >= 130
+
+
+def test_scheduler_demand_folds_in_dispatch_backlog():
+    # a flush landing behind unresolved device work reports more
+    # pressure than its batch size alone (provider_dispatch_queue_depth
+    # is folded into the EWMA sample at report time)
+    from fabric_tpu.ops_plane.metrics import registry
+    g = registry.gauge("provider_dispatch_queue_depth",
+                       "device dispatches enqueued, not yet resolved")
+    try:
+        g.set(0.0)
+        ps = _scheduler()
+        ps.provider_for("a", demand=100)
+        assert ps.snapshot()["channels"]["a"]["demand_ewma"] == 100.0
+        g.set(900.0)
+        ps2 = _scheduler()
+        ps2.provider_for("a", demand=100)
+        assert ps2.snapshot()["channels"]["a"]["demand_ewma"] == 1000.0
+    finally:
+        g.set(0.0)

@@ -673,3 +673,67 @@ def test_verify_plane_ops_route(orgs, sw_provider):
     assert out["speculative"] is True
     assert out["trust_attestations"] is True
     assert out["speculative_dispatched"] == 0
+
+
+# -- deliver-time attestations (orderer -> peer) -----------------------------
+
+
+def test_attest_block_emits_digests_only_for_cached_true(sw_provider):
+    from fabric_tpu.verify_plane import attest_block
+    org = DevOrg("Org1")
+    msps = {"Org1": CachedMSP(org.msp())}
+    envs = [_order_env(org), _order_env(org), _order_env(org)]
+    cache = VerdictCache(capacity=64)
+    block = make_block(envs, number=3)
+    assert attest_block(cache, block, "ch", msps) is None  # nothing cached
+    cache.put(creator_item(envs[0], msps), True, scope="ch")
+    cache.put(creator_item(envs[2], msps), False, scope="ch")  # never attested
+    attests = attest_block(cache, block, "ch", msps)
+    assert attests is not None and len(attests) == 3
+    assert attests[0] == item_digest(creator_item(envs[0], msps)).hex()
+    assert attests[1] is None and attests[2] is None
+
+
+def test_accept_block_attestations_rederives_before_seeding(sw_provider):
+    from fabric_tpu.verify_plane import accept_block_attestations
+    org = DevOrg("Org1")
+    msps = {"Org1": CachedMSP(org.msp())}
+    env = _order_env(org)
+    good = item_digest(creator_item(env, msps)).hex()
+    # a forged digest next to the envelope seeds nothing; the correct
+    # digest next to TAMPERED bytes seeds nothing either (the peer
+    # derives from its own bytes, digests diverge)
+    tampered = Envelope(env.payload, env.signature[:-2] + b"\x00\x01")
+    cache = VerdictCache(capacity=64)
+    before = counts()
+    assert accept_block_attestations(
+        cache, make_block([env]), ["ab" * 32], "ch", msps) == 0
+    assert accept_block_attestations(
+        cache, make_block([tampered]), [good], "ch", msps) == 0
+    assert cache.peek(creator_item(env, msps)) is None
+    assert accept_block_attestations(
+        cache, make_block([env]), [good], "ch", msps) == 1
+    assert cache.peek(creator_item(env, msps)) is True
+    assert delta(before, counts())["attested"] == 1
+
+
+def test_attest_roundtrip_skips_peer_device_verify(sw_provider):
+    """Orderer caches an admission verdict -> attests it on deliver ->
+    peer seeds its cache -> the peer-side CachingProvider answers the
+    commit-gate dispatch without touching the device."""
+    from fabric_tpu.verify_plane import accept_block_attestations, attest_block
+    org = DevOrg("Org1")
+    msps = {"Org1": CachedMSP(org.msp())}
+    env = _order_env(org)
+    block = make_block([env], number=7)
+    orderer_cache = VerdictCache(capacity=64, owner="orderer")
+    orderer_cache.put(creator_item(env, msps), True, scope="ch")
+    attests = attest_block(orderer_cache, block, "ch", msps)
+
+    peer_cache = VerdictCache(capacity=64, owner="peer")
+    assert accept_block_attestations(peer_cache, block, attests,
+                                     "ch", msps) == 1
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    cp = CachingProvider(inner, peer_cache, site="committer", scope="ch")
+    verdicts = cp.batch_verify([creator_item(env, msps)])
+    assert bool(verdicts.all()) and inner.dispatched == 0
