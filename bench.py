@@ -492,6 +492,87 @@ def bench_commit_stage(n_tx: int = 300, n_blocks: int = 4) -> dict:
     return det
 
 
+def bench_ingest(n_tx: int = 200, n_blocks: int = 8) -> dict:
+    """Ingest-stage (r09 zero-copy) throughput: raw wire bytes -> parsed
+    block, native C parser (wire.parse_block -> BlockView over an arena
+    span table) vs the displaced Python path (Block.deserialize, one
+    Envelope object per tx).  Pure host work — no device, no signature
+    verification — so the pair is honest on any box.  Also records the
+    per-tx Python allocation counts the zero-copy claim rests on
+    (sys.getallocatedblocks around one parse; the native arena lives in
+    PyMem_RawMalloc and correctly does not show up there)."""
+    import gc
+    import statistics as _stats
+    import time as _time
+
+    from fabric_tpu.msp.ca import DevOrg
+    from fabric_tpu.protocol import (KVWrite, NsRwSet, TxRwSet, build,
+                                     wire)
+    from fabric_tpu.protocol.types import (Block, BlockHeader,
+                                           BlockMetadata, block_data_hash)
+
+    det: dict = {"ingest_block_txs": n_tx, "ingest_blocks": n_blocks}
+    if wire._fastparse is None:
+        det["ingest_error"] = "native _fastparse unavailable"
+        return det
+
+    org = DevOrg("Org1")
+    rwset = TxRwSet((NsRwSet("cc", writes=(KVWrite("k", b"v"),)),))
+    env = build.endorser_tx("ch", "cc", "1.0", rwset, org.admin,
+                            [org.admin]).serialize()
+    raws = []
+    for b in range(n_blocks):
+        data = [env] * n_tx
+        raws.append(Block(BlockHeader(b, b"\x00" * 32,
+                                      block_data_hash(data)),
+                          data, BlockMetadata()).serialize())
+
+    def run(parse):
+        parse(raws[0])                       # warm (arena pool / caches)
+        per_block = []
+        for _ in range(3):
+            for raw in raws:
+                t0 = _time.perf_counter()
+                blk = parse(raw)
+                per_block.append(_time.perf_counter() - t0)
+                assert blk is not None
+        p50 = _stats.median(per_block)
+        gc.collect()
+        gc.disable()
+        try:
+            before = sys.getallocatedblocks()
+            keep = parse(raws[0])
+            allocs = sys.getallocatedblocks() - before
+        finally:
+            gc.enable()
+        del keep
+        return n_tx / p50, p50, allocs
+
+    nat_rate, nat_p50, nat_allocs = run(wire.parse_block)
+    py_rate, py_p50, py_allocs = run(Block.deserialize)
+    det.update({
+        "ingest_native_envs_per_sec": round(nat_rate, 1),
+        "ingest_python_envs_per_sec": round(py_rate, 1),
+        "ingest_parse_speedup": round(nat_rate / py_rate, 2),
+        "ingest_native_parse_p50_ms": round(nat_p50 * 1e3, 3),
+        "ingest_python_parse_p50_ms": round(py_p50 * 1e3, 3),
+        "ingest_native_allocs_per_block": int(nat_allocs),
+        "ingest_python_allocs_per_block": int(py_allocs),
+    })
+
+    # envelope header peek (the gateway submit path's summary extractor)
+    for name, fn in (("native", wire.envelope_summary),
+                     ("python", wire.envelope_summary_py)):
+        t0 = _time.perf_counter()
+        reps = 2000
+        for _ in range(reps):
+            assert fn(env) is not None
+        det[f"ingest_summary_{name}_envs_per_sec"] = round(
+            reps / (_time.perf_counter() - t0), 1)
+    det["ingest_parser_stats"] = wire._fastparse.stats()
+    return det
+
+
 def _kernel_name() -> str:
     import jax
     if jax.default_backend() == "cpu":
@@ -722,6 +803,17 @@ def main():
                     "for a virtual-mesh dry run")
         except Exception as exc:
             detail["window_sharded_error"] = str(exc)[:200]
+
+    # -- ingest stage: native wire parser vs Python materializer -------------
+    # (ISSUE r09 proof point: raw-bytes -> parsed-block pair, native
+    # arena/span parser vs Block.deserialize, plus the per-parse Python
+    # allocation counts.  Host-only — honest on any box.)
+    if os.environ.get("BENCH_SKIP_INGEST") != "1":
+        try:
+            ingest_tx = int(os.environ.get("BENCH_INGEST_TXS", "200"))
+            detail.update(bench_ingest(n_tx=ingest_tx))
+        except Exception as exc:
+            detail["ingest_error"] = str(exc)[:200]
 
     # -- commit-stage MVCC: serial oracle vs wavefront scheduler -------------
     # (ISSUE 8 proof point: same block stream through both planes, with

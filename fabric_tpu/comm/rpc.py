@@ -58,10 +58,18 @@ def _send_frame(ch: SecureChannel, frame: dict, method: str,
 
 
 class RpcConnection:
-    """Client side: concurrent requests over one channel."""
+    """Client side: concurrent requests over one channel.
 
-    def __init__(self, channel: SecureChannel):
+    stream_views=True decodes incoming frames with serde.decode_views:
+    bytes values arrive as read-only memoryviews into the received frame
+    buffer instead of copies.  Opt-in per connection — only consumers
+    that treat frame bytes as immutable spans (the deliver stream's
+    zero-copy block ingest) should ask for it.
+    """
+
+    def __init__(self, channel: SecureChannel, stream_views: bool = False):
         self.channel = channel
+        self.stream_views = bool(stream_views)
         self._next_id = 1
         self._lock = threading.Lock()
         self._waiters: Dict[int, "_Waiter"] = {}
@@ -70,9 +78,10 @@ class RpcConnection:
         self._reader.start()
 
     def _read_loop(self) -> None:
+        decode = serde.decode_views if self.stream_views else serde.decode
         try:
             while True:
-                msg = serde.decode(self.channel.recv())
+                msg = decode(self.channel.recv())
                 wid = msg.get("id")
                 with self._lock:
                     w = self._waiters.get(wid)
@@ -329,5 +338,7 @@ def _observe_rpc(method: str, ok: bool, seconds: float) -> None:
         pass      # metrics must never break the request path
 
 
-def connect(addr, signer, msps: Dict, timeout: float = 10.0) -> RpcConnection:
-    return RpcConnection(dial(addr, signer, msps, timeout=timeout))
+def connect(addr, signer, msps: Dict, timeout: float = 10.0,
+            stream_views: bool = False) -> RpcConnection:
+    return RpcConnection(dial(addr, signer, msps, timeout=timeout),
+                         stream_views=stream_views)

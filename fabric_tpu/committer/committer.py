@@ -16,6 +16,7 @@ from fabric_tpu.ledger import KVLedger
 from fabric_tpu.ops_plane import tracing
 from fabric_tpu.ops_plane.logging import jlog
 from fabric_tpu.protocol import Block
+from fabric_tpu.protocol.wire import n_txs
 
 from .txvalidator import TxValidator, ValidationResult
 
@@ -64,7 +65,7 @@ class Committer:
                 "committer.store_block",
                 attributes={"channel": self.validator.channel_id,
                             "block": int(block.header.number),
-                            "txs": len(block.data)}) as span:
+                            "txs": n_txs(block)}) as span:
             result = self._store_block_inner(block)
             if span.recording:
                 span.set_attribute("valid",
@@ -296,7 +297,7 @@ class Committer:
                 "committed_blocks_total", "blocks committed").add(1, channel=ch)
             registry.counter(
                 "committed_txs_total", "txs committed").add(
-                    len(block.data), channel=ch)
+                    n_txs(block), channel=ch)
             registry.gauge("ledger_height", "block height").set(
                 self.ledger.height, channel=ch)
         except Exception:

@@ -27,6 +27,7 @@ thread only.
 from __future__ import annotations
 
 import time
+from collections import deque
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Tuple
 
@@ -62,26 +63,64 @@ def _validate_tx(db: StateDB, batch: UpdateBatch, rwset) -> Optional[int]:
 
 
 class ParallelCommitScheduler:
-    """One per ledger (channel); owns the worker pool."""
+    """One per ledger (channel); owns the worker pool.
 
-    def __init__(self, max_workers: int = 4, channel_id: str = ""):
+    Pool sizing is adaptive: `max_workers` is the static OVERRIDE CAP,
+    and the pool actually provisioned tracks the rolling maximum of the
+    observed conflict-graph wave widths (workers beyond the widest wave
+    can never have work).  Low-contention channels whose blocks fan out
+    wide grow toward the cap; serial workloads (chained writes, single
+    hot key) idle at a one-thread pool instead of parking cap-1 threads
+    per channel.  `adaptive=False` pins the pool at the cap (the
+    pre-adaptive behavior)."""
+
+    def __init__(self, max_workers: int = 4, channel_id: str = "",
+                 adaptive: bool = True, width_window: int = 32):
         self.max_workers = max(1, int(max_workers))
         self.channel_id = channel_id
+        self.adaptive = bool(adaptive)
+        # rolling window of per-block max wave widths (the demand signal)
+        self._widths: deque = deque(maxlen=max(1, int(width_window)))
         self._pool: Optional[ThreadPoolExecutor] = None
+        self._pool_size = 0
         # last-block stats, surfaced by the committer
         self.last_waves = 0
         self.last_edges = 0
         self.last_max_width = 0
 
-    def _executor(self) -> ThreadPoolExecutor:
+    def target_workers(self, width: int) -> int:
+        """Worker count for a block whose widest wave is `width`: the
+        rolling demand maximum, clamped to [1, max_workers]."""
+        self._widths.append(int(width))
+        if not self.adaptive:
+            return self.max_workers
+        return max(1, min(self.max_workers, max(self._widths)))
+
+    def _executor(self, workers: int) -> ThreadPoolExecutor:
+        if self._pool is not None and self._pool_size != workers:
+            # ThreadPoolExecutor cannot resize: swap pools.  The rolling
+            # window damps churn — shrink happens only after width_window
+            # consecutive narrower blocks age the wide ones out.
+            self._pool.shutdown(wait=False)
+            self._pool = None
         if self._pool is None:
             self._pool = ThreadPoolExecutor(
-                max_workers=self.max_workers,
+                max_workers=workers,
                 thread_name_prefix=f"mvcc-{self.channel_id}")
+            self._pool_size = workers
+            try:
+                from fabric_tpu.ops_plane import registry
+                registry.gauge(
+                    "commit_workers_effective",
+                    "adaptive MVCC pool size (cap: commit_workers)").set(
+                        workers, channel=self.channel_id)
+            except Exception:
+                pass
         return self._pool
 
     def close(self) -> None:
         pool, self._pool = self._pool, None
+        self._pool_size = 0
         if pool is not None:
             pool.shutdown(wait=False)
 
@@ -124,8 +163,9 @@ class ParallelCommitScheduler:
                  for tx_num, txid, rwset, writes in parsed}
         working = UpdateBatch()
         valid: Dict[int, bool] = {}
-        pool = (self._executor()
-                if self.max_workers > 1 and graph.max_wave_width > 1
+        workers = self.target_workers(graph.max_wave_width)
+        pool = (self._executor(workers)
+                if workers > 1 and graph.max_wave_width > 1
                 else None)
         for wave in graph.waves:
             tw = time.perf_counter()

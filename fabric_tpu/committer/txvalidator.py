@@ -43,6 +43,7 @@ from fabric_tpu.policy import PolicyEvaluator, SignaturePolicy, SignedData
 from fabric_tpu.protocol import Block
 from fabric_tpu.protocol.txflags import TxFlags, ValidationCode
 from fabric_tpu.protocol.types import META_TXFLAGS
+from fabric_tpu.protocol.wire import n_txs
 
 logger = logging.getLogger("fabric_tpu.committer")
 
@@ -588,7 +589,7 @@ class TxValidator:
                                   "1000000000"))
 
     def _begin_inner(self, block: Block) -> dict:
-        n = len(block.data)
+        n = n_txs(block)
         # duplicate-txid oracle widened by the in-flight window: a txid
         # in an earlier block the ledger cannot see yet is a duplicate
         # here.  Prune entries the ledger now covers (committed) and
@@ -736,13 +737,23 @@ class TxValidator:
         the streamed window.  Flag parity with the classic tail and the
         pure-Python mirror is enforced differentially
         (tests/test_committer.py)."""
-        n = len(block.data)
+        n = n_txs(block)
         t0 = time.perf_counter()
         oracle = self.ledger_has_txid
         if oracle is _false_oracle:
             oracle = None          # unwired: skip the per-tx call in C
-        codes, seen_txids, works, creators, endorsers = _fastcollect.digest(
-            block.data, self.channel_id, carry, oracle)
+        spans = getattr(block, "data_spans", None)
+        if spans is not None and hasattr(_fastcollect, "digest_spans"):
+            # zero-copy ingest: the envelopes are consumed as spans of
+            # the block's raw wire bytes (protocol/wire.py BlockView) —
+            # no per-tx bytes objects ever exist on this path
+            codes, seen_txids, works, creators, endorsers = \
+                _fastcollect.digest_spans(spans[0], spans[1],
+                                          self.channel_id, carry, oracle)
+        else:
+            codes, seen_txids, works, creators, endorsers = \
+                _fastcollect.digest(block.data, self.channel_id, carry,
+                                    oracle)
         if doomed:
             # early abort on the deep path: DROP the work tuple (assemble
             # interns every work's items regardless of its code, and gate
@@ -951,7 +962,7 @@ class TxValidator:
             "[%s] validated block %d: %d/%d valid | collect=%.1fms "
             "dispatch=%.1fms (%d uniq sigs) gate=%.1fms",
             self.channel_id, block.header.number, flags.valid_count(),
-            len(block.data), collect_s * 1e3, dispatch_s * 1e3,
+            n_txs(block), collect_s * 1e3, dispatch_s * 1e3,
             len(index), gate_s * 1e3)
         return ValidationResult(flags, collect_s, dispatch_s, gate_s,
                                 state["n_refs"], len(index))

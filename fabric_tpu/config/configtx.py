@@ -81,7 +81,8 @@ def config_envelope_of(block) -> Optional[Envelope]:
     Shared by the committer and apply_config_block so the rule cannot
     drift.
     """
-    if len(block.data) != 1:
+    from fabric_tpu.protocol.wire import n_txs
+    if n_txs(block) != 1:
         return None
     try:
         env = Envelope.deserialize(block.data[0])

@@ -335,7 +335,11 @@ class BatchBroadcaster:
                 # _connection sets self._idx to the dial target before it
                 # can raise, so failure paths charge the right orderer
                 idx, conn = self._connection()
-                body = {"envelopes": [e.serialize() for _, e in pending]}
+                # raw wire bytes pass through untouched (zero-copy submit
+                # path); Envelope objects serialize here as before
+                body = {"envelopes": [
+                    e if isinstance(e, (bytes, bytearray, memoryview))
+                    else e.serialize() for _, e in pending]}
                 if tps and any(tps):
                     body["tps"] = [tps[i] if i < len(tps) else ""
                                    for i, _ in pending]

@@ -30,18 +30,25 @@ from fabric_tpu.gateway.notifier import CommitNotifier
 from fabric_tpu.ops_plane import registry, tracing
 from fabric_tpu.ops_plane.logging import jlog
 from fabric_tpu.protocol import Envelope
+from fabric_tpu.protocol import wire
 from fabric_tpu.protocol.txflags import ValidationCode
 
 logger = logging.getLogger("fabric_tpu.gateway")
 
 
 class _Pending:
-    __slots__ = ("env", "txid", "event", "status", "info", "ctx",
-                 "span_queue")
+    """One admitted submission.  `raw` keeps the client's wire bytes as
+    received — the batcher rebroadcasts those exact bytes and the
+    speculative verifier's native extractor walks them in place, so the
+    covered submit path never materializes an Envelope object."""
 
-    def __init__(self, env: Envelope, txid: str):
-        self.env = env
+    __slots__ = ("raw", "txid", "channel_id", "event", "status", "info",
+                 "ctx", "span_queue")
+
+    def __init__(self, raw: bytes, txid: str, channel_id: str):
+        self.raw = raw
         self.txid = txid
+        self.channel_id = channel_id
         self.event = threading.Event()
         self.status = 0
         self.info = ""
@@ -228,12 +235,21 @@ class GatewayService:
         orderer (or the submit timeout lapses with it still queued)."""
         t0 = time.monotonic()
         try:
-            env = Envelope.deserialize(body["envelope"])
-            header = env.header().channel_header
-            txid = header.txid
+            raw = body["envelope"]
+            # native header peek: (type, channel_id, txid) straight off
+            # the wire bytes; a native reject re-runs the full Python
+            # deserialize so malformed submissions fail with the same
+            # exceptions as before
+            summary = wire.envelope_summary(raw)
+            if summary is not None:
+                channel_id, txid = summary[1], summary[2]
+            else:
+                header = Envelope.deserialize(raw).header().channel_header
+                txid = header.txid
+                channel_id = header.channel_id
             if not txid:
                 raise ValueError("envelope has no txid")
-            ch = self.node.channels.get(header.channel_id)
+            ch = self.node.channels.get(channel_id)
             if ch is not None:
                 self._notifier(ch)   # attach before ordering can commit it
             with self._cv:
@@ -249,12 +265,12 @@ class GatewayService:
                         self._m_backpressure.add(1)
                         jlog(logger, "gateway.backpressure",
                              level=logging.WARNING, txid=txid,
-                             channel=header.channel_id,
+                             channel=channel_id,
                              queue_depth=len(self._queue))
                         raise RuntimeError(
                             "gateway admission queue full "
                             f"({self.max_queue}): backpressure, retry later")
-                    pending = _Pending(env, txid)
+                    pending = _Pending(raw, txid, channel_id)
                     self._inflight[txid] = pending
                     self._queue.append(pending)
                     self._m_depth.set(len(self._queue))
@@ -372,16 +388,15 @@ class GatewayService:
             if spec is not None:
                 try:
                     attests = spec.stamp(
-                        [p.env for p in batch],
-                        [p.env.header().channel_header.channel_id
-                         for p in batch],
+                        [p.raw for p in batch],
+                        [p.channel_id for p in batch],
                         spans=spans_order)
                 except Exception:
                     logger.exception("verify-plane ingress stamp failed")
                     attests = None
             try:
                 results = self.broadcaster.broadcast_batch(
-                    [p.env for p in batch], tps=tps, attests=attests)
+                    [p.raw for p in batch], tps=tps, attests=attests)
             except Exception as exc:
                 logger.exception("broadcast batch failed")
                 jlog(logger, "gateway.broadcast_failed",

@@ -58,6 +58,12 @@ class MessageCryptoService:
 
     @staticmethod
     def _data_hash(block: Block) -> bytes:
+        # BlockView exposes the hash over its raw data span — identical
+        # bytes to block_data_hash(block.data) without materializing the
+        # per-envelope list (protocol/wire.py layout fact)
+        pre = getattr(block, "computed_data_hash", None)
+        if pre is not None:
+            return pre
         from fabric_tpu.protocol.types import block_data_hash
         return block_data_hash(block.data)
 

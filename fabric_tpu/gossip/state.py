@@ -14,6 +14,7 @@ import logging
 from typing import Callable, Dict, List, Optional
 
 from fabric_tpu.protocol import Block
+from fabric_tpu.protocol import wire
 
 logger = logging.getLogger("fabric_tpu.gossip.state")
 
@@ -59,7 +60,9 @@ class GossipState:
 
     def _on_block_msg(self, body: dict) -> None:
         try:
-            block = Block.deserialize(body["block"])
+            # native span parse (BlockView) with Block.deserialize
+            # fallback — reject behavior identical, per-tx decode gone
+            block = wire.parse_block(body["block"])
         except (KeyError, ValueError, TypeError):
             return
         if self.mcs is not None and not self.mcs.verify_block(block):

@@ -25,6 +25,7 @@ from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Tuple
 
 from fabric_tpu.protocol import Block, Envelope, block_header_hash
+from fabric_tpu.protocol import wire
 from fabric_tpu.protocol.types import META_TXFLAGS
 from fabric_tpu.protocol.txflags import TxFlags, ValidationCode
 
@@ -120,10 +121,16 @@ class BlockStore:
         self._prev_hash = block.header.previous_hash
         self._cur_hash = h
         for i, env_bytes in enumerate(block.data):
-            try:
-                txid = Envelope.deserialize(env_bytes).header().channel_header.txid
-            except Exception:
-                continue
+            # native header peek; full decode only when it rejects
+            summary = wire.envelope_summary(env_bytes)
+            if summary is not None:
+                txid = summary[2]
+            else:
+                try:
+                    txid = Envelope.deserialize(
+                        env_bytes).header().channel_header.txid
+                except Exception:
+                    continue
             # first writer wins: duplicate txids keep the earliest location
             self._by_txid.setdefault(txid, (num, i))
 

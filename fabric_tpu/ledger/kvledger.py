@@ -80,7 +80,10 @@ class LedgerConfig:
     # uniformly across the peers of a channel only as an operational
     # convention (the OUTPUT is identical; only timing differs).
     parallel_commit: bool = False
-    commit_workers: int = 4
+    commit_workers: int = 4             # static cap on the worker pool
+    # adaptive sizing: the pool tracks the rolling max conflict-graph
+    # wave width, clamped to commit_workers (scheduler.target_workers)
+    commit_adaptive: bool = True
 
 
 @dataclass
@@ -120,7 +123,8 @@ class KVLedger:
                 ParallelCommitScheduler)
             self._commit_scheduler = ParallelCommitScheduler(
                 max_workers=self.config.commit_workers,
-                channel_id=channel_id)
+                channel_id=channel_id,
+                adaptive=self.config.commit_adaptive)
         self._recover()
 
     # -- recovery (recovery.go) --------------------------------------------

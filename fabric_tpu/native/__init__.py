@@ -9,6 +9,7 @@ set FABRIC_TPU_NO_NATIVE=1 to force the pure-Python fallbacks.
 Current extensions:
   _ftlv        — the canonical serde codec (fabric_tpu/utils/serde.py)
   _fastcollect — txvalidator pass-1 block walker + SHA-256 (SHA-NI)
+  _fastparse   — zero-copy wire ingest: block/envelope span parser
 """
 
 from __future__ import annotations
@@ -33,7 +34,12 @@ def _build(name: str):
         cc = os.environ.get("CC", "cc")
         inc = sysconfig.get_path("include")
         tmp = so + f".tmp{os.getpid()}"
-        cmd = [cc, "-O3", "-shared", "-fPIC", f"-I{inc}", src, "-o", tmp]
+        # warnings are errors: a diagnostic in accelerator-adjacent C is
+        # a bug report, and silent ones rot (tests/smoke.sh also runs an
+        # ASan/UBSan build of the parser over the fuzz corpus)
+        cmd = [cc, "-O3", "-shared", "-fPIC",
+               "-Wall", "-Wextra", "-Werror",
+               f"-I{inc}", src, "-o", tmp]
         subprocess.run(cmd, check=True, capture_output=True)
         os.replace(tmp, so)    # atomic: concurrent builders race benignly
     return importlib.import_module(f"fabric_tpu.native.{name}")

@@ -1017,6 +1017,7 @@ static PyObject *collect_env(const uint8_t *env, size_t env_n,
 
 static PyObject *py_collect(PyObject *self, PyObject *args)
 {
+    (void)self;
     PyObject *envs;
     const char *chan;
     Py_ssize_t chan_n;
@@ -1042,21 +1043,31 @@ static PyObject *py_collect(PyObject *self, PyObject *args)
             Py_END_ALLOW_THREADS
         }
         PyObject *env = PySequence_Fast_GET_ITEM(seq, i);
-        const uint8_t *p;
-        Py_ssize_t en;
         PyObject *r;
         if (env == Py_None) {
             r = PyLong_FromLong(E_NIL_ENVELOPE);
-        } else {
+        } else if (PyBytes_Check(env)) {
             char *cp;
+            Py_ssize_t en;
             if (PyBytes_AsStringAndSize(env, &cp, &en) < 0) {
                 Py_DECREF(seq);
                 Py_DECREF(out);
                 return NULL;
             }
-            p = (const uint8_t *)cp;
-            r = collect_env(p, (size_t)en, (const uint8_t *)chan,
-                            (size_t)chan_n);
+            r = collect_env((const uint8_t *)cp, (size_t)en,
+                            (const uint8_t *)chan, (size_t)chan_n);
+        } else {
+            /* any contiguous buffer (memoryview span from the zero-copy
+             * ingest path) — same walk, no intermediate bytes copy */
+            Py_buffer vb;
+            if (PyObject_GetBuffer(env, &vb, PyBUF_CONTIG_RO) < 0) {
+                Py_DECREF(seq);
+                Py_DECREF(out);
+                return NULL;
+            }
+            r = collect_env((const uint8_t *)vb.buf, (size_t)vb.len,
+                            (const uint8_t *)chan, (size_t)chan_n);
+            PyBuffer_Release(&vb);
         }
         if (!r) {
             Py_DECREF(seq);
@@ -1259,21 +1270,21 @@ static PyObject *digest_actions(PyObject *acts, PyObject *emap,
  * VC_NOT_VALIDATED (254) for live works.  works[j] =
  * (tx_num, txtype, creator_slot, payload, pdigest, signature, acts|None);
  * creators/endorsers are first-seen-ordered unique identity bytes whose
- * MSP resolution the Python caller performs once per slot. */
-static PyObject *py_digest(PyObject *self, PyObject *args)
+ * MSP resolution the Python caller performs once per slot.
+ *
+ * Two envelope sources share one implementation: a Python sequence of
+ * bytes objects (digest(), the classic entry), or a zero-copy span
+ * table over one base buffer (digest_spans(), fed straight from
+ * native/fastparse.c block parses — no per-tx bytes objects exist). */
+static PyObject *digest_impl(PyObject *seq,
+                             const uint8_t *base, size_t base_n,
+                             const uint8_t *spans, Py_ssize_t nspans,
+                             const char *chan, Py_ssize_t chan_n,
+                             PyObject *carry_in, PyObject *oracle)
 {
-    PyObject *envs, *carry_in, *oracle;
-    const char *chan;
-    Py_ssize_t chan_n;
-    if (!PyArg_ParseTuple(args, "Os#OO", &envs, &chan, &chan_n,
-                          &carry_in, &oracle))
-        return NULL;
-    PyObject *seq = NULL, *carry = NULL, *codes = NULL, *seen = NULL,
+    PyObject *carry = NULL, *codes = NULL, *seen = NULL,
              *works = NULL, *creators = NULL, *endorsers = NULL,
              *cmap = NULL, *emap = NULL, *ret = NULL;
-    seq = PySequence_Fast(envs, "digest() needs a sequence");
-    if (!seq)
-        return NULL;
     carry = PySequence_List(carry_in);
     if (!carry)
         goto done;
@@ -1284,7 +1295,7 @@ static PyObject *py_digest(PyObject *self, PyObject *args)
                             "digest() carry entries must be dicts");
             goto done;
         }
-    Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+    Py_ssize_t n = seq ? PySequence_Fast_GET_SIZE(seq) : nspans;
     codes = PyByteArray_FromStringAndSize(NULL, n);
     seen = PyDict_New();
     works = PyList_New(0);
@@ -1303,18 +1314,29 @@ static PyObject *py_digest(PyObject *self, PyObject *args)
             Py_BEGIN_ALLOW_THREADS
             Py_END_ALLOW_THREADS
         }
-        PyObject *env = PySequence_Fast_GET_ITEM(seq, i);
         PyObject *rec;
-        if (env == Py_None) {
-            cp[i] = FC2VC[E_NIL_ENVELOPE];
-            continue;
-        }
-        {
+        if (seq) {
+            PyObject *env = PySequence_Fast_GET_ITEM(seq, i);
+            if (env == Py_None) {
+                cp[i] = FC2VC[E_NIL_ENVELOPE];
+                continue;
+            }
             char *ep;
             Py_ssize_t en;
             if (PyBytes_AsStringAndSize(env, &ep, &en) < 0)
                 goto done;
             rec = collect_env((const uint8_t *)ep, (size_t)en,
+                              (const uint8_t *)chan, (size_t)chan_n);
+        } else {
+            uint64_t off, ln;
+            memcpy(&off, spans + 16 * i, 8);
+            memcpy(&ln, spans + 16 * i + 8, 8);
+            if (off > base_n || ln > base_n - off) {
+                PyErr_SetString(PyExc_ValueError,
+                                "digest_spans: span out of range");
+                goto done;
+            }
+            rec = collect_env(base + off, (size_t)ln,
                               (const uint8_t *)chan, (size_t)chan_n);
         }
         if (!rec)
@@ -1399,7 +1421,6 @@ static PyObject *py_digest(PyObject *self, PyObject *args)
     PyTuple_SET_ITEM(ret, 4, endorsers);
     codes = seen = works = creators = endorsers = NULL;
 done:
-    Py_XDECREF(seq);
     Py_XDECREF(carry);
     Py_XDECREF(codes);
     Py_XDECREF(seen);
@@ -1408,6 +1429,60 @@ done:
     Py_XDECREF(endorsers);
     Py_XDECREF(cmap);
     Py_XDECREF(emap);
+    return ret;
+}
+
+static PyObject *py_digest(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *envs, *carry_in, *oracle;
+    const char *chan;
+    Py_ssize_t chan_n;
+    if (!PyArg_ParseTuple(args, "Os#OO", &envs, &chan, &chan_n,
+                          &carry_in, &oracle))
+        return NULL;
+    PyObject *seq = PySequence_Fast(envs, "digest() needs a sequence");
+    if (!seq)
+        return NULL;
+    PyObject *ret = digest_impl(seq, NULL, 0, NULL, 0, chan, chan_n,
+                                carry_in, oracle);
+    Py_DECREF(seq);
+    return ret;
+}
+
+/* digest_spans(base, spans, channel_id, carry, oracle) — identical
+ * result to digest([base[off:off+len] for off, len in spans], ...) but
+ * the envelopes are consumed in place: `spans` is a buffer of
+ * native-endian (u64 off, u64 len) pairs into `base` (the layout
+ * fastparse.parse_block emits). */
+static PyObject *py_digest_spans(PyObject *self, PyObject *args)
+{
+    (void)self;
+    PyObject *base_o, *spans_o, *carry_in, *oracle;
+    const char *chan;
+    Py_ssize_t chan_n;
+    if (!PyArg_ParseTuple(args, "OOs#OO", &base_o, &spans_o, &chan,
+                          &chan_n, &carry_in, &oracle))
+        return NULL;
+    Py_buffer base_v, spans_v;
+    if (PyObject_GetBuffer(base_o, &base_v, PyBUF_CONTIG_RO) < 0)
+        return NULL;
+    if (PyObject_GetBuffer(spans_o, &spans_v, PyBUF_CONTIG_RO) < 0) {
+        PyBuffer_Release(&base_v);
+        return NULL;
+    }
+    PyObject *ret = NULL;
+    if (spans_v.len % 16) {
+        PyErr_SetString(PyExc_ValueError,
+                        "digest_spans: spans length not a multiple of 16");
+    } else {
+        ret = digest_impl(NULL, (const uint8_t *)base_v.buf,
+                          (size_t)base_v.len,
+                          (const uint8_t *)spans_v.buf, spans_v.len / 16,
+                          chan, chan_n, carry_in, oracle);
+    }
+    PyBuffer_Release(&spans_v);
+    PyBuffer_Release(&base_v);
     return ret;
 }
 
@@ -1473,6 +1548,7 @@ static Py_ssize_t intern_item(PyObject *index, PyObject *item)
  * matching _finish_inner's accounting. */
 static PyObject *py_assemble(PyObject *self, PyObject *args)
 {
+    (void)self;
     PyObject *works, *c_ents, *e_ents, *endorsers, *codes, *index,
              *plans, *cls, *scheme, *policy_for, *pol_cache;
     if (!PyArg_ParseTuple(args, "OOOOOOOOOOO", &works, &c_ents, &e_ents,
@@ -1681,6 +1757,7 @@ static PyObject *py_assemble(PyObject *self, PyObject *args)
  * ENDORSEMENT_POLICY_FAILURE, else VALID. */
 static PyObject *py_gate(PyObject *self, PyObject *args)
 {
+    (void)self;
     PyObject *plans, *codes, *plugin, *evaluator, *eval_cache;
     Py_buffer vb;
     if (!PyArg_ParseTuple(args, "Oy*OOOO", &plans, &vb, &codes, &plugin,
@@ -1831,6 +1908,7 @@ static int der_int32(const uint8_t **pp, const uint8_t *end, uint8_t out[32])
 
 static PyObject *py_parse_der_sigs(PyObject *self, PyObject *args)
 {
+    (void)self;
     PyObject *sigs;
     if (!PyArg_ParseTuple(args, "O", &sigs))
         return NULL;
@@ -1876,6 +1954,7 @@ static PyObject *py_parse_der_sigs(PyObject *self, PyObject *args)
 
 static PyObject *py_sha256(PyObject *self, PyObject *args)
 {
+    (void)self;
     Py_buffer buf;
     if (!PyArg_ParseTuple(args, "y*", &buf))
         return NULL;
@@ -1891,6 +1970,9 @@ static PyMethodDef methods[] = {
     {"digest", py_digest, METH_VARARGS,
      "digest(envs, channel_id, carry, oracle) -> "
      "(codes, seen, works, creators, endorsers)"},
+    {"digest_spans", py_digest_spans, METH_VARARGS,
+     "digest_spans(base, spans, channel_id, carry, oracle) -> "
+     "digest() over zero-copy (u64 off, u64 len) spans into base"},
     {"assemble", py_assemble, METH_VARARGS,
      "assemble(works, c_ents, e_ents, endorsers, codes, index, plans, "
      "verify_item_cls, scheme_p256, policy_for, pol_cache) -> n_refs"},
@@ -1903,7 +1985,8 @@ static PyMethodDef methods[] = {
 
 static struct PyModuleDef moddef = {
     PyModuleDef_HEAD_INIT, "_fastcollect",
-    "C pass-1 block collection (txvalidator hot path)", -1, methods};
+    "C pass-1 block collection (txvalidator hot path)", -1, methods,
+    NULL, NULL, NULL, NULL};
 
 PyMODINIT_FUNC PyInit__fastcollect(void)
 {

@@ -1,0 +1,671 @@
+/* Zero-copy wire ingest — native block/envelope span parser.
+ *
+ * fastcollect.c took over txvalidator pass 1 *after* Python had already
+ * decoded the block container and materialized a list of per-envelope
+ * bytes objects.  This module moves the C plane one layer up, to the
+ * wire: it takes the raw FTLV frame bytes (fabric_tpu/utils/serde.py
+ * format) of a whole Block or a single Envelope and extracts the byte
+ * SPANS the rest of the pipeline needs — without creating any per-tx
+ * Python object.  The envelope span table is written into an
+ * arena-allocated, ring-pooled buffer so steady-state block ingest does
+ * not call malloc at all.
+ *
+ * Exported:
+ *   parse_block(buf) -> (number, previous_hash, data_hash,
+ *                        data_off, data_end, n, spans, meta_val_off)
+ *                       | None
+ *     buf must be EXACTLY the canonical encoding of
+ *       {"data": [bytes, ...], "header": {"data_hash": bytes,
+ *        "number": i64, "previous_hash": bytes}, "metadata": {...}}
+ *     (strict canonical form throughout: sorted unique dict keys,
+ *     minimal 'V' ints, valid UTF-8, nesting <= MAX_DEPTH, no trailing
+ *     bytes — the same rules serde.decode enforces).  Anything else
+ *     returns None and the caller falls back to Block.deserialize, so
+ *     accept/reject behavior of the system never changes — only who
+ *     does the work.
+ *       spans        arena buffer of n (u64 off, u64 len) native-endian
+ *                    pairs: block.data[i] == buf[off:off+len]
+ *       data_off/end span of the whole data LIST value, so
+ *                    sha256(buf[data_off:data_end]) ==
+ *                    block_data_hash(block.data) bit-identically
+ *       meta_val_off offset where the metadata VALUE begins; because
+ *                    "metadata" is the last key of the sorted top dict,
+ *                    buf[:meta_val_off] + serde.encode(metadata_dict)
+ *                    re-serializes a metadata-mutated block by splice
+ *   envelope_summary(buf) -> (type, channel_id, txid) | None
+ *     the gateway submit path's header peek: what
+ *     Envelope.deserialize(buf).header().channel_header would yield,
+ *     without building the Envelope/Header object trees.  None on any
+ *     deviation from the strict shape (caller falls back).
+ *   stats() -> dict of arena-pool and accept/reject counters
+ *
+ * Arena lifecycle: parse_block writes the span table into an Arena
+ * object (read-only buffer protocol).  When the Arena's refcount drops
+ * to zero its backing buffer is pushed onto a small ring free-list
+ * (FP_POOL entries) and the next parse_block reuses it; only pool
+ * overflow frees.  All pool operations run under the GIL (parse holds
+ * it throughout; tp_dealloc always has it), so no extra locking.
+ */
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+/* ------------------------------------------------------------------ */
+/* FTLV cursor (format: fabric_tpu/utils/serde.py; walker idiom shared
+ * with native/fastcollect.c — the two must enforce identical rules)    */
+
+typedef struct {
+    const uint8_t *p;
+    const uint8_t *end;
+} cur_t;
+
+static int rd_u32(cur_t *c, uint32_t *out)
+{
+    if (c->end - c->p < 4) return -1;
+    *out = ((uint32_t)c->p[0] << 24) | ((uint32_t)c->p[1] << 16)
+         | ((uint32_t)c->p[2] << 8) | c->p[3];
+    c->p += 4;
+    return 0;
+}
+
+#define MAX_DEPTH 64
+
+/* strict UTF-8 (CPython decoder semantics: no overlongs, no
+ * surrogates, max U+10FFFF) */
+static int utf8_ok(const uint8_t *p, uint32_t n)
+{
+    uint32_t i = 0;
+    while (i < n) {
+        uint8_t b = p[i];
+        if (b < 0x80) { i++; continue; }
+        if (b < 0xC2) return 0;
+        if (b < 0xE0) {
+            if (n - i < 2 || (p[i+1] & 0xC0) != 0x80) return 0;
+            i += 2; continue;
+        }
+        if (b < 0xF0) {
+            if (n - i < 3) return 0;
+            uint8_t b1 = p[i+1], b2 = p[i+2];
+            if ((b1 & 0xC0) != 0x80 || (b2 & 0xC0) != 0x80) return 0;
+            if (b == 0xE0 && b1 < 0xA0) return 0;
+            if (b == 0xED && b1 >= 0xA0) return 0;
+            i += 3; continue;
+        }
+        if (b < 0xF5) {
+            if (n - i < 4) return 0;
+            uint8_t b1 = p[i+1], b2 = p[i+2], b3 = p[i+3];
+            if ((b1 & 0xC0) != 0x80 || (b2 & 0xC0) != 0x80
+                || (b3 & 0xC0) != 0x80) return 0;
+            if (b == 0xF0 && b1 < 0x90) return 0;
+            if (b == 0xF4 && b1 >= 0x90) return 0;
+            i += 4; continue;
+        }
+        return 0;
+    }
+    return 1;
+}
+
+/* validate one value in strict canonical form (serde.decode rules) */
+static int canon_value_d(cur_t *c, int depth)
+{
+    if (depth > MAX_DEPTH) return -1;
+    if (c->p >= c->end) return -1;
+    uint8_t tag = *c->p++;
+    uint32_t n;
+    switch (tag) {
+    case 'N': case 'T': case 'F':
+        return 0;
+    case 'I':
+        if (c->end - c->p < 8) return -1;
+        c->p += 8;
+        return 0;
+    case 'V':
+        if (rd_u32(c, &n) < 0 || (uint32_t)(c->end - c->p) < n) return -1;
+        if (n < 8 || c->p[0] == 0 || (n == 8 && c->p[0] < 0x80))
+            return -1;
+        c->p += n;
+        return 0;
+    case 'B':
+        if (rd_u32(c, &n) < 0 || (uint32_t)(c->end - c->p) < n) return -1;
+        c->p += n;
+        return 0;
+    case 'S':
+        if (rd_u32(c, &n) < 0 || (uint32_t)(c->end - c->p) < n) return -1;
+        if (!utf8_ok(c->p, n)) return -1;
+        c->p += n;
+        return 0;
+    case 'L':
+        if (rd_u32(c, &n) < 0) return -1;
+        while (n--)
+            if (canon_value_d(c, depth + 1) < 0) return -1;
+        return 0;
+    case 'D': {
+        if (rd_u32(c, &n) < 0) return -1;
+        const uint8_t *prev = NULL;
+        uint32_t prev_n = 0;
+        while (n--) {
+            uint32_t kn;
+            const uint8_t *k;
+            if (rd_u32(c, &kn) < 0
+                || (uint32_t)(c->end - c->p) < kn) return -1;
+            k = c->p;
+            c->p += kn;
+            if (!utf8_ok(k, kn)) return -1;
+            if (prev) {
+                uint32_t m = prev_n < kn ? prev_n : kn;
+                int cmp = memcmp(prev, k, m);
+                if (cmp > 0 || (cmp == 0 && prev_n >= kn)) return -1;
+            }
+            prev = k;
+            prev_n = kn;
+            if (canon_value_d(c, depth + 1) < 0) return -1;
+        }
+        return 0;
+    }
+    default:
+        return -1;
+    }
+}
+
+/* Enter a dict ('D'): entry count out, -1 if not a dict header. */
+static int dict_enter(cur_t *c, uint32_t *count)
+{
+    if (c->p >= c->end || *c->p != 'D') return -1;
+    c->p++;
+    return rd_u32(c, count);
+}
+
+/* Next dict entry's key span (must be valid UTF-8 and strictly greater
+ * than *prev — the canonical-order check other walkers do inline). */
+static int dict_key(cur_t *c, const uint8_t **prev, uint32_t *prev_n,
+                    const uint8_t **key, uint32_t *klen)
+{
+    if (rd_u32(c, klen) < 0 || (uint32_t)(c->end - c->p) < *klen) return -1;
+    *key = c->p;
+    c->p += *klen;
+    if (!utf8_ok(*key, *klen)) return -1;
+    if (*prev) {
+        uint32_t m = *prev_n < *klen ? *prev_n : *klen;
+        int cmp = memcmp(*prev, *key, m);
+        if (cmp > 0 || (cmp == 0 && *prev_n >= *klen)) return -1;
+    }
+    *prev = *key;
+    *prev_n = *klen;
+    return 0;
+}
+
+static int key_is(const uint8_t *key, uint32_t klen, const char *name)
+{
+    size_t n = strlen(name);
+    return klen == n && memcmp(key, name, n) == 0;
+}
+
+/* read a 'B' (bytes) value's content span */
+static int rd_bytes(cur_t *c, const uint8_t **p, uint32_t *n)
+{
+    if (c->p >= c->end || *c->p != 'B') return -1;
+    c->p++;
+    if (rd_u32(c, n) < 0 || (uint32_t)(c->end - c->p) < *n) return -1;
+    *p = c->p;
+    c->p += *n;
+    return 0;
+}
+
+/* read an 'S' (str) value's content span (UTF-8 validated) */
+static int rd_str(cur_t *c, const uint8_t **p, uint32_t *n)
+{
+    if (c->p >= c->end || *c->p != 'S') return -1;
+    c->p++;
+    if (rd_u32(c, n) < 0 || (uint32_t)(c->end - c->p) < *n) return -1;
+    if (!utf8_ok(c->p, *n)) return -1;
+    *p = c->p;
+    c->p += *n;
+    return 0;
+}
+
+/* read an 'I' (fixed i64) value */
+static int rd_i64(cur_t *c, int64_t *out)
+{
+    if (c->p >= c->end || *c->p != 'I') return -1;
+    c->p++;
+    if (c->end - c->p < 8) return -1;
+    uint64_t v = 0;
+    for (int i = 0; i < 8; i++)
+        v = (v << 8) | c->p[i];
+    c->p += 8;
+    *out = (int64_t)v;
+    return 0;
+}
+
+/* ------------------------------------------------------------------ */
+/* Arena: ring-pooled span buffer with read-only buffer protocol       */
+
+#define FP_POOL 8
+
+static struct { uint8_t *buf; size_t cap; } pool[FP_POOL];
+static int pool_n = 0;
+
+static uint64_t st_pool_hit = 0;    /* acquires served from the pool   */
+static uint64_t st_pool_miss = 0;   /* acquires that hit malloc        */
+static uint64_t st_pool_drop = 0;   /* releases freed (pool full)      */
+static uint64_t st_blk_accept = 0;
+static uint64_t st_blk_reject = 0;
+static uint64_t st_env_accept = 0;
+static uint64_t st_env_reject = 0;
+
+typedef struct {
+    PyObject_HEAD
+    uint8_t *buf;
+    size_t cap;
+    Py_ssize_t len;
+} FPArena;
+
+static void arena_dealloc(PyObject *self)
+{
+    FPArena *a = (FPArena *)self;
+    if (a->buf) {
+        if (pool_n < FP_POOL) {
+            pool[pool_n].buf = a->buf;
+            pool[pool_n].cap = a->cap;
+            pool_n++;
+        } else {
+            st_pool_drop++;
+            PyMem_RawFree(a->buf);
+        }
+        a->buf = NULL;
+    }
+    Py_TYPE(self)->tp_free(self);
+}
+
+static int arena_getbuffer(PyObject *self, Py_buffer *view, int flags)
+{
+    FPArena *a = (FPArena *)self;
+    return PyBuffer_FillInfo(view, self, a->buf, a->len, 1, flags);
+}
+
+static PyBufferProcs arena_as_buffer = {
+    arena_getbuffer,
+    NULL,
+};
+
+static Py_ssize_t arena_length(PyObject *self)
+{
+    return ((FPArena *)self)->len;
+}
+
+static PySequenceMethods arena_as_sequence = {
+    .sq_length = arena_length,
+};
+
+static PyTypeObject FPArenaType = {
+    PyVarObject_HEAD_INIT(NULL, 0)
+    .tp_name = "_fastparse.Arena",
+    .tp_basicsize = sizeof(FPArena),
+    .tp_dealloc = arena_dealloc,
+    .tp_flags = Py_TPFLAGS_DEFAULT,
+    .tp_doc = "ring-pooled read-only span buffer",
+    .tp_as_buffer = &arena_as_buffer,
+    .tp_as_sequence = &arena_as_sequence,
+    .tp_new = NULL,                 /* not constructible from Python */
+};
+
+/* round up to the next power of two, >= 256 */
+static size_t round_cap(size_t need)
+{
+    size_t cap = 256;
+    while (cap < need)
+        cap <<= 1;
+    return cap;
+}
+
+static FPArena *arena_acquire(size_t need)
+{
+    uint8_t *buf = NULL;
+    size_t cap = 0;
+    for (int i = 0; i < pool_n; i++) {
+        if (pool[i].cap >= need) {
+            buf = pool[i].buf;
+            cap = pool[i].cap;
+            pool_n--;
+            pool[i] = pool[pool_n];
+            st_pool_hit++;
+            break;
+        }
+    }
+    if (!buf) {
+        cap = round_cap(need);
+        buf = PyMem_RawMalloc(cap);
+        if (!buf) {
+            PyErr_NoMemory();
+            return NULL;
+        }
+        st_pool_miss++;
+    }
+    FPArena *a = PyObject_New(FPArena, &FPArenaType);
+    if (!a) {
+        /* return the buffer to the pool rather than leak/free churn */
+        if (pool_n < FP_POOL) {
+            pool[pool_n].buf = buf;
+            pool[pool_n].cap = cap;
+            pool_n++;
+        } else {
+            PyMem_RawFree(buf);
+        }
+        return NULL;
+    }
+    a->buf = buf;
+    a->cap = cap;
+    a->len = 0;
+    return a;
+}
+
+/* ------------------------------------------------------------------ */
+/* parse_block                                                         */
+
+static PyObject *py_parse_block(PyObject *self, PyObject *arg)
+{
+    (void)self;
+    Py_buffer in;
+    if (PyObject_GetBuffer(arg, &in, PyBUF_CONTIG_RO) < 0)
+        return NULL;
+    const uint8_t *base = in.buf;
+    cur_t c = {base, base + in.len};
+
+    int64_t number = 0;
+    const uint8_t *prev_p = NULL, *dhash_p = NULL;
+    uint32_t prev_n = 0, dhash_n = 0;
+    size_t data_off = 0, data_end = 0, meta_off = 0;
+    uint32_t ndata = 0;
+    FPArena *spans = NULL;
+
+    uint32_t top_n;
+    if (dict_enter(&c, &top_n) < 0 || top_n != 3)
+        goto reject;
+
+    /* --- "data": [bytes, ...] ---------------------------------------- */
+    {
+        const uint8_t *k; uint32_t kn;
+        const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+        if (dict_key(&c, &kprev, &kprev_n, &k, &kn) < 0
+            || !key_is(k, kn, "data"))
+            goto reject;
+        if (c.p >= c.end || *c.p != 'L')
+            goto reject;
+        data_off = (size_t)(c.p - base);
+        c.p++;
+        if (rd_u32(&c, &ndata) < 0)
+            goto reject;
+        /* a genuine n-item list needs >= 5 bytes per 'B' item; a count
+         * this buffer cannot possibly hold would otherwise make us
+         * malloc a huge span table before the walk fails */
+        if ((size_t)ndata > (size_t)in.len / 5)
+            goto reject;
+        spans = arena_acquire(ndata ? (size_t)ndata * 16 : 16);
+        if (!spans)
+            goto error;
+        uint64_t *tab = (uint64_t *)spans->buf;
+        for (uint32_t i = 0; i < ndata; i++) {
+            const uint8_t *bp; uint32_t bn;
+            if (rd_bytes(&c, &bp, &bn) < 0)
+                goto reject;
+            tab[2 * i] = (uint64_t)(bp - base);
+            tab[2 * i + 1] = bn;
+        }
+        spans->len = (Py_ssize_t)ndata * 16;
+        data_end = (size_t)(c.p - base);
+    }
+
+    /* --- "header": {data_hash, number, previous_hash} ----------------- */
+    {
+        const uint8_t *k; uint32_t kn;
+        const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+        if (rd_u32(&c, &kn) < 0 || (uint32_t)(c.end - c.p) < kn)
+            goto reject;
+        k = c.p;
+        c.p += kn;
+        if (!key_is(k, kn, "header"))
+            goto reject;
+        uint32_t hn;
+        if (dict_enter(&c, &hn) < 0 || hn != 3)
+            goto reject;
+        if (dict_key(&c, &kprev, &kprev_n, &k, &kn) < 0
+            || !key_is(k, kn, "data_hash")
+            || rd_bytes(&c, &dhash_p, &dhash_n) < 0)
+            goto reject;
+        if (dict_key(&c, &kprev, &kprev_n, &k, &kn) < 0
+            || !key_is(k, kn, "number")
+            || rd_i64(&c, &number) < 0)
+            goto reject;
+        if (dict_key(&c, &kprev, &kprev_n, &k, &kn) < 0
+            || !key_is(k, kn, "previous_hash")
+            || rd_bytes(&c, &prev_p, &prev_n) < 0)
+            goto reject;
+    }
+
+    /* --- "metadata": any canonical dict, last value in the buffer ----- */
+    {
+        const uint8_t *k; uint32_t kn;
+        if (rd_u32(&c, &kn) < 0 || (uint32_t)(c.end - c.p) < kn)
+            goto reject;
+        k = c.p;
+        c.p += kn;
+        if (!key_is(k, kn, "metadata"))
+            goto reject;
+        meta_off = (size_t)(c.p - base);
+        if (c.p >= c.end || *c.p != 'D')
+            goto reject;
+        if (canon_value_d(&c, 1) < 0)
+            goto reject;
+        if (c.p != c.end)
+            goto reject;
+    }
+
+    {
+        PyObject *res = Py_BuildValue(
+            "(Ly#y#nnIOn)",
+            (long long)number,
+            (const char *)prev_p, (Py_ssize_t)prev_n,
+            (const char *)dhash_p, (Py_ssize_t)dhash_n,
+            (Py_ssize_t)data_off, (Py_ssize_t)data_end,
+            (unsigned int)ndata,
+            (PyObject *)spans,
+            (Py_ssize_t)meta_off);
+        Py_DECREF(spans);
+        PyBuffer_Release(&in);
+        if (res)
+            st_blk_accept++;
+        return res;
+    }
+
+reject:
+    Py_XDECREF(spans);
+    PyBuffer_Release(&in);
+    st_blk_reject++;
+    Py_RETURN_NONE;
+error:
+    Py_XDECREF(spans);
+    PyBuffer_Release(&in);
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
+/* envelope_summary                                                    */
+
+/* Walk a strict-canonical dict; for the single entry whose key matches
+ * `want`, leave a sub-cursor positioned at its value and fully
+ * canon-validate every other entry.  Returns 1 found / 0 not found /
+ * -1 malformed.  The full dict (including the wanted value) is
+ * canonically validated either way. */
+static int dict_find(cur_t *c, const char *want, cur_t *val)
+{
+    uint32_t n;
+    int found = 0;
+    if (dict_enter(c, &n) < 0) return -1;
+    const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+    while (n--) {
+        const uint8_t *k; uint32_t kn;
+        if (dict_key(c, &kprev, &kprev_n, &k, &kn) < 0) return -1;
+        const uint8_t *vstart = c->p;
+        if (canon_value_d(c, 1) < 0) return -1;
+        if (key_is(k, kn, want)) {
+            val->p = vstart;
+            val->end = c->p;
+            found = 1;
+        }
+    }
+    return found;
+}
+
+static PyObject *py_envelope_summary(PyObject *self, PyObject *arg)
+{
+    (void)self;
+    Py_buffer in;
+    if (PyObject_GetBuffer(arg, &in, PyBUF_CONTIG_RO) < 0)
+        return NULL;
+    const uint8_t *base = in.buf;
+    cur_t c = {base, base + in.len};
+
+    const uint8_t *type_p = NULL, *chan_p = NULL, *txid_p = NULL;
+    uint32_t type_n = 0, chan_n = 0, txid_n = 0;
+
+    /* envelope top dict: must contain payload:B and signature; whole
+     * buffer strict canonical with no trailing bytes */
+    cur_t payload_v = {NULL, NULL}, sig_v = {NULL, NULL};
+    {
+        uint32_t n;
+        if (dict_enter(&c, &n) < 0) goto reject;
+        const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+        while (n--) {
+            const uint8_t *k; uint32_t kn;
+            if (dict_key(&c, &kprev, &kprev_n, &k, &kn) < 0) goto reject;
+            const uint8_t *vstart = c.p;
+            if (canon_value_d(&c, 1) < 0) goto reject;
+            if (key_is(k, kn, "payload")) {
+                payload_v.p = vstart;
+                payload_v.end = c.p;
+            } else if (key_is(k, kn, "signature")) {
+                sig_v.p = vstart;
+                sig_v.end = c.p;
+            }
+        }
+        if (c.p != c.end || !payload_v.p || !sig_v.p) goto reject;
+    }
+
+    /* payload must be 'B'; its CONTENT is itself a canonical dict
+     * (what Envelope.payload_dict() decodes) */
+    {
+        const uint8_t *pp; uint32_t pn;
+        if (rd_bytes(&payload_v, &pp, &pn) < 0 || payload_v.p != payload_v.end)
+            goto reject;
+        cur_t pc = {pp, pp + pn};
+
+        cur_t header_v = {NULL, NULL};
+        int r = dict_find(&pc, "header", &header_v);
+        if (r < 0 || pc.p != pc.end || r == 0) goto reject;
+
+        /* header: needs channel_header AND signature_header (mirror:
+         * Header.from_dict KeyErrors without either) */
+        cur_t ch_v = {NULL, NULL}, sh_v = {NULL, NULL};
+        {
+            cur_t hv = header_v;
+            if (dict_find(&hv, "channel_header", &ch_v) != 1) goto reject;
+            hv = header_v;
+            if (dict_find(&hv, "signature_header", &sh_v) != 1) goto reject;
+        }
+        /* signature_header: creator + nonce keys must exist */
+        {
+            cur_t t = sh_v, dummy = {NULL, NULL};
+            if (dict_find(&t, "creator", &dummy) != 1) goto reject;
+            t = sh_v;
+            if (dict_find(&t, "nonce", &dummy) != 1) goto reject;
+        }
+        /* channel_header: type/channel_id/txid strs */
+        {
+            cur_t t = ch_v, v = {NULL, NULL};
+            if (dict_find(&t, "type", &v) != 1
+                || rd_str(&v, &type_p, &type_n) < 0 || v.p != v.end)
+                goto reject;
+            t = ch_v;
+            if (dict_find(&t, "channel_id", &v) != 1
+                || rd_str(&v, &chan_p, &chan_n) < 0 || v.p != v.end)
+                goto reject;
+            t = ch_v;
+            if (dict_find(&t, "txid", &v) != 1
+                || rd_str(&v, &txid_p, &txid_n) < 0 || v.p != v.end)
+                goto reject;
+        }
+    }
+
+    {
+        PyObject *res = Py_BuildValue(
+            "(s#s#s#)",
+            (const char *)type_p, (Py_ssize_t)type_n,
+            (const char *)chan_p, (Py_ssize_t)chan_n,
+            (const char *)txid_p, (Py_ssize_t)txid_n);
+        PyBuffer_Release(&in);
+        if (res)
+            st_env_accept++;
+        return res;
+    }
+
+reject:
+    PyBuffer_Release(&in);
+    st_env_reject++;
+    Py_RETURN_NONE;
+}
+
+/* ------------------------------------------------------------------ */
+/* stats                                                               */
+
+static PyObject *py_stats(PyObject *self, PyObject *noarg)
+{
+    (void)self;
+    (void)noarg;
+    return Py_BuildValue(
+        "{s:K,s:K,s:K,s:i,s:K,s:K,s:K,s:K}",
+        "pool_hit", (unsigned long long)st_pool_hit,
+        "pool_miss", (unsigned long long)st_pool_miss,
+        "pool_drop", (unsigned long long)st_pool_drop,
+        "pool_free", pool_n,
+        "block_accept", (unsigned long long)st_blk_accept,
+        "block_reject", (unsigned long long)st_blk_reject,
+        "env_accept", (unsigned long long)st_env_accept,
+        "env_reject", (unsigned long long)st_env_reject);
+}
+
+/* ------------------------------------------------------------------ */
+
+static PyMethodDef methods[] = {
+    {"parse_block", py_parse_block, METH_O,
+     "parse_block(buf) -> (number, prev_hash, data_hash, data_off, "
+     "data_end, n, spans, meta_val_off) | None"},
+    {"envelope_summary", py_envelope_summary, METH_O,
+     "envelope_summary(buf) -> (type, channel_id, txid) | None"},
+    {"stats", py_stats, METH_NOARGS,
+     "stats() -> arena-pool and accept/reject counters"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef moduledef = {
+    PyModuleDef_HEAD_INIT, "_fastparse",
+    "zero-copy wire-to-device block/envelope span parser", -1, methods,
+    NULL, NULL, NULL, NULL,
+};
+
+PyMODINIT_FUNC PyInit__fastparse(void)
+{
+    if (PyType_Ready(&FPArenaType) < 0)
+        return NULL;
+    PyObject *m = PyModule_Create(&moduledef);
+    if (!m)
+        return NULL;
+    Py_INCREF(&FPArenaType);
+    if (PyModule_AddObject(m, "Arena", (PyObject *)&FPArenaType) < 0) {
+        Py_DECREF(&FPArenaType);
+        Py_DECREF(m);
+        return NULL;
+    }
+    return m;
+}

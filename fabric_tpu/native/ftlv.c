@@ -219,6 +219,7 @@ static int enc(PyObject *v, buf_t *b, int depth) {
 }
 
 static PyObject *py_encode(PyObject *self, PyObject *arg) {
+    (void)self;
     buf_t b;
     if (buf_init(&b) < 0) return PyErr_NoMemory();
     if (enc(arg, &b, 0) < 0) {
@@ -377,6 +378,7 @@ static PyObject *dec(rd_t *r, int depth) {
 }
 
 static PyObject *py_decode(PyObject *self, PyObject *arg) {
+    (void)self;
     Py_buffer view;
     if (PyObject_GetBuffer(arg, &view, PyBUF_CONTIG_RO) < 0) return NULL;
     rd_t r = { (const unsigned char *)view.buf, view.len, 0 };
@@ -400,6 +402,7 @@ static PyMethodDef methods[] = {
 
 static struct PyModuleDef moduledef = {
     PyModuleDef_HEAD_INIT, "_ftlv", "C FTLV codec", -1, methods,
+    NULL, NULL, NULL, NULL,
 };
 
 PyMODINIT_FUNC PyInit__ftlv(void) {

@@ -102,6 +102,15 @@ class OrdererNode:
         self.bundle_source = BundleSource(Bundle(channel_cfg))
         msps = self.bundle_source.current().msps
         self.data_dir = data_dir
+        # per-gateway standing registry (verify_plane/trust.py): which
+        # allowlisted attestors are still honoured.  Persisted under the
+        # data dir so a digest-mismatch revocation survives restarts.
+        self.attestor_trust = None
+        if self._trust_attestations and self._attestors:
+            import os
+            from fabric_tpu.verify_plane import AttestorTrust
+            self.attestor_trust = AttestorTrust(
+                os.path.join(data_dir, "attestor_trust.json"))
 
         self.registrar = Registrar()
         self.raft_id = int(cfg["raft_id"])
@@ -195,7 +204,13 @@ class OrdererNode:
                     self.ops, self.verify_cache,
                     extra=lambda: {
                         "trust_attestations": self._trust_attestations,
-                        "attestors": len(self._attestors)})
+                        "attestors": len(self._attestors),
+                        "attestors_revoked": (
+                            self.attestor_trust.revoked_count()
+                            if self.attestor_trust is not None else 0),
+                        "attestor_standing": (
+                            self.attestor_trust.snapshot()
+                            if self.attestor_trust is not None else {})})
             self.ops.register_route("GET", "/participation/v1/channels",
                                     self._rest_channels)
             # the ops server is PLAIN HTTP with no client auth, so the
@@ -318,6 +333,7 @@ class OrdererNode:
             support.processor.trust_attestations = self._trust_attestations
             support.processor.attestors = \
                 support.processor._normalize_attestors(self._attestors)
+            support.processor.attestor_trust = self.attestor_trust
         self.cluster.add_chain(cid, support.chain,
                                consenters=ch_consenters, peers=ch_peers)
         return support

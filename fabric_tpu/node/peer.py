@@ -55,6 +55,7 @@ from fabric_tpu.privdata import (
     PvtDataStore,
     TransientStore,
 )
+from fabric_tpu.protocol import wire
 from fabric_tpu.protocol.types import Block
 from fabric_tpu.scc.cscc import Cscc
 from fabric_tpu.scc.discovery import DiscoveryService
@@ -121,8 +122,11 @@ class RemoteDeliver:
         for k in range(len(self.orderers)):
             addr = self.orderers[(self._rr + k) % len(self.orderers)]
             try:
+                # stream_views: block bytes arrive as memoryviews into
+                # the received frame and go straight to the native span
+                # parser — no frame->block copy, no per-tx objects
                 conn = connect(tuple(addr), self.signer, self.msps,
-                               timeout=3.0)
+                               timeout=3.0, stream_views=True)
                 try:
                     sender = getattr(conn.channel, "peer_identity", None)
                     for item in conn.call_stream("deliver", {
@@ -130,7 +134,7 @@ class RemoteDeliver:
                             "stop": seek.stop, "behavior": seek.behavior,
                             "timeout_s": int(timeout_s),
                             "signed_data": sd}):
-                        yield (Block.deserialize(item["block"]),
+                        yield (wire.parse_block(item["block"]),
                                item.get("attests"), sender)
                     self._rr = (self._rr + k) % len(self.orderers)
                     return
@@ -251,7 +255,8 @@ class PeerChannel:
             self.channel_id,
             LedgerConfig(root=f"{ch_dir}/ledger",
                          parallel_commit=bool(pc_cfg.get("enabled", False)),
-                         commit_workers=int(pc_cfg.get("max_workers", 4))))
+                         commit_workers=int(pc_cfg.get("max_workers", 4)),
+                         commit_adaptive=bool(pc_cfg.get("adaptive", True))))
         early_abort = None
         if pc_cfg.get("early_abort", pc_cfg.get("enabled", False)):
             from fabric_tpu.committer.parallel_commit import (
@@ -424,8 +429,10 @@ class PeerChannel:
             # epoch the commit-time validator will judge against
             cache.set_epoch(self.bundle_source.current().sequence,
                             scope=self.channel_id)
-            accept_block_attestations(cache, block, attests,
-                                      self.channel_id, self.msps)
+            accept_block_attestations(
+                cache, block, attests, self.channel_id, self.msps,
+                trust=self.node.attestor_trust,
+                attestor_binding=self.node._attestor_binding(sender))
         except Exception:
             logger.debug("attestation seeding failed", exc_info=True)
 
@@ -532,6 +539,14 @@ class PeerNode:
             vcfg.get("trust_attestations", False))
         self._attestors = StandardChannelProcessor._normalize_attestors(
             vcfg.get("attestors"))
+        # per-orderer standing on top of the allowlist (verify_plane/
+        # trust.py): a sender whose attested digest ever failed this
+        # peer's own re-derivation is revoked, persistently.
+        self.attestor_trust = None
+        if self._trust_attestations and self._attestors:
+            from fabric_tpu.verify_plane import AttestorTrust
+            self.attestor_trust = AttestorTrust(
+                os.path.join(data_dir, "attestor_trust.json"))
 
         channel_cfg = ChannelConfig.deserialize(
             bytes.fromhex(cfg["channel_config_hex"]))
@@ -682,8 +697,14 @@ class PeerNode:
             # worker state
             if self.verify_cache is not None:
                 from fabric_tpu import verify_plane as _vp
-                _vp.register_ops(self.ops, self.verify_cache,
-                                 spec=self.speculative)
+                _vp.register_ops(
+                    self.ops, self.verify_cache, spec=self.speculative,
+                    extra=lambda: {
+                        "trust_attestations": self._trust_attestations,
+                        "attestors": len(self._attestors),
+                        "attestors_revoked": (
+                            self.attestor_trust.revoked_count()
+                            if self.attestor_trust is not None else 0)})
 
         # SLO plane: GET /slo + /slo/alerts, burn-rate alerting over the
         # metrics registry; config/env via the `slo` sub-dict
@@ -875,12 +896,22 @@ class PeerNode:
         if (not self._trust_attestations or sender is None
                 or not self._attestors):
             return False
+        binding = self._attestor_binding(sender)
+        if binding is None or binding not in self._attestors:
+            return False
+        # allowlisted but revoked (a past digest mismatch) = not honoured
+        return (self.attestor_trust is None
+                or self.attestor_trust.allowed(binding))
+
+    @staticmethod
+    def _attestor_binding(sender):
+        """(mspid, cert sha256) of a transport-authenticated sender, or
+        None when it carries no usable certificate."""
         try:
             from fabric_tpu.orderer.cluster import cert_fingerprint
-            binding = (sender.mspid, cert_fingerprint(sender.cert))
+            return (sender.mspid, cert_fingerprint(sender.cert))
         except Exception:
-            return False
-        return binding in self._attestors
+            return None
 
     def _channel_epoch(self, channel_id: str) -> int:
         """Config sequence for the speculative verifier's per-channel

@@ -55,7 +55,8 @@ class StandardChannelProcessor:
                  writers_policy: SignaturePolicy,
                  absolute_max_bytes: int = 10 * 1024 * 1024,
                  now=None, bundle_source=None, verify_cache=None,
-                 trust_attestations: bool = False, attestors=None):
+                 trust_attestations: bool = False, attestors=None,
+                 attestor_trust=None):
         self.channel_id = channel_id
         self._static_msps = msps
         self._static_writers = writers_policy
@@ -79,6 +80,10 @@ class StandardChannelProcessor:
         self.verify_cache = verify_cache
         self.trust_attestations = bool(trust_attestations)
         self.attestors = self._normalize_attestors(attestors)
+        # per-identity standing on top of the allowlist (verify_plane/
+        # trust.py): an attestor whose digest ever mismatched is revoked
+        # — still allowlisted, no longer honoured.  None = membership only.
+        self.attestor_trust = attestor_trust
         self._now = now or (lambda: datetime.datetime.now(datetime.timezone.utc))
 
     # -- live config resolution (channelconfig bundle when attached) --------
@@ -170,7 +175,7 @@ class StandardChannelProcessor:
                     pass
             if (attest and self.trust_attestations
                     and self._attestor_authorized(attestor)):
-                self._accept_attestation(env, sh.creator, attest)
+                self._accept_attestation(env, sh.creator, attest, attestor)
         self._sig_filter(env, sh.creator)
         if cls is MsgClass.CONFIG and self.bundle_source is not None:
             # config-plane validation BEFORE ordering (reference:
@@ -204,10 +209,14 @@ class StandardChannelProcessor:
             binding = (attestor.mspid, cert_fingerprint(attestor.cert))
         except Exception:
             return False
-        return binding in self.attestors
+        if binding not in self.attestors:
+            return False
+        # allowlisted but revoked (a past digest mismatch) = not honoured
+        return (self.attestor_trust is None
+                or self.attestor_trust.allowed(binding))
 
     def _accept_attestation(self, env: Envelope, creator: bytes,
-                            attest: str) -> None:
+                            attest: str, attestor=None) -> None:
         """Seed the verdict cache from an AUTHORIZED gateway's verdict
         attestation (the caller already ran _attestor_authorized).
 
@@ -217,8 +226,11 @@ class StandardChannelProcessor:
         — identity from ITS msps, payload/signature from the wire bytes
         — and only accepts the attestation when the digests are
         bit-identical, so a mismatched attestation can never vouch for
-        different bytes than the ones being admitted.  Policy
-        evaluation, expiry, and config checks still run live below."""
+        different bytes than the ones being admitted.  A mismatch also
+        revokes the vouching identity's standing (attestor_trust): an
+        honest attestor cannot produce one, since the digest is a pure
+        function of bytes both sides hold.  Policy evaluation, expiry,
+        and config checks still run live below."""
         try:
             from fabric_tpu.verify_plane import item_digest
             ident = deserialize_from_msps(self.msps, creator)
@@ -226,10 +238,27 @@ class StandardChannelProcessor:
                 return
             item = ident.verify_item(env.payload, env.signature)
             if item_digest(item).hex() != attest:
+                self._note_attestor(attestor, ok=False)
                 return
             self.verify_cache.put(item, True, scope=self.channel_id)
+            self._note_attestor(attestor, ok=True)
             from fabric_tpu.verify_plane.cache import _m
             _m()["attested"].add(1)
+        except Exception:
+            pass
+
+    def _note_attestor(self, attestor, ok: bool) -> None:
+        """Record an authorized attestor's outcome in the standing
+        registry (no-op without one)."""
+        if self.attestor_trust is None or attestor is None:
+            return
+        try:
+            from fabric_tpu.orderer.cluster import cert_fingerprint
+            binding = (attestor.mspid, cert_fingerprint(attestor.cert))
+            if ok:
+                self.attestor_trust.note_accepted(binding)
+            else:
+                self.attestor_trust.note_mismatch(binding)
         except Exception:
             pass
 

@@ -1,0 +1,236 @@
+"""Zero-copy wire views: lazy Block/Envelope access over raw frame bytes.
+
+The committer's deliver path and the gateway's submit path both used to
+turn every received frame into a full Python object tree
+(Block.deserialize -> per-envelope bytes -> per-field dataclasses)
+before any validation ran.  native/fastparse.c extracts the byte SPANS
+those paths actually touch — envelope positions, header fields, the
+metadata splice point — in one C walk, and this module wraps them:
+
+  parse_block(raw)      -> BlockView (native parse) | Block (fallback)
+  BlockView             duck-types Block for every consumer on the
+                        covered path; materializes .data / .metadata
+                        lazily only when a consumer truly needs Python
+                        objects (MVCC, config handling)
+  envelope_summary(raw) -> (type, channel_id, txid) | None — the gateway
+                        header peek, no Envelope/Header trees
+  parse_block_py / envelope_summary_py
+                        pure-Python line-for-line mirrors of the native
+                        accept/reject decisions and extracted fields,
+                        used by the differential fuzz suite
+  n_txs(block)          len(block.data) without forcing a BlockView to
+                        materialize its envelope list
+
+Fallback semantics: the native parser accepts EXACTLY the strict
+canonical block shape; anything else (including every malformed input)
+returns None and parse_block falls back to Block.deserialize, so
+accept/reject behavior — down to the exception raised — is unchanged
+from the pure-Python path.  A BlockView is only ever produced for bytes
+Block.deserialize would have accepted.
+
+Key layout fact (fabric_tpu/utils/serde.py): block encodings are
+canonical dicts with sorted keys data < header < metadata.  So the data
+LIST's value span inside the raw bytes IS serde.encode(list(data)) —
+sha256 over it equals block_data_hash(block.data) — and metadata is the
+LAST value, so a metadata-mutated block re-serializes as
+raw[:meta_val_off] + serde.encode(metadata), a pure splice.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+from fabric_tpu.utils import serde
+from fabric_tpu.protocol.types import (
+    Block,
+    BlockHeader,
+    BlockMetadata,
+    Envelope,
+    block_header_hash,
+)
+
+try:
+    from fabric_tpu import native as _native_pkg
+    _fastparse = _native_pkg.load("_fastparse")
+except Exception:  # pragma: no cover - broken toolchain
+    _fastparse = None
+
+_Raw = Union[bytes, bytearray, memoryview]
+
+
+class BlockView:
+    """A Block over raw wire bytes; Python objects are built on demand.
+
+    Cheap always: .header, .n_data, .raw, .data_spans, .computed_data_hash,
+    .hash(), .serialize() (identity until .metadata is touched).
+    Materializing: .data (full envelope bytes list, cached), .metadata
+    (decoded dict, cached — after first access serialize() re-splices,
+    which is bit-identical for unmutated metadata by serde bijection).
+    """
+
+    __slots__ = ("raw", "header", "n_data", "_data_off", "_data_end",
+                 "_spans", "_meta_off", "_data", "_metadata", "_dhash")
+
+    def __init__(self, raw: _Raw, number: int, previous_hash: bytes,
+                 data_hash: bytes, data_off: int, data_end: int,
+                 n_data: int, spans, meta_off: int):
+        self.raw = raw
+        self.header = BlockHeader(number, previous_hash, data_hash)
+        self.n_data = n_data
+        self._data_off = data_off
+        self._data_end = data_end
+        self._spans = spans
+        self._meta_off = meta_off
+        self._data: Optional[List[bytes]] = None
+        self._metadata: Optional[BlockMetadata] = None
+        self._dhash: Optional[bytes] = None
+
+    # -- covered-path accessors (no per-tx objects) ---------------------
+
+    @property
+    def data_spans(self):
+        """(base, spans) pair for _fastcollect.digest_spans."""
+        return self.raw, self._spans
+
+    @property
+    def computed_data_hash(self) -> bytes:
+        """sha256 over the data list's value span ==
+        block_data_hash(self.data), computed without materializing."""
+        if self._dhash is None:
+            self._dhash = hashlib.sha256(
+                self.raw[self._data_off:self._data_end]).digest()
+        return self._dhash
+
+    def hash(self) -> bytes:
+        return block_header_hash(self.header)
+
+    def serialize(self) -> _Raw:
+        if self._metadata is None:
+            return self.raw
+        return (bytes(self.raw[:self._meta_off])
+                + serde.encode(self._metadata.to_dict()))
+
+    # -- materializing accessors ---------------------------------------
+
+    @property
+    def data(self) -> List[bytes]:
+        if self._data is None:
+            raw = self.raw
+            tab = memoryview(self._spans).cast("Q")
+            self._data = [bytes(raw[tab[2 * i]:tab[2 * i] + tab[2 * i + 1]])
+                          for i in range(self.n_data)]
+        return self._data
+
+    @property
+    def metadata(self) -> BlockMetadata:
+        if self._metadata is None:
+            md = serde.decode(bytes(self.raw[self._meta_off:]))
+            self._metadata = BlockMetadata.from_dict(md)
+        return self._metadata
+
+    def envelopes(self) -> List[Envelope]:
+        return [Envelope.deserialize(b) for b in self.data]
+
+    def to_dict(self) -> dict:
+        return {"header": self.header.to_dict(), "data": list(self.data),
+                "metadata": self.metadata.to_dict()}
+
+    def to_block(self) -> Block:
+        return Block(self.header, list(self.data), self.metadata)
+
+
+def parse_block(raw: _Raw) -> Union[BlockView, Block]:
+    """Wire bytes -> BlockView (native fast path) or Block (fallback).
+
+    Raises exactly what Block.deserialize raises for bytes neither
+    accepts; never raises for bytes Block.deserialize accepts.
+    """
+    if _fastparse is not None:
+        r = _fastparse.parse_block(raw)
+        if r is not None:
+            return BlockView(raw, *r)
+    return Block.deserialize(raw)
+
+
+def n_txs(block) -> int:
+    """len(block.data) without forcing a BlockView to materialize."""
+    n = getattr(block, "n_data", None)
+    return len(block.data) if n is None else n
+
+
+def envelope_summary(raw: _Raw) -> Optional[Tuple[str, str, str]]:
+    """(type, channel_id, txid) of a serialized Envelope, or None when
+    the bytes deviate from the strict shape (caller falls back to the
+    Envelope.deserialize path, preserving its exact error behavior)."""
+    if _fastparse is None:
+        return None
+    return _fastparse.envelope_summary(raw)
+
+
+# ---------------------------------------------------------------------------
+# pure-Python mirrors — the differential-fuzz reference implementations.
+# Native accept/reject and every extracted field must match these
+# byte-for-byte (tests/test_fastparse.py); like collect_py they are the
+# plain-language statement of what the C walk does.
+
+
+def parse_block_py(raw: _Raw):
+    """Mirror of _fastparse.parse_block: (number, previous_hash,
+    data_hash, data list, metadata dict, meta_val_off) or None."""
+    try:
+        d = serde.decode_py(bytes(raw))
+    except Exception:
+        return None
+    if not isinstance(d, dict) or sorted(d) != ["data", "header", "metadata"]:
+        return None
+    h = d["header"]
+    if (not isinstance(h, dict)
+            or sorted(h) != ["data_hash", "number", "previous_hash"]):
+        return None
+    number = h["number"]
+    # native reads a fixed 'I' i64; bignum ('V') numbers fall back
+    if (not isinstance(number, int) or isinstance(number, bool)
+            or not -(2 ** 63) <= number < 2 ** 63):
+        return None
+    if not isinstance(h["previous_hash"], bytes):
+        return None
+    if not isinstance(h["data_hash"], bytes):
+        return None
+    if not isinstance(d["data"], list):
+        return None
+    for item in d["data"]:
+        if not isinstance(item, bytes):
+            return None
+    if not isinstance(d["metadata"], dict):
+        return None
+    # metadata is the top dict's last key: its value span runs to the end
+    meta_off = len(bytes(raw)) - len(serde.encode_py(d["metadata"]))
+    return (number, h["previous_hash"], h["data_hash"], d["data"],
+            d["metadata"], meta_off)
+
+
+def envelope_summary_py(raw: _Raw) -> Optional[Tuple[str, str, str]]:
+    """Mirror of _fastparse.envelope_summary."""
+    try:
+        d = serde.decode_py(bytes(raw))
+        if not isinstance(d, dict) or "payload" not in d or "signature" not in d:
+            return None
+        payload = d["payload"]
+        if not isinstance(payload, bytes):
+            return None
+        p = serde.decode_py(payload)
+        header = p["header"]
+        ch = header["channel_header"]
+        sh = header["signature_header"]
+        if not isinstance(ch, dict) or not isinstance(sh, dict):
+            return None
+        if "creator" not in sh or "nonce" not in sh:
+            return None
+        t, cid, txid = ch["type"], ch["channel_id"], ch["txid"]
+        if not (isinstance(t, str) and isinstance(cid, str)
+                and isinstance(txid, str)):
+            return None
+        return (t, cid, txid)
+    except Exception:
+        return None

@@ -183,6 +183,69 @@ def _dec(mv: memoryview, off: int, depth: int = 0):
 
 decode_py = decode
 
+
+def decode_views(data) -> Any:
+    """Strict decode where 'B' values are READ-ONLY memoryview slices
+    into `data` instead of bytes copies.
+
+    Same accept/reject behavior as decode() (it shares the walker); only
+    the representation of bytes values differs.  Used by the zero-copy
+    ingest path (comm/rpc.py stream_views): a deliver frame's
+    {"block": <70 KB>} decodes without duplicating the block bytes, and
+    the views keep the received frame buffer alive.  Callers must treat
+    the result as immutable and not hand views to consumers that expect
+    hashable bytes.
+    """
+    mv = memoryview(data)
+    if not mv.readonly:
+        mv = mv.toreadonly()
+    try:
+        v, off = _dec_views(mv, 0)
+    except struct.error as e:  # truncated length/int field
+        raise ValueError(f"truncated input: {e}") from e
+    if off != len(mv):
+        raise ValueError("trailing bytes")
+    return v
+
+
+def _dec_views(mv: memoryview, off: int, depth: int = 0):
+    # identical to _dec except the 'B' arm, which returns a slice view
+    if depth > MAX_DEPTH:
+        raise ValueError("nesting too deep")
+    tag = _take(mv, off, 1)
+    if tag == b"B":
+        n = _U32.unpack_from(mv, off + 1)[0]
+        off += 5
+        if off + n > len(mv):
+            raise ValueError(
+                f"short buffer: need {n} bytes at {off}, have {len(mv) - off}")
+        return mv[off:off + n], off + n
+    if tag == b"L":
+        n = _U32.unpack_from(mv, off + 1)[0]
+        off += 5
+        items = []
+        for _ in range(n):
+            v, off = _dec_views(mv, off, depth + 1)
+            items.append(v)
+        return items, off
+    if tag == b"D":
+        n = _U32.unpack_from(mv, off + 1)[0]
+        off += 5
+        d = {}
+        prev = None
+        for _ in range(n):
+            kn = _U32.unpack_from(mv, off)[0]
+            off += 4
+            k = _take(mv, off, kn).decode("utf-8")
+            off += kn
+            if prev is not None and not (k > prev):
+                raise ValueError("non-canonical dict key order")
+            prev = k
+            v, off = _dec_views(mv, off, depth + 1)
+            d[k] = v
+        return d, off
+    return _dec(mv, off, depth)
+
 # hot-path C codec (fabric_tpu/native/ftlv.c) — identical wire format and
 # error behavior; tests/test_serde.py exercises both differentially
 try:

@@ -11,11 +11,12 @@ from .attest import accept_block_attestations, attest_block
 from .cache import (CachingProvider, CoverageWindow, VerdictCache,
                     item_digest, note_device_verifications)
 from .speculative import SpeculativeVerifier, derive_items
+from .trust import AttestorTrust
 
 __all__ = ["CachingProvider", "CoverageWindow", "VerdictCache",
            "item_digest", "note_device_verifications",
            "SpeculativeVerifier", "derive_items", "register_ops",
-           "attest_block", "accept_block_attestations"]
+           "attest_block", "accept_block_attestations", "AttestorTrust"]
 
 
 def register_ops(ops, cache: VerdictCache, spec=None, extra=None) -> None:

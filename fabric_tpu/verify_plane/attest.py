@@ -57,11 +57,18 @@ def attest_block(cache: VerdictCache, block, channel_id: str,
 
 
 def accept_block_attestations(cache: VerdictCache, block, attests,
-                              channel_id: str, msps) -> int:
+                              channel_id: str, msps, trust=None,
+                              attestor_binding=None) -> int:
     """Seed `cache` from an AUTHORIZED sender's attestation list (the
     caller already checked the allowlist).  Every digest is re-derived
     from our own envelope bytes before acceptance.  Returns how many
-    verdicts were seeded."""
+    verdicts were seeded.
+
+    `trust`/`attestor_binding` (optional) feed the sender's per-identity
+    standing (trust.py): a digest that fails re-derivation is a vouch
+    for bytes the sender did not deliver and revokes it; envelopes whose
+    creator cannot even be derived are skipped without blame (that is a
+    local MSP question, not the attestor's)."""
     if not attests:
         return 0
     n = 0
@@ -75,11 +82,15 @@ def accept_block_attestations(cache: VerdictCache, block, attests,
                 continue
             item = creators[0]
             if item_digest(item).hex() != att:
+                if trust is not None and attestor_binding is not None:
+                    trust.note_mismatch(attestor_binding)
                 continue
             cache.put(item, True, scope=channel_id)
             n += 1
         except Exception:
             continue
+    if n and trust is not None and attestor_binding is not None:
+        trust.note_accepted(attestor_binding, n)
     if n:
         try:
             from .cache import _m

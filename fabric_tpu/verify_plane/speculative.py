@@ -31,7 +31,26 @@ from fabric_tpu.ops_plane import tracing
 
 from .cache import VerdictCache, item_digest
 
+# native pass-1 walker: the gateway's submit path derives items through
+# the SAME extractor the committer runs, so the zero-copy ingest bytes
+# never detour through a Python object tree here either.  collect_py
+# stays as the no-compiler fallback and the differential oracle.
+try:
+    from fabric_tpu.native import load as _load_native
+    _fastcollect = _load_native("_fastcollect")
+except Exception:               # pragma: no cover - broken toolchain
+    _fastcollect = None
+
 logger = logging.getLogger("fabric_tpu.verify_plane")
+
+
+def _raw_bytes(env):
+    """Serialized envelope bytes: raw submissions pass through untouched
+    (the gateway keeps wire bytes all the way here), Envelope objects
+    serialize once."""
+    if isinstance(env, (bytes, bytearray, memoryview)):
+        return env
+    return env.serialize()
 
 
 def _ident_item(msps, memo: dict, ident_bytes: bytes, msg: bytes,
@@ -63,7 +82,10 @@ def derive_items(raw_env: bytes, channel_id: str, msps,
     flags those without any crypto; nothing to speculate on)."""
     if memo is None:
         memo = {}
-    rec = collect_py.collect_env(raw_env, channel_id)
+    if _fastcollect is not None:
+        rec = _fastcollect.collect([raw_env], channel_id)[0]
+    else:
+        rec = collect_py.collect_env(raw_env, channel_id)
     if isinstance(rec, int) or len(rec) == 2:
         return [], []
     txtype, txid, creator, payload, pdigest, signature, actions = rec
@@ -125,6 +147,10 @@ class SpeculativeVerifier:
         verdict attestation digests ("" where no verdict is available)
         that ride beside the envelopes to the orderer.
 
+        `envs` entries may be Envelope objects or raw serialized bytes;
+        the gateway submit path hands wire bytes straight through so the
+        native extractor works on the original frame buffer.
+
         `spans`, when given, are the per-envelope ordering spans; the
         ingress verify trace is linked into each so a client's request
         trace reaches the device work done on its behalf (the batcher
@@ -137,7 +163,7 @@ class SpeculativeVerifier:
         for env, cid in zip(envs, channel_ids):
             try:
                 creators, endorse = derive_items(
-                    env.serialize(), cid, self.msps_source(cid),
+                    _raw_bytes(env), cid, self.msps_source(cid),
                     memos.setdefault(cid, {}))
             except Exception:
                 logger.debug("speculative derive failed", exc_info=True)

@@ -49,6 +49,29 @@ python tests/smoke_mesh.py
 echo "== parallel commit probe (wavefront vs serial oracle, two-stack gate) =="
 python tests/smoke_parallel_commit.py
 
+echo "== ASan/UBSan fuzz corpus vs the native wire parser =="
+# Build _fastparse with the sanitizers and drive the full adversarial
+# corpus (tests/test_fastparse.py --asan-corpus) through it: any heap
+# overflow / UB in the span parser aborts here instead of shipping.
+# Skipped gracefully when the toolchain lacks the sanitizer runtimes.
+san_tmp=$(mktemp -d)
+trap 'rm -rf "$san_tmp"' EXIT
+if echo 'int main(void){return 0;}' > "$san_tmp/probe.c" \
+   && "${CC:-cc}" -fsanitize=address,undefined -O1 \
+        "$san_tmp/probe.c" -o "$san_tmp/probe" 2>/dev/null \
+   && "$san_tmp/probe"; then
+    "${CC:-cc}" -fsanitize=address,undefined -fno-sanitize-recover=all \
+        -O1 -g -shared -fPIC -Wall -Wextra -Werror \
+        -I"$(python -c 'import sysconfig;print(sysconfig.get_path("include"))')" \
+        fabric_tpu/native/fastparse.c -o "$san_tmp/_fastparse.so"
+    LD_PRELOAD="$("${CC:-cc}" -print-file-name=libasan.so)" \
+    ASAN_OPTIONS=detect_leaks=0 \
+    PYTHONPATH="$san_tmp:$PYTHONPATH" \
+        python tests/test_fastparse.py --asan-corpus
+else
+    echo "skip: sanitizer toolchain unavailable"
+fi
+
 echo "== non-slow test subset =="
 python -m pytest tests/ -q -m 'not slow' -p no:cacheprovider
 echo "OK: smoke passed"

@@ -404,3 +404,26 @@ def test_committer_early_abort_flag_parity_and_fewer_dispatches(
     assert aborts0 == 0 and aborts1 == 1
     # the doomed tx's creator+endorser items never reached the device
     assert items1 < items0
+
+
+def test_adaptive_pool_tracks_rolling_wave_width():
+    """The provisioned pool follows the rolling max wave width, clamped
+    to the configured cap; adaptive=False pins it at the cap."""
+    s = ParallelCommitScheduler(max_workers=8, channel_id="ch",
+                                adaptive=True, width_window=4)
+    assert s.target_workers(1) == 1          # serial block: no pool fan-out
+    assert s.target_workers(3) == 3          # demand grows the target
+    assert s.target_workers(16) == 8         # config cap is the override
+    for _ in range(4):                       # wide blocks age out of the
+        last = s.target_workers(1)           # window -> pool shrinks back
+    assert last == 1
+    pinned = ParallelCommitScheduler(max_workers=8, adaptive=False)
+    assert pinned.target_workers(1) == 8
+
+    # the executor actually resizes (pool swap) when the target moves
+    pool_a = s._executor(2)
+    assert s._pool_size == 2
+    pool_b = s._executor(5)
+    assert s._pool_size == 5 and pool_b is not pool_a
+    assert s._executor(5) is pool_b          # stable while target holds
+    s.close()

@@ -737,3 +737,80 @@ def test_attest_roundtrip_skips_peer_device_verify(sw_provider):
     cp = CachingProvider(inner, peer_cache, site="committer", scope="ch")
     verdicts = cp.batch_verify([creator_item(env, msps)])
     assert bool(verdicts.all()) and inner.dispatched == 0
+
+
+# -- per-identity attestor standing (verify_plane/trust.py) ------------------
+
+
+def test_attestor_revoked_on_digest_mismatch_and_persisted(
+        sw_provider, tmp_path):
+    """A forged attestation no longer just gets ignored: the vouching
+    identity is revoked — its NEXT attestation is not honoured even
+    when bit-correct — and the revocation survives a restart via the
+    JSON state file."""
+    from fabric_tpu.verify_plane import AttestorTrust
+    org = DevOrg("Org1")
+    gw = org.new_identity("gateway")
+    msps = {"Org1": CachedMSP(org.msp())}
+    path = str(tmp_path / "attestor_trust.json")
+    trust = AttestorTrust(path)
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True,
+                      attestors=[_attestor_binding(gw)])
+    proc.attestor_trust = trust
+
+    env1, env2 = _order_env(org), _order_env(org)
+    proc.process(env1, attest="ab" * 32, attestor=gw)   # mismatch: revoke
+    assert inner.dispatched == 1
+    assert trust.revoked_count() == 1
+    # a correct attestation from the now-revoked identity seeds nothing
+    before = counts()
+    proc.process(env2, attest=item_digest(creator_item(env2, msps)).hex(),
+                 attestor=gw)
+    assert inner.dispatched == 2                        # device-verified
+    assert delta(before, counts())["attested"] == 0
+
+    reloaded = AttestorTrust(path)                      # restart
+    assert reloaded.revoked_count() == 1
+    binding = _attestor_binding(gw)
+    assert not reloaded.allowed((binding["mspid"], binding["cert_fp"]))
+
+
+def test_attestor_standing_accumulates_accepts(sw_provider, tmp_path):
+    from fabric_tpu.verify_plane import AttestorTrust
+    org = DevOrg("Org1")
+    gw = org.new_identity("gateway")
+    msps = {"Org1": CachedMSP(org.msp())}
+    trust = AttestorTrust(str(tmp_path / "t.json"))
+    inner = CountingProvider(init_factories(FactoryOpts(default="SW")))
+    proc = _processor(org, inner, VerdictCache(capacity=64), trust=True,
+                      attestors=[_attestor_binding(gw)])
+    proc.attestor_trust = trust
+    for _ in range(3):
+        env = _order_env(org)
+        proc.process(env, attest=item_digest(creator_item(env, msps)).hex(),
+                     attestor=gw)
+    assert inner.dispatched == 0                        # all vouched
+    (ent,) = trust.snapshot().values()
+    assert ent["accepted"] == 3 and ent["mismatched"] == 0
+    assert not ent["revoked"] and trust.revoked_count() == 0
+
+
+def test_deliver_attestation_mismatch_revokes_sender(orgs, sw_provider):
+    """The orderer->peer direction: accept_block_attestations feeds the
+    sender's standing — one bad digest in a delivered block revokes."""
+    from fabric_tpu.verify_plane import (AttestorTrust,
+                                         accept_block_attestations)
+    org1, org2 = orgs
+    msps = _msps(org1, org2)
+    envs = [make_tx(org1, org2) for _ in range(2)]
+    block = make_block(envs)
+    good = item_digest(creator_item(envs[0], msps)).hex()
+    cache = VerdictCache(capacity=64)
+    trust = AttestorTrust()
+    binding = ("OrdererOrg", "ab" * 32)
+    n = accept_block_attestations(cache, block, [good, "cd" * 32], "ch",
+                                  msps, trust=trust,
+                                  attestor_binding=binding)
+    assert n == 1                       # the good digest still seeded
+    assert not trust.allowed(binding)   # ...but the forgery revoked
