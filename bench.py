@@ -492,6 +492,156 @@ def bench_commit_stage(n_tx: int = 300, n_blocks: int = 4) -> dict:
     return det
 
 
+def bench_overload(over_factor: float = 2.2) -> dict:
+    """Open-loop overload probe (ISSUE 10 proof point): boot a one-
+    orderer topology with a structurally throttled gateway drain
+    (max_batch 4, 50ms linger — so saturation sits at a few dozen tx/s
+    on any host), measure saturation closed-loop, then ramp an open-
+    loop Zipf-keyed workload to `over_factor` x it with a seeded fault
+    burst delaying broadcasts.  Records offered/accepted/committed
+    rates, shed fraction, sojourn percentiles, and the admission
+    controller's transition count.  Pure host + in-process sockets —
+    honest on any box."""
+    import tempfile as _tempfile
+    import threading as _threading
+
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    from fabric_tpu.comm import faults as _faults
+    from fabric_tpu.comm.faults import FaultPlan
+    from fabric_tpu.endorser.proposal import assemble_transaction
+    from fabric_tpu.gateway import GatewayClient
+    from fabric_tpu.node.orderer import load_signing_identity
+    from fabric_tpu.workload import (ClientPopulation, TrafficMix,
+                                     WorkloadRunner)
+    from fabric_tpu.workload.__main__ import boot
+
+    seed = 20260805
+    det: dict = {}
+    # the live-network path runs on the software provider (same as the
+    # smoke probes); init_factories is re-callable, and this section is
+    # the LAST provider-dependent one in main() by construction
+    init_factories(FactoryOpts(default="SW"))
+    admission = {"enabled": True, "queue_high_frac": 0.25,
+                 "latency_slo_s": 0.4, "dwell_s": 0.5,
+                 "recover_ratio": 0.6, "eval_interval_s": 0.05,
+                 "retry_after_base_ms": 100, "seed": seed}
+    slo = {"sample_interval_s": 0.5, "short_window_s": 3.0,
+           "long_window_s": 9.0}
+    with _tempfile.TemporaryDirectory() as base:
+        paths, orderers, peers = boot(
+            base, 1, admission, slo, 32,
+            gateway={"linger_s": 0.05, "max_batch": 4})
+        peer = peers[0]
+        with open(paths["clients"]["Org1"]) as f:
+            cc = json.load(f)
+        signer = load_signing_identity(
+            cc["mspid"], cc["cert_pem"].encode(), cc["key_pem"].encode())
+
+        def mk_client(**kw):
+            kw.setdefault("shed_retry_max", 0)
+            return GatewayClient(peer.rpc.addr, signer, peer.msps,
+                                 channel_id="ch", **kw)
+
+        try:
+            prep_gw = mk_client()
+            pool = []
+            for i in range(90):
+                sp, resp = prep_gw.endorse(
+                    "assets", "bump", [f"bench-{i % 48:03d}".encode()])
+                pool.append(assemble_transaction(sp, resp, signer))
+
+            it = iter(pool)
+            lock = _threading.Lock()
+            acked = [0]
+
+            def drain():
+                gw = mk_client()
+                while True:
+                    with lock:
+                        env = next(it, None)
+                    if env is None:
+                        break
+                    gw.submit_envelope(env, timeout_s=15.0)
+                    with lock:
+                        acked[0] += 1
+                gw.close()
+
+            ts = [_threading.Thread(target=drain, daemon=True)
+                  for _ in range(8)]
+            t0 = time.monotonic()
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=60.0)
+            sat = acked[0] / max(time.monotonic() - t0, 1e-9)
+            det["overload_saturation_tps"] = round(sat, 1)
+
+            phases = [
+                {"name": "ramp", "duration_s": 3.0,
+                 "arrivals": {"kind": "ramp", "start_rate": 0.2 * sat,
+                              "end_rate": over_factor * sat,
+                              "ramp_s": 3.0}},
+                {"name": "hold", "duration_s": 2.0,
+                 "arrivals": {"kind": "constant",
+                              "rate": over_factor * sat}},
+                {"name": "recover", "duration_s": 3.0,
+                 "arrivals": {"kind": "constant", "rate": 0.15 * sat}},
+            ]
+            mix = TrafficMix([{
+                "channel": "ch", "chaincode": "assets", "weight": 1.0,
+                "keys": 192, "zipf_s": 1.1,
+                "blend": {"read": 0.1, "write": 0.85, "range": 0.05}}],
+                seed=seed)
+            clients = ClientPopulation(
+                5000, 6,
+                factory=lambda slot: mk_client(seed=seed * 10 + slot),
+                seed=seed)
+            clients.warm()
+
+            def prepare(op):
+                fn, args = WorkloadRunner._call_shape(op)
+                sp, resp = prep_gw.endorse(op.chaincode, fn, args,
+                                           channel=op.channel)
+                return assemble_transaction(sp, resp, signer)
+
+            _faults.install(FaultPlan(seed=seed, name="bench-burst").rule(
+                method="broadcast*", kind="req", delay=0.3, delay_s=0.03,
+                schedule={"kind": "burst", "period_s": 2.0,
+                          "duty": 0.4}))
+            try:
+                rep = WorkloadRunner(
+                    clients, mix, phases, signer=signer, prepare=prepare,
+                    workers=128, commit_every=4, seed=seed).run()
+            finally:
+                _faults.uninstall()
+            tot = rep["totals"]
+            snap = peer.gateway.admission.snapshot()
+            det.update({
+                "overload_factor": over_factor,
+                "overload_offered_rate": tot["offered_rate"],
+                "overload_accepted_rate": tot["accepted_rate"],
+                "overload_committed_rate_sampled": tot["committed_rate"],
+                "overload_commit_every": rep["commit_every"],
+                "overload_shed": tot["shed"],
+                "overload_shed_frac": tot["shed_frac"],
+                "overload_backpressure": tot["backpressure"],
+                "overload_conflict_frac": tot["conflict_frac"],
+                "overload_sojourn_ms": tot["sojourn_ms"],
+                "overload_admission_transitions":
+                    len(snap["transitions"]),
+                "overload_admission_final": snap["state"],
+            })
+            clients.close()
+            prep_gw.close()
+        finally:
+            for n in peers + orderers:
+                try:
+                    n.stop()
+                except Exception:
+                    pass
+    return det
+
+
 def bench_ingest(n_tx: int = 200, n_blocks: int = 8) -> dict:
     """Ingest-stage (r09 zero-copy) throughput: raw wire bytes -> parsed
     block, native C parser (wire.parse_block -> BlockView over an arena
@@ -825,6 +975,18 @@ def main():
             detail.update(bench_commit_stage(n_tx=commit_tx))
         except Exception as exc:
             detail["commit_stage_error"] = str(exc)[:200]
+
+    # -- overload: open-loop 2.2x-saturation drill through admission ---------
+    # (ISSUE 10 proof point: measured saturation, then an open-loop
+    # Zipf-keyed ramp past it with seeded fault bursts; records shed
+    # fraction, sojourn percentiles, and the admission ladder's
+    # transition count.  Re-inits the SW provider, so it must stay the
+    # LAST provider-dependent section.)
+    if os.environ.get("BENCH_SKIP_OVERLOAD") != "1":
+        try:
+            detail.update(bench_overload())
+        except Exception as exc:
+            detail["overload_error"] = str(exc)[:200]
 
     # -- batching economics (same source as the live /metrics surface) -------
     # bench and the node dashboard must agree on occupancy/pad-waste, so

@@ -9,8 +9,9 @@ from . import faults
 from .secure import HandshakeError, SecureChannel, SecureServer, dial
 from .rpc import (RpcClosed, RpcConnection, RpcError, RpcServer,
                   RpcTimeout, connect)
-from .faults import FaultPlan, FaultRule
+from .faults import FaultPlan, FaultRule, FaultSchedule
 
 __all__ = ["SecureChannel", "SecureServer", "HandshakeError", "dial",
            "RpcConnection", "RpcServer", "RpcError", "RpcTimeout",
-           "RpcClosed", "connect", "faults", "FaultPlan", "FaultRule"]
+           "RpcClosed", "connect", "faults", "FaultPlan", "FaultRule",
+           "FaultSchedule"]

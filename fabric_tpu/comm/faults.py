@@ -22,6 +22,14 @@ a network partition — in-process nodes share one address space, so the
 partition is expressed as "this group is unreachable"; `heal()`
 restores it).
 
+Schedules: a rule may carry a `FaultSchedule` — a wall-time envelope
+(ramp, burst, window) over elapsed plan time that MULTIPLIES the rule's
+action probabilities, so a chaos drill can say "fault probability ramps
+up over 10s" or "faults fire only during 3s bursts every 10s" and run
+composably alongside always-on rules.  The envelope scales the
+probability before the PRNG compare and never consumes extra draws, so
+scheduled plans keep the draw-sequence determinism below.
+
 Determinism: every probabilistic decision consumes one draw from ONE
 seeded PRNG under the plan lock, in frame-send order.  A test that
 replays the same workload single-threaded against the same seed sees
@@ -82,6 +90,55 @@ def _addr_str(addr) -> str:
 
 
 @dataclass
+class FaultSchedule:
+    """A wall-time envelope over elapsed plan time that multiplies a
+    rule's action probabilities by `factor(t)` in [floor, 1]:
+
+      constant   1.0 always (the implicit default when a rule has none)
+      ramp       floor -> 1.0 linearly over `ramp_s` starting at
+                 `start_s`, then hold (chaos that builds with the load
+                 ramp instead of arriving full-strength at t=0)
+      burst      1.0 for the first `duty` fraction of every `period_s`,
+                 `floor` otherwise (fault bursts riding a load burst)
+      window     1.0 inside [start_s, end_s), `floor` outside
+
+    `end_s` bounds every kind; outside it the factor is `floor`.  Pure
+    function of t, so a seeded plan with an injected clock replays the
+    exact same fault sequence."""
+
+    kind: str = "constant"       # constant | ramp | burst | window
+    start_s: float = 0.0
+    ramp_s: float = 10.0
+    period_s: float = 10.0
+    duty: float = 0.3
+    end_s: Optional[float] = None
+    floor: float = 0.0           # factor outside the active phase
+
+    def factor(self, t: float) -> float:
+        if t < self.start_s or (self.end_s is not None
+                                and t >= self.end_s):
+            return self.floor
+        t = t - self.start_s
+        if self.kind == "ramp":
+            if self.ramp_s <= 0.0:
+                return 1.0
+            f = min(1.0, t / self.ramp_s)
+            return self.floor + (1.0 - self.floor) * f
+        if self.kind == "burst":
+            if self.period_s <= 0.0:
+                return 1.0
+            phase = (t % self.period_s) / self.period_s
+            return 1.0 if phase < self.duty else self.floor
+        return 1.0                # constant / window (inside the window)
+
+    def as_dict(self) -> dict:
+        return {"kind": self.kind, "start_s": self.start_s,
+                "ramp_s": self.ramp_s, "period_s": self.period_s,
+                "duty": self.duty, "end_s": self.end_s,
+                "floor": self.floor}
+
+
+@dataclass
 class FaultRule:
     """One match+action rule.  Probabilities are independent per action;
     at most one action fires per frame (first match in ACTIONS order
@@ -97,6 +154,8 @@ class FaultRule:
     reorder: float = 0.0
     error: float = 0.0
     max_fires: Optional[int] = None   # stop firing after N faults
+    # wall-time envelope multiplying every probability (None = always on)
+    schedule: Optional[FaultSchedule] = None
     fires: int = field(default=0, compare=False)
 
     def matches(self, method: str, peer: str, kind: str) -> bool:
@@ -116,7 +175,10 @@ class FaultRule:
                 "drop": self.drop, "delay": self.delay,
                 "delay_s": self.delay_s, "dup": self.dup,
                 "reorder": self.reorder, "error": self.error,
-                "max_fires": self.max_fires, "fires": self.fires}
+                "max_fires": self.max_fires,
+                "schedule": (self.schedule.as_dict()
+                             if self.schedule is not None else None),
+                "fires": self.fires}
 
 
 class FaultInjected(Exception):
@@ -139,9 +201,12 @@ class FaultPlan:
         faults.uninstall()
     """
 
-    def __init__(self, seed: int = 0, name: str = ""):
+    def __init__(self, seed: int = 0, name: str = "", clock=None):
         self.seed = int(seed)
         self.name = name or f"plan-{seed}"
+        # schedule time base: elapsed wall time since install();
+        # injectable so tests replay envelopes without sleeping
+        self._clock = clock or time.time
         self._rand = random.Random(self.seed)
         self._lock = threading.Lock()
         self.rules: List[FaultRule] = []
@@ -155,7 +220,10 @@ class FaultPlan:
     # -- building -----------------------------------------------------------
 
     def rule(self, **kw) -> "FaultPlan":
-        self.rules.append(FaultRule(**kw))
+        sched = kw.pop("schedule", None)
+        if isinstance(sched, dict):
+            sched = FaultSchedule(**sched)
+        self.rules.append(FaultRule(schedule=sched, **kw))
         return self
 
     # -- connection-level faults --------------------------------------------
@@ -213,14 +281,23 @@ class FaultPlan:
         peer_s = _addr_str(peer) if peer is not None else ""
         action = None
         delay_s = 0.0
+        now = self._clock()
+        elapsed = now - (self.installed_at
+                         if self.installed_at is not None else now)
         with self._lock:
             for r in self.rules:
                 if not r.matches(method, peer_s, kind):
                     continue
+                # the wall-time envelope scales every probability; a
+                # candidate action with p > 0 still consumes exactly one
+                # draw even at factor 0, so the draw sequence is the
+                # same in and out of the envelope's active phase
+                factor = (r.schedule.factor(elapsed)
+                          if r.schedule is not None else 1.0)
                 # one PRNG draw per candidate action, in fixed order
                 for a in ACTIONS:
                     p = getattr(r, a if a != "delay" else "delay")
-                    if p > 0.0 and self._rand.random() < p:
+                    if p > 0.0 and self._rand.random() < p * factor:
                         action = a
                         delay_s = r.delay_s
                         r.fires += 1
@@ -289,8 +366,8 @@ def install(plan: FaultPlan) -> FaultPlan:
     """Make `plan` the process-global fault plan (tests/chaos only)."""
     global _PLAN
     with _INSTALL_LOCK:
-        plan.installed_at = time.time()
-        _PLAN = plan
+        plan.installed_at = plan._clock()   # schedule t=0 (time.time
+        _PLAN = plan                        # unless a clock is injected)
     logger.warning("fault plan %s INSTALLED (seed=%d, %d rules)",
                    plan.name, plan.seed, len(plan.rules))
     return plan

@@ -18,15 +18,24 @@ authenticated RPC plane (comm/rpc.py):
 Internals: a bounded admission queue with explicit backpressure
 (service.py), batch broadcast with exponential-backoff failover across
 orderers (broadcaster.py, same pattern as gossip/blocksprovider.py),
-a txid dedup window for idempotent submission, and a commit notifier
+a txid dedup window for idempotent submission, a commit notifier
 driven by the committer's post-validation txflags (notifier.py) so
-commit_status never polls the ledger.
+commit_status never polls the ledger, and an SLO-driven admission
+controller (admission.py) that sheds load with typed retryable
+verdicts — NORMAL -> SHED_EVALUATE -> SHED_PROBABILISTIC -> SHED_HARD
+with hysteretic recovery — before the queue-full cliff.
 """
 
+from fabric_tpu.gateway.admission import AdmissionController
 from fabric_tpu.gateway.broadcaster import BatchBroadcaster
-from fabric_tpu.gateway.client import GatewayClient, GatewayError
+from fabric_tpu.gateway.client import (
+    GatewayClient,
+    GatewayError,
+    GatewayShedError,
+)
 from fabric_tpu.gateway.notifier import CommitNotifier
 from fabric_tpu.gateway.service import GatewayService
 
-__all__ = ["BatchBroadcaster", "CommitNotifier", "GatewayClient",
-           "GatewayError", "GatewayService"]
+__all__ = ["AdmissionController", "BatchBroadcaster", "CommitNotifier",
+           "GatewayClient", "GatewayError", "GatewayShedError",
+           "GatewayService"]

@@ -202,6 +202,17 @@ class BatchBroadcaster:
         with self._lock:
             return [st.as_dict() for st in self._states]
 
+    def latency_ewma(self) -> float:
+        """Smoothed broadcast latency (seconds) of the current orderer,
+        falling back to the best-known peer — the admission plane's
+        downstream-backpressure signal.  0.0 until a broadcast lands."""
+        with self._lock:
+            st = self._states[self._idx]
+            if st.ewma_s > 0.0:
+                return st.ewma_s
+            vals = [s.ewma_s for s in self._states if s.ewma_s > 0.0]
+            return min(vals) if vals else 0.0
+
     # connection management --------------------------------------------
 
     def _backoff(self) -> float:

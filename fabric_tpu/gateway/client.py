@@ -16,8 +16,10 @@ fan-out, ordering, retry, and commit tracking all happen server-side.
 from __future__ import annotations
 
 import logging
+import random
 import threading
-from typing import List, Optional, Sequence, Tuple
+import time
+from typing import Callable, List, Optional, Sequence, Tuple
 
 from fabric_tpu.comm import RpcError, connect
 from fabric_tpu.ops_plane import tracing
@@ -41,6 +43,21 @@ class GatewayError(Exception):
         self.status = status
 
 
+class GatewayShedError(GatewayError):
+    """The gateway's admission controller shed the request: a TYPED,
+    RETRYABLE overload verdict (not a failure of the request itself),
+    carrying the shed mode and the server's retry-after hint.  Distinct
+    from queue-full backpressure, which surfaces as a plain RpcError —
+    shed means "the node is overloaded, stay away for a while"."""
+
+    def __init__(self, message: str, mode: str = "",
+                 retry_after_ms: int = 0, severity: float = 0.0):
+        super().__init__(message, status=429)
+        self.mode = mode
+        self.retry_after_ms = int(retry_after_ms)
+        self.severity = float(severity)
+
+
 class GatewayClient:
     """Client handle onto one peer's gateway service.
 
@@ -51,15 +68,26 @@ class GatewayClient:
 
     def __init__(self, peer_addr: Tuple[str, int], signer, msps,
                  channel_id: Optional[str] = None,
-                 timeout: float = 5.0, call_timeout: float = 30.0):
+                 timeout: float = 5.0, call_timeout: float = 30.0,
+                 shed_retry_max: int = 2,
+                 shed_backoff_cap_s: float = 2.0, seed: int = 0):
         self.peer_addr = tuple(peer_addr)
         self.signer = signer
         self.msps = msps
         self.channel_id = channel_id
         self._timeout = timeout
         self._call_timeout = call_timeout
+        # shed handling: retries honor the server's retry-after hint
+        # with capped jittered backoff (NEVER an immediate retry — that
+        # just re-offers the load the node asked us to withhold)
+        self.shed_retry_max = int(shed_retry_max)
+        self.shed_backoff_cap_s = float(shed_backoff_cap_s)
+        self._rand = random.Random(seed)
         self._lock = threading.Lock()
         self._conn = None
+        self._stats_lock = threading.Lock()
+        self._stats = {"shed_seen": 0, "shed_retries": 0,
+                       "shed_exhausted": 0}
 
     # plumbing ----------------------------------------------------------
 
@@ -67,22 +95,74 @@ class GatewayClient:
               timeout: Optional[float] = None) -> dict:
         if timeout is None:
             timeout = self._call_timeout
+        # hold the lock only around dial/teardown: RpcConnection
+        # multiplexes concurrent requests over one channel, so calls
+        # themselves must overlap — a population of simulated clients
+        # on one socket otherwise serializes into a closed loop
         with self._lock:
-            if self._conn is None:
-                self._conn = connect(self.peer_addr, self.signer, self.msps,
-                                     timeout=self._timeout)
+            conn = self._conn
+            if conn is None:
+                conn = connect(self.peer_addr, self.signer, self.msps,
+                               timeout=self._timeout)
+                self._conn = conn
+        try:
+            return conn.call(verb, body, timeout=timeout)
+        except RpcError:
+            raise
+        except Exception:
+            # connection damaged: drop it so the next call redials
+            with self._lock:
+                if self._conn is conn:
+                    self._conn = None
             try:
-                return self._conn.call(verb, body, timeout=timeout)
-            except RpcError:
-                raise
+                conn.close()
             except Exception:
-                # connection damaged: drop it so the next call redials
-                try:
-                    self._conn.close()
-                except Exception:
-                    pass
-                self._conn = None
-                raise
+                pass
+            raise
+
+    def _shed_guard(self, out: dict, what: str) -> None:
+        """Raise the typed shed error when a verb answered with an
+        admission shed verdict (status 429 + shed marker)."""
+        if not out.get("shed"):
+            return
+        with self._stats_lock:
+            self._stats["shed_seen"] += 1
+        raise GatewayShedError(
+            f"{what} shed by gateway admission "
+            f"({out.get('mode', '?')}): retry after "
+            f"{out.get('retry_after_ms', 0)}ms",
+            mode=str(out.get("mode", "")),
+            retry_after_ms=int(out.get("retry_after_ms", 0)),
+            severity=int(out.get("severity_milli", 0)) / 1000.0)
+
+    def _with_shed_retry(self, fn: Callable[[], dict]) -> dict:
+        """Run a verb, honoring shed verdicts with capped jittered
+        backoff seeded per client: delay = min(hint, cap) * U[0.5, 1.5)
+        * 2^(attempt-1), capped — so a shed population de-synchronizes
+        instead of re-stampeding in lockstep at the hint boundary."""
+        attempt = 0
+        while True:
+            try:
+                return fn()
+            except GatewayShedError as exc:
+                if attempt >= self.shed_retry_max:
+                    with self._stats_lock:
+                        self._stats["shed_exhausted"] += 1
+                    raise
+                attempt += 1
+                with self._stats_lock:
+                    self._stats["shed_retries"] += 1
+                base = min(max(exc.retry_after_ms, 50) / 1000.0,
+                           self.shed_backoff_cap_s)
+                delay = base * (0.5 + self._rand.random()) \
+                    * (2 ** (attempt - 1))
+                time.sleep(min(delay, self.shed_backoff_cap_s))
+
+    def stats(self) -> dict:
+        """Client-perceived shed counters (the workload runner's view of
+        admission behaviour from outside the node)."""
+        with self._stats_lock:
+            return dict(self._stats)
 
     def _channel(self, channel: Optional[str]) -> str:
         ch = channel or self.channel_id
@@ -106,9 +186,15 @@ class GatewayClient:
         """Query: endorse on the gateway peer only, return the payload."""
         ch = self._channel(channel)
         sp = signed_proposal(ch, chaincode_id, fn, args, self.signer)
-        out = self._call("gateway.evaluate",
-                         {"channel": ch, "proposal": sp.proposal_bytes,
-                          "signature": sp.signature})
+
+        def _once() -> dict:
+            out = self._call("gateway.evaluate",
+                             {"channel": ch, "proposal": sp.proposal_bytes,
+                              "signature": sp.signature})
+            self._shed_guard(out, "evaluate")
+            return out
+
+        out = self._with_shed_retry(_once)
         if out.get("status") != 200:
             raise GatewayError(
                 f"evaluate failed: {out.get('message', '')}",
@@ -122,9 +208,15 @@ class GatewayClient:
         proposal plus responses ready for assemble_transaction."""
         ch = self._channel(channel)
         sp = signed_proposal(ch, chaincode_id, fn, args, self.signer)
-        out = self._call("gateway.endorse",
-                         {"channel": ch, "proposal": sp.proposal_bytes,
-                          "signature": sp.signature})
+
+        def _once() -> dict:
+            out = self._call("gateway.endorse",
+                             {"channel": ch, "proposal": sp.proposal_bytes,
+                              "signature": sp.signature})
+            self._shed_guard(out, "endorse")
+            return out
+
+        out = self._with_shed_retry(_once)
         if out.get("status") != 200 or not out.get("endorsements"):
             raise GatewayError(
                 f"endorse failed: {out.get('message', '')}",
@@ -143,9 +235,15 @@ class GatewayClient:
         if timeout_s is not None:
             # serde is float-free by design: timeouts ride as int ms
             body["timeout_ms"] = int(timeout_s * 1000)
-        out = self._call("gateway.submit", body,
-                         timeout=max((timeout_s or 20.0) + 10.0,
-                                     self._call_timeout))
+
+        def _once() -> dict:
+            out = self._call("gateway.submit", body,
+                             timeout=max((timeout_s or 20.0) + 10.0,
+                                         self._call_timeout))
+            self._shed_guard(out, "submit")
+            return out
+
+        out = self._with_shed_retry(_once)
         if out.get("status") != 200:
             raise GatewayError(
                 f"submit failed ({out.get('status')}): "

@@ -25,6 +25,7 @@ from typing import Dict, List, Optional
 
 from fabric_tpu.comm import connect
 from fabric_tpu.endorser.proposal import SignedProposal
+from fabric_tpu.gateway import admission as _admission
 from fabric_tpu.gateway.broadcaster import BatchBroadcaster
 from fabric_tpu.gateway.notifier import CommitNotifier
 from fabric_tpu.ops_plane import registry, tracing
@@ -43,7 +44,7 @@ class _Pending:
     covered submit path never materializes an Envelope object."""
 
     __slots__ = ("raw", "txid", "channel_id", "event", "status", "info",
-                 "ctx", "span_queue")
+                 "ctx", "span_queue", "t_in")
 
     def __init__(self, raw: bytes, txid: str, channel_id: str):
         self.raw = raw
@@ -52,6 +53,7 @@ class _Pending:
         self.event = threading.Event()
         self.status = 0
         self.info = ""
+        self.t_in = time.monotonic()   # gateway-sojourn start (admission)
         # tracing: the submitter's span context + its queue-wait span,
         # started on the submit thread and ended by the batcher thread
         self.ctx = tracing.tracer.current_context()
@@ -110,6 +112,16 @@ class GatewayService:
         self._m_backpressure = registry.counter(
             "gateway_backpressure_total",
             "submissions rejected on a full admission queue")
+        # SLO-driven admission control: typed shed verdicts BEFORE the
+        # queue-full cliff.  The burn source reads the node's
+        # SloEvaluator lazily (peer wiring creates slo after the
+        # gateway); queue occupancy reads the list length lock-free
+        # (len() is atomic; the controller EWMAs it).
+        self.admission = _admission.AdmissionController(
+            cfg.get("admission"),
+            burn_source=self._admission_burn,
+            queue_source=lambda: len(self._queue) / float(
+                max(1, self.max_queue)))
         # commit notifiers attach per channel as channels are touched
         for ch in getattr(node, "channels", {}).values():
             self._notifier(ch)
@@ -138,6 +150,7 @@ class GatewayService:
                          "inflight": inflight,
                          "dedup_window": recent,
                          "healthy": self.broadcaster.healthy(),
+                         "admission": self.admission.snapshot(),
                          "orderers": self.broadcaster.states()}
         ops.register_route("GET", "/gateway", _gateway)
 
@@ -153,6 +166,17 @@ class GatewayService:
         self.broadcaster.close()
 
     # helpers -----------------------------------------------------------
+
+    def _admission_burn(self):
+        """Max short-window SLO burn from the hosting node's evaluator
+        (None when the node has no SLO plane or no data yet)."""
+        slo = getattr(self.node, "slo", None)
+        if slo is None:
+            return None
+        try:
+            return slo.burn_state().get("max_burn_short")
+        except Exception:
+            return None
 
     def _notifier(self, ch) -> CommitNotifier:
         with self._lock:
@@ -177,6 +201,15 @@ class GatewayService:
         nothing reaches the orderer (read path / queries)."""
         t0 = time.monotonic()
         try:
+            # evaluates shed FIRST under overload: queries can retry on
+            # any peer, and rejecting them frees endorsement simulation
+            # capacity for submits that already paid for theirs
+            shed = self.admission.admit("evaluate")
+            if shed is not None:
+                return dict(shed.body(), status=_admission.SHED_STATUS,
+                            message=f"admission shed ({shed.mode}): "
+                                    "gateway overloaded, retry later",
+                            payload=b"")
             ch = self.node._chan(body)
             sp = SignedProposal(body["proposal"], body["signature"])
             resp = ch.endorser.process_proposal(sp)
@@ -191,6 +224,12 @@ class GatewayService:
         gateway round trip (gateway/endorse.go's plan execution)."""
         t0 = time.monotonic()
         try:
+            shed = self.admission.admit("endorse")
+            if shed is not None:
+                return dict(shed.body(), status=_admission.SHED_STATUS,
+                            message=f"admission shed ({shed.mode}): "
+                                    "gateway overloaded, retry later",
+                            payload=b"", endorsements=[])
             ch = self.node._chan(body)
             sp = SignedProposal(body["proposal"], body["signature"])
             resp = ch.endorser.process_proposal(sp)
@@ -261,6 +300,25 @@ class GatewayService:
                     return {"txid": txid, "status": st, "info": info,
                             "deduped": True}
                 if pending is None:
+                    # shed check AFTER the dedup window: a retry of an
+                    # already-admitted txid must attach/replay, never be
+                    # shed — overload control cannot break idempotency.
+                    # Distinct from queue-full backpressure below: shed
+                    # is a typed retryable verdict with a retry-after
+                    # hint, backpressure is "lost the race this instant".
+                    shed = self.admission.admit("submit")
+                    if shed is not None:
+                        jlog(logger, "gateway.shed",
+                             level=logging.WARNING, txid=txid,
+                             channel=channel_id, mode=shed.mode,
+                             retry_after_ms=shed.retry_after_ms,
+                             severity=round(shed.severity, 3))
+                        return dict(
+                            shed.body(), txid=txid,
+                            status=_admission.SHED_STATUS,
+                            info=f"admission shed ({shed.mode}): gateway "
+                                 "overloaded, retry after "
+                                 f"{shed.retry_after_ms}ms")
                     if len(self._queue) >= self.max_queue:
                         self._m_backpressure.add(1)
                         jlog(logger, "gateway.backpressure",
@@ -413,5 +471,13 @@ class GatewayService:
                     self._recent[p.txid] = (p.status, p.info)
                 while len(self._recent) > self.recent_window:
                     self._recent.popitem(last=False)
+            # feed per-tx gateway sojourn (queue wait + broadcast) into
+            # the admission controller's latency EWMA
+            done = time.monotonic()
+            for p in batch:
+                try:
+                    self.admission.observe_latency(done - p.t_in)
+                except Exception:
+                    pass
             for p in batch:
                 p.event.set()

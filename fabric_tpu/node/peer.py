@@ -92,8 +92,23 @@ def _asset_contract():
         stub.put_private_data(collection.decode(), key.decode(), value)
         return b"ok"
 
+    def bump(stub, key):
+        # read-modify-write upsert: records a read (version None when
+        # absent) so two concurrent bumps of one key MVCC-conflict —
+        # the workload plane's conflict dial rides on this
+        cur = stub.get_state(key.decode())
+        n = int(cur or b"0") + 1
+        stub.put_state(key.decode(), str(n).encode())
+        return str(n).encode()
+
+    def scan(stub, start, end):
+        # range read: stages a RangeQueryInfo, so a committed write
+        # landing inside [start, end) invalidates this tx (phantoms)
+        items = stub.get_state_by_range(start.decode(), end.decode())
+        return str(len(items)).encode()
+
     return FuncContract(create=create, read=read, transfer=transfer,
-                        put_private=put_private)
+                        put_private=put_private, bump=bump, scan=scan)
 
 
 DEV_CONTRACTS = {"asset_demo": _asset_contract}
@@ -651,7 +666,14 @@ class PeerNode:
         self.gateway = None
         if self.orderers and cfg.get("gateway_enabled", True):
             from fabric_tpu.gateway import GatewayService
-            self.gateway = GatewayService(self, cfg.get("gateway", {}))
+            # `admission {enabled, shed_evaluate_burn, shed_hard_burn,
+            # ...}` may live at the node top level (env-overridable as
+            # FABRIC_TPU_PEER_ADMISSION__*) or nested under `gateway`;
+            # top level wins so one flag flips shedding on a deployment
+            gw_cfg = dict(cfg.get("gateway", {}))
+            if cfg.get("admission") is not None:
+                gw_cfg["admission"] = cfg.get("admission")
+            self.gateway = GatewayService(self, gw_cfg)
             self.gateway.register(self.rpc)
         # speculative verifier: stamps creator verdicts at ingress and
         # verifies endorsement sets while the orderer cuts the block —

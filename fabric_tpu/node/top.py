@@ -137,6 +137,10 @@ def collect_node(addr: str, timeout: float = 2.0) -> dict:
     row["queue_depth"] = _sum(metrics.get("provider_dispatch_queue_depth"))
     row["breakers_open"] = _sum(metrics.get("gateway_orderer_breaker_open"))
     row["faults_fired"] = _sum(metrics.get("fault_injected_total"))
+    # admission plane: current shed state + lifetime shed count
+    row["shed_total"] = _sum(metrics.get("gateway_shed_total"))
+    adm = [v for _, v in metrics.get("gateway_admission_state", ()) or ()]
+    row["admission_state"] = max(adm) if adm else None
     # verify-once plane: cache hit rate over all lookups, and the
     # rolling fraction of committed verify items whose verdicts were
     # speculatively cached before the block arrived
@@ -217,9 +221,23 @@ def _fmt_devices(devs) -> str:
 
 
 _COLS = ("NODE", "HT", "TX/S", "COLLECT", "DISP", "GATE", "COMMIT",
-         "OCC", "DEV", "OVLP", "VCACHE", "SPEC", "QD", "BRKR", "FAULTS",
-         "SLO", "HEALTH")
-_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 4, 5, 7, 12, 8)
+         "OCC", "DEV", "OVLP", "VCACHE", "SPEC", "QD", "BRKR", "SHED",
+         "FAULTS", "SLO", "HEALTH")
+_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 4, 5, 9, 7, 12, 8)
+
+# gateway_admission_state gauge value -> short cell tag
+_ADM_SHORT = {0: "ok", 1: "EVAL", 2: "PROB", 3: "HARD"}
+
+
+def _fmt_shed(row: dict) -> str:
+    """`<state>/<shed count>`: `ok/0` while admitting, `PROB/1234` mid-
+    shed; `-` when the node runs no gateway (orderers)."""
+    st = row.get("admission_state")
+    shed = row.get("shed_total") or 0.0
+    if st is None and not shed:
+        return "-"
+    name = _ADM_SHORT.get(int(st or 0), "?")
+    return f"{name}/{shed:.0f}"
 
 # --sort column -> row key; None values sort last, numeric descending
 # (the interesting rows — hottest, furthest ahead, most alerting — rise)
@@ -228,7 +246,7 @@ _SORT_KEYS = {
     "ovlp": "overlap", "qd": "queue_depth", "brkr": "breakers_open",
     "faults": "faults_fired", "slo": "slo_alerting", "height": "height",
     "rate": "rate", "occupancy": "occupancy", "dev": "devices",
-    "vcache": "vcache", "spec": "spec",
+    "vcache": "vcache", "spec": "spec", "shed": "shed_total",
 }
 
 
@@ -281,6 +299,7 @@ def render(rows: List[dict]) -> str:
             _fmt_pct(r.get("vcache")), _fmt_pct(r.get("spec")),
             f"{r.get('queue_depth', 0):.0f}",
             f"{r.get('breakers_open', 0):.0f}",
+            _fmt_shed(r),
             faults, slo, str(r.get("health", "?")))
         lines.append("  ".join(str(c).ljust(w)
                                for c, w in zip(cells, _WIDTHS)))

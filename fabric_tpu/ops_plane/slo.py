@@ -438,6 +438,19 @@ class SloEvaluator:
             return {"active": [dict(r) for r in self._active.values()],
                     "history": [dict(r) for r in self._history]}
 
+    def burn_state(self) -> dict:
+        """Consumer view for load-control planes (gateway admission):
+        the max short-window burn across objectives plus the per-
+        objective burns.  Reads the LAST evaluation only — never
+        re-samples — so callers may poll it on a hot path."""
+        with self._lock:
+            statuses = list(self._last_status)
+            active = sorted(self._active)
+        burns = {s["name"]: s["burn_short"] for s in statuses
+                 if s.get("burn_short") is not None}
+        return {"max_burn_short": max(burns.values()) if burns else None,
+                "alerting": active, "burns": burns}
+
     # -- background thread ---------------------------------------------------
 
     def start(self) -> "SloEvaluator":

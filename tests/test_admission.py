@@ -1,0 +1,408 @@
+"""Admission control (fabric_tpu/gateway/admission): SLO-driven shed.
+
+Controller tests drive synthetic burn/queue/latency trajectories
+through an injected clock — no node, no sleeping — and pin the state
+machine exactly: escalation is immediate, recovery is hysteretic (one
+state per dwell, only below recover_ratio x the entry threshold),
+evaluates shed before submits, and the probabilistic coin is seeded.
+
+Service tests check the wire shape: a shed rides as a TYPED 429 body
+(never an exception string), dedup outranks shed for an already-seen
+txid, and — on a LIVE one-orderer topology — GatewayClient turns the
+body into GatewayShedError, retries with capped backoff, and counts
+what it saw.
+"""
+
+import json
+import time
+
+import pytest
+
+from fabric_tpu.config import BatchConfig
+from fabric_tpu.gateway.admission import (
+    NORMAL,
+    SHED_EVALUATE,
+    SHED_HARD,
+    SHED_PROBABILISTIC,
+    SHED_STATUS,
+    AdmissionController,
+)
+from fabric_tpu.node.orderer import OrdererNode, load_signing_identity
+from fabric_tpu.node.peer import PeerNode
+from fabric_tpu.node.provision import provision_network
+from fabric_tpu.protocol.txflags import ValidationCode
+
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    return init_factories(FactoryOpts(default="SW"))
+
+
+def _controller(cfg=None, burn=None, queue=None):
+    """Controller on a hand-cranked clock with dict-backed signals."""
+    sig = {"burn": None, "queue": 0.0}
+    clk = [0.0]
+    if burn is not None:
+        sig["burn"] = burn
+    base = {"enabled": True, "dwell_s": 1.0, "eval_interval_s": 0.0}
+    base.update(cfg or {})
+    c = AdmissionController(
+        base,
+        burn_source=lambda: sig["burn"],
+        queue_source=(lambda: sig["queue"]) if queue is None else queue,
+        clock=lambda: clk[0])
+    return c, sig, clk
+
+
+# -- state machine -------------------------------------------------------
+
+
+def test_disabled_controller_admits_everything():
+    c = AdmissionController({"enabled": False},
+                            burn_source=lambda: 100.0)
+    for verb in ("evaluate", "endorse", "submit"):
+        assert c.admit(verb) is None
+    assert c.state == NORMAL
+
+
+def test_threshold_ordering_is_validated():
+    with pytest.raises(ValueError, match="thresholds"):
+        AdmissionController({"shed_evaluate_burn": 3.0,
+                             "shed_probabilistic_burn": 2.0})
+    with pytest.raises(ValueError, match="thresholds"):
+        AdmissionController({"shed_evaluate_burn": 0.0})
+
+
+def test_escalation_is_immediate():
+    c, sig, clk = _controller()
+    assert c.evaluate_state() == NORMAL
+    sig["burn"] = 1.2                 # past evaluate (1.0)
+    assert c.evaluate_state() == SHED_EVALUATE
+    sig["burn"] = 5.0                 # past hard (4.0): skips straight up
+    assert c.evaluate_state() == SHED_HARD
+    # two transitions, both recorded with severities
+    trans = c.snapshot()["transitions"]
+    assert [(t["from"], t["to"]) for t in trans] == [
+        ("NORMAL", "SHED_EVALUATE"), ("SHED_EVALUATE", "SHED_HARD")]
+
+
+def test_recovery_steps_down_one_state_per_dwell():
+    c, sig, clk = _controller()
+    sig["burn"] = 5.0
+    assert c.evaluate_state() == SHED_HARD
+    sig["burn"] = 0.1                 # overload clears instantly ...
+    assert c.evaluate_state() == SHED_HARD      # ... but no dwell yet
+    clk[0] = 1.5
+    assert c.evaluate_state() == SHED_PROBABILISTIC   # one step only
+    assert c.evaluate_state() == SHED_PROBABILISTIC   # dwell restarts
+    clk[0] = 3.0
+    assert c.evaluate_state() == SHED_EVALUATE
+    clk[0] = 4.5
+    assert c.evaluate_state() == NORMAL
+
+
+def test_no_recovery_while_severity_above_recover_ratio():
+    # entry threshold for SHED_PROBABILISTIC is 2.0; recover_ratio 0.7
+    # puts the exit bar at 1.4 — severity 1.6 must hold the state no
+    # matter how long it dwells
+    c, sig, clk = _controller()
+    sig["burn"] = 2.5
+    assert c.evaluate_state() == SHED_PROBABILISTIC
+    sig["burn"] = 1.6
+    clk[0] = 100.0
+    assert c.evaluate_state() == SHED_PROBABILISTIC
+    sig["burn"] = 1.3                 # below the bar -> step down
+    clk[0] = 200.0
+    assert c.evaluate_state() == SHED_EVALUATE
+
+
+def test_evaluates_shed_before_submits():
+    c, sig, clk = _controller()
+    sig["burn"] = 1.2
+    assert c.evaluate_state() == SHED_EVALUATE
+    assert c.admit("evaluate") is not None     # queries bounce first
+    assert c.admit("endorse") is not None      # endorse sheds with them
+    assert c.admit("submit") is None           # paid-for work proceeds
+
+
+def test_hard_sheds_every_verb_with_typed_decision():
+    c, sig, clk = _controller()
+    sig["burn"] = 9.0
+    c.evaluate_state()
+    for verb in ("evaluate", "endorse", "submit"):
+        d = c.admit(verb)
+        assert d is not None
+        body = d.body()
+        assert body["shed"] is True
+        assert body["mode"] == "SHED_HARD"
+        assert body["retry_after_ms"] > 0
+
+
+def test_probabilistic_coin_is_seeded_and_severity_weighted():
+    def verdicts(seed, burn, n=40):
+        c, sig, clk = _controller({"seed": seed})
+        sig["burn"] = burn
+        c.evaluate_state()
+        assert c.state == SHED_PROBABILISTIC
+        return [c.admit("submit") is None for _ in range(n)]
+
+    a = verdicts(5, 2.5)
+    b = verdicts(5, 2.5)
+    assert a == b                       # same seed -> same coin flips
+    assert any(a) and not all(a)        # mid-band: mixed verdicts
+    # severity at the hard threshold drives p to 1: everything sheds
+    assert not any(verdicts(5, 3.999))
+
+
+def test_retry_after_grows_with_severity_and_caps():
+    c, sig, clk = _controller({"retry_after_base_ms": 100,
+                               "retry_after_max_ms": 1000})
+    sig["burn"] = 5.0
+    c.evaluate_state()
+    mild = c.admit("submit").retry_after_ms
+    sig["burn"] = 50.0
+    c.evaluate_state()
+    assert c.admit("submit").retry_after_ms == 1000    # capped
+    assert mild < 1000
+
+
+def test_queue_and_latency_signals_drive_severity():
+    c, sig, clk = _controller({"queue_high_frac": 0.5,
+                               "latency_slo_s": 1.0})
+    sig["queue"] = 1.0                  # queue at 2x the high-water mark
+    c.evaluate_state()                  # EWMA needs a couple of samples
+    c.evaluate_state()
+    assert c.snapshot()["severity"] > 1.0
+    assert c.state >= SHED_EVALUATE
+
+    c2, sig2, _ = _controller({"latency_slo_s": 1.0})
+    for _ in range(20):
+        c2.observe_latency(3.0)         # acks at 3x the latency SLO
+    c2.evaluate_state()
+    assert c2.snapshot()["severity"] == pytest.approx(3.0, rel=0.05)
+    assert c2.state == SHED_PROBABILISTIC
+
+
+def test_stale_latency_evidence_decays_for_recovery():
+    # the latency EWMA only refreshes when a batch completes; once shed
+    # has stopped all traffic a frozen overload-era reading must decay
+    # or the controller wedges in a shed state forever
+    c, sig, clk = _controller({"latency_slo_s": 0.4, "dwell_s": 0.5})
+    for _ in range(10):
+        c.observe_latency(1.2)            # 3x the SLO, sampled at t=0
+    assert c.evaluate_state() == SHED_PROBABILISTIC
+    clk[0] = 0.3                          # inside the dwell: holds
+    assert c.evaluate_state() == SHED_PROBABILISTIC
+    clk[0] = 10.0                         # 20 dwells with zero samples
+    assert c.evaluate_state() == SHED_EVALUATE     # one step per dwell
+    clk[0] = 10.6
+    assert c.evaluate_state() == NORMAL
+
+
+def test_snapshot_carries_signals_and_thresholds():
+    c, sig, clk = _controller()
+    sig["burn"] = 2.5
+    c.evaluate_state()
+    snap = c.snapshot()
+    assert snap["enabled"] is True
+    assert snap["state"] == "SHED_PROBABILISTIC"
+    assert snap["signals"]["burn"] == 2.5
+    assert snap["thresholds"]["shed_hard_burn"] == 4.0
+    assert snap["transitions"][-1]["to"] == "SHED_PROBABILISTIC"
+
+
+# -- service wire shape (unit: no batcher, no network) -------------------
+
+
+def _unit_service(admission_cfg):
+    from types import SimpleNamespace
+
+    from fabric_tpu.gateway.service import GatewayService
+    from fabric_tpu.msp.ca import DevOrg
+
+    org = DevOrg("Org1")
+    signer = org.new_identity("u1")
+    node = SimpleNamespace(orderers=[("127.0.0.1", 1)], signer=signer,
+                           msps={}, channels={}, peers=[])
+    svc = GatewayService(node, {"max_queue": 4,
+                                "admission": admission_cfg})
+    return svc, signer
+
+
+def _unit_env(signer, i):
+    from fabric_tpu.protocol import KVWrite, NsRwSet, TxRwSet, build
+    rw = TxRwSet((NsRwSet("cc", writes=(KVWrite(f"k{i}", b"v"),)),))
+    return build.endorser_tx("ch", "cc", "1.0", rw, signer,
+                             [signer]).serialize()
+
+
+def test_submit_shed_is_a_typed_body_and_dedup_outranks_it():
+    svc, signer = _unit_service({"enabled": True, "dwell_s": 3600.0})
+    try:
+        env0 = _unit_env(signer, 0)
+        first = svc._rpc_submit({"envelope": env0, "timeout_ms": 0}, None)
+        assert first["status"] == 0        # queued (batcher not started)
+
+        svc.admission.force_state(SHED_HARD)
+        # a NEW tx sheds: typed body, 429 status, never an exception
+        shed = svc._rpc_submit({"envelope": _unit_env(signer, 1),
+                                "timeout_ms": 0}, None)
+        assert shed["shed"] is True
+        assert shed["status"] == SHED_STATUS
+        assert shed["mode"] == "SHED_HARD"
+        assert shed["retry_after_ms"] > 0
+        # the ALREADY-ADMITTED txid is absorbed by dedup, not shed:
+        # a client retrying through a shed window must not double-order
+        dup = svc._rpc_submit({"envelope": env0, "timeout_ms": 0}, None)
+        assert dup.get("deduped") is True
+        assert "shed" not in dup
+    finally:
+        svc.stop()
+
+
+def test_gateway_surface_reports_admission():
+    svc, _ = _unit_service({"enabled": True, "dwell_s": 3600.0})
+    try:
+        svc.admission.force_state(SHED_EVALUATE)
+        snap = svc.admission.snapshot()
+        assert snap["state"] == "SHED_EVALUATE"
+        assert snap["transitions"]
+    finally:
+        svc.stop()
+
+
+# -- live round trip -----------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def net(tmp_path_factory):
+    """One orderer + one Org1 peer with admission armed but idle
+    (dwell pinned high so force_state decisions stick)."""
+    base = str(tmp_path_factory.mktemp("admnet"))
+    paths = provision_network(
+        base, n_orderers=1, peer_orgs=["Org1"], peers_per_org=1,
+        batch=BatchConfig(max_message_count=8, timeout_s=0.05))
+    orderers, peers = [], []
+    try:
+        for p in paths["orderers"]:
+            with open(p) as f:
+                cfg = json.load(f)
+            orderers.append(OrdererNode(cfg, data_dir=cfg["data_dir"])
+                            .start())
+        for p in paths["peers"]:
+            with open(p) as f:
+                cfg = json.load(f)
+            cfg["gateway"] = {
+                "linger_s": 0.002, "max_batch": 8,
+                "admission": {"enabled": True, "dwell_s": 3600.0,
+                              "retry_after_base_ms": 50}}
+            peers.append(PeerNode(cfg, data_dir=cfg["data_dir"]).start())
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if any(o.support.chain.node.role == "leader"
+                   for o in orderers):
+                break
+            time.sleep(0.2)
+        else:
+            raise AssertionError("no raft leader elected")
+        yield {"paths": paths, "orderers": orderers, "peers": peers}
+    finally:
+        for n in peers + orderers:
+            try:
+                n.stop()
+            except Exception:
+                pass
+
+
+def _client(net, **kw):
+    from fabric_tpu.gateway import GatewayClient
+    with open(net["paths"]["clients"]["Org1"]) as f:
+        cc = json.load(f)
+    signer = load_signing_identity(cc["mspid"], cc["cert_pem"].encode(),
+                                   cc["key_pem"].encode())
+    peer = net["peers"][0]
+    return GatewayClient(peer.rpc.addr, signer, peer.msps,
+                         channel_id="ch", **kw), signer
+
+
+@pytest.mark.slow
+def test_shed_round_trips_as_typed_error_with_client_stats(net):
+    from fabric_tpu.gateway import GatewayShedError
+
+    adm = net["peers"][0].gateway.admission
+    gw, _ = _client(net, shed_retry_max=1, shed_backoff_cap_s=0.2)
+    try:
+        adm.force_state(SHED_HARD)
+        t0 = time.monotonic()
+        with pytest.raises(GatewayShedError) as exc:
+            gw.submit_transaction("assets", "bump", [b"adm-live-1"])
+        assert exc.value.mode == "SHED_HARD"
+        assert exc.value.retry_after_ms > 0
+        assert exc.value.status == SHED_STATUS
+        # one retry happened (with real backoff) before giving up
+        st = gw.stats()
+        assert st["shed_seen"] == 2
+        assert st["shed_retries"] == 1
+        assert st["shed_exhausted"] == 1
+        assert time.monotonic() - t0 >= 0.02     # backoff actually slept
+        # recovery: the same client commits once the node is healthy
+        adm.force_state(NORMAL)
+        code, _ = gw.submit_transaction("assets", "bump", [b"adm-live-1"])
+        assert code == int(ValidationCode.VALID)
+    finally:
+        adm.force_state(NORMAL)
+        gw.close()
+
+
+@pytest.mark.slow
+def test_evaluate_sheds_while_submit_proceeds(net):
+    from fabric_tpu.gateway import GatewayShedError
+
+    from fabric_tpu.endorser.proposal import assemble_transaction
+
+    adm = net["peers"][0].gateway.admission
+    gw, signer = _client(net, shed_retry_max=0)
+    try:
+        # endorsement is pre-ordering work: collect it while healthy
+        sp, responses = gw.endorse("assets", "bump", [b"adm-live-2"])
+        env = assemble_transaction(sp, responses, signer)
+        adm.force_state(SHED_EVALUATE)
+        # queries bounce first (and endorse sheds with them) ...
+        with pytest.raises(GatewayShedError) as exc:
+            gw.evaluate("assets", "bump", [b"adm-live-2"])
+        assert exc.value.mode == "SHED_EVALUATE"
+        with pytest.raises(GatewayShedError):
+            gw.endorse("assets", "bump", [b"adm-live-2b"])
+        # ... but a submit whose endorsement is already paid for admits
+        out = gw.submit_envelope(env)
+        code, _ = gw.commit_status(out["txid"])
+        assert code == int(ValidationCode.VALID)
+    finally:
+        adm.force_state(NORMAL)
+        gw.close()
+
+
+@pytest.mark.slow
+def test_dedup_window_unaffected_by_shed_retries(net):
+    from fabric_tpu.endorser.proposal import assemble_transaction
+
+    adm = net["peers"][0].gateway.admission
+    gw, signer = _client(net, shed_retry_max=0)
+    try:
+        sp, responses = gw.endorse("assets", "bump", [b"adm-live-3"])
+        env = assemble_transaction(sp, responses, signer)
+        txid = env.header().channel_header.txid
+        out = gw.submit_envelope(env)
+        assert out["txid"] == txid
+        code, _ = gw.commit_status(txid)
+        assert code == int(ValidationCode.VALID)
+        # the node goes hard-shed; a client retrying the SAME envelope
+        # must hit the dedup window (absorbed), not the shed path —
+        # exactly-once survives overload
+        adm.force_state(SHED_HARD)
+        dup = gw.submit_envelope(env)
+        assert dup.get("deduped") is True
+    finally:
+        adm.force_state(NORMAL)
+        gw.close()

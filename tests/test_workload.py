@@ -1,0 +1,347 @@
+"""Workload plane (fabric_tpu/workload): the open-loop load generator.
+
+Everything here runs WITHOUT a network and WITHOUT sleeping: arrival
+schedules are pure functions of (params, seed, duration), the scheduler
+takes an injected clock, and the conflict dial has an analytic form —
+so the tests pin exact determinism, statistical shape, and monotonicity
+rather than wall-clock behavior:
+
+  - schedules are byte-identical across re-draws, differ across seeds
+  - Poisson counts land within sampling tolerance of rate * duration
+  - ramp / square-wave / diurnal profiles shape WHERE arrivals land
+  - OpenLoopScheduler fires at schedule offsets under a fake clock and
+    keeps firing (open loop) when the fake clock says it is behind
+  - Zipf sampler: pmf normalizes, hot-rank frequency tracks pmf, s=0
+    degenerates to uniform
+  - conflict dial: expected_collision_p strictly monotone in s,
+    empirical same-key collision rate follows it
+  - fault-schedule envelopes (comm/faults): ramp/burst/window factors,
+    schedule gating under an injected plan clock, and draw-sequence
+    stability in and out of the envelope's active phase
+"""
+
+import collections
+import random
+
+import pytest
+
+from fabric_tpu.comm.faults import FaultPlan, FaultSchedule
+from fabric_tpu.workload import (
+    ConstantArrivals,
+    DiurnalArrivals,
+    OpenLoopScheduler,
+    RampArrivals,
+    SquareWaveArrivals,
+    TrafficMix,
+    ZipfSampler,
+    expected_collision_p,
+    from_spec,
+)
+
+
+# -- arrival schedules: determinism --------------------------------------
+
+
+def test_schedule_is_pure_function_of_seed():
+    a = ConstantArrivals(40.0, seed=11).schedule(10.0)
+    b = ConstantArrivals(40.0, seed=11).schedule(10.0)
+    c = ConstantArrivals(40.0, seed=12).schedule(10.0)
+    assert a == b                      # byte-identical re-draw
+    assert a != c                      # seed actually matters
+    assert a == sorted(a)              # ascending offsets
+    assert all(0.0 <= t < 10.0 for t in a)
+
+
+def test_schedule_empty_on_degenerate_inputs():
+    assert ConstantArrivals(0.0).schedule(10.0) == []
+    assert ConstantArrivals(50.0).schedule(0.0) == []
+
+
+def test_poisson_count_within_sampling_tolerance():
+    # N ~ Poisson(rate * T): mean 1000, sd ~ 31.6; 5 sd is one-in-3M
+    sched = ConstantArrivals(50.0, seed=3).schedule(20.0)
+    assert abs(len(sched) - 1000) < 160
+
+
+def test_ramp_concentrates_arrivals_late():
+    sched = RampArrivals(1.0, 100.0, ramp_s=10.0, seed=5).schedule(10.0)
+    early = sum(1 for t in sched if t < 5.0)
+    late = len(sched) - early
+    # integral of rate over [0,5) vs [5,10) is ~1:3 — just pin the order
+    assert late > 2 * early
+
+
+def test_square_wave_respects_duty_windows():
+    p = SquareWaveArrivals(0.0, 80.0, period_s=10.0, duty=0.3, seed=9)
+    sched = p.schedule(20.0)
+    assert sched, "high_rate=80 over two duty windows must fire"
+    # low_rate=0: every arrival must land inside a duty window
+    assert all((t % 10.0) / 10.0 < 0.3 for t in sched)
+
+
+def test_diurnal_mean_rate_tracks_base():
+    p = DiurnalArrivals(30.0, amplitude=0.8, period_s=10.0, seed=1)
+    # the sinusoid averages out over whole periods
+    assert p.mean_rate(20.0) == pytest.approx(30.0, rel=0.05)
+    assert p.max_rate() == pytest.approx(54.0)
+
+
+def test_from_spec_round_trip_and_unknown_kind():
+    p = from_spec({"kind": "ramp", "start_rate": 2.0, "end_rate": 20.0,
+                   "ramp_s": 5.0}, seed=4)
+    assert isinstance(p, RampArrivals)
+    assert p.schedule(5.0) == RampArrivals(2.0, 20.0, 5.0,
+                                           seed=4).schedule(5.0)
+    with pytest.raises(ValueError, match="unknown arrival kind"):
+        from_spec({"kind": "fractal"})
+
+
+# -- open-loop scheduler under an injected clock -------------------------
+
+
+class _FakeClock:
+    """Monotonic clock the test advances; sleep() moves it forward."""
+
+    def __init__(self):
+        self.t = 100.0
+
+    def now(self):
+        return self.t
+
+    def sleep(self, s):
+        self.t += s
+
+
+def test_scheduler_fires_every_offset_without_real_time():
+    clk = _FakeClock()
+    fired = []
+    sched = OpenLoopScheduler(
+        [0.1, 0.5, 0.9], lambda i, t: fired.append((i, t, clk.t)),
+        clock=clk.now, sleep=clk.sleep)
+    sched.run()
+    assert sched.fired == 3
+    assert [(i, t) for i, t, _ in fired] == [(0, 0.1), (1, 0.5), (2, 0.9)]
+    # each fire happened at (t0 + offset) on the injected clock
+    for _, off, at in fired:
+        assert at == pytest.approx(100.0 + off, abs=1e-9)
+    assert sched.max_skew_s == pytest.approx(0.0, abs=1e-9)
+
+
+def test_scheduler_is_open_loop_when_behind():
+    # a fire handler that stalls the clock past the NEXT offset: the
+    # scheduler must still fire it (late, recorded as skew) instead of
+    # dropping or rescheduling — that is the open-loop contract
+    clk = _FakeClock()
+    fired = []
+
+    def slow_fire(i, t):
+        fired.append(i)
+        clk.t += 1.0               # blow way past the following offsets
+
+    sched = OpenLoopScheduler([0.1, 0.2, 0.3], slow_fire,
+                              clock=clk.now, sleep=clk.sleep)
+    sched.run()
+    assert fired == [0, 1, 2]      # nothing dropped
+    assert sched.max_skew_s > 0.5  # and the slippage is visible
+
+
+def test_scheduler_stop_halts_mid_schedule():
+    clk = _FakeClock()
+    fired = []
+    sched = OpenLoopScheduler([0.1, 0.2, 0.3], None,
+                              clock=clk.now, sleep=clk.sleep)
+
+    def fire(i, t):
+        fired.append(i)
+        if i == 0:
+            sched.stop()
+
+    sched.fire = fire
+    sched.run()
+    assert fired == [0]
+
+
+# -- zipf keyspace -------------------------------------------------------
+
+
+def test_zipf_pmf_normalizes_and_orders():
+    z = ZipfSampler(100, 1.2, seed=0)
+    total = sum(z.pmf(r) for r in range(1, 101))
+    assert total == pytest.approx(1.0, abs=1e-9)
+    assert z.pmf(1) > z.pmf(2) > z.pmf(50)
+
+
+def test_zipf_hot_rank_frequency_tracks_pmf():
+    z = ZipfSampler(50, 1.1, seed=7)
+    n = 20000
+    counts = collections.Counter(z.rank() for _ in range(n))
+    # rank 1 carries ~22% of the mass at s=1.1 over 50 keys; the
+    # empirical frequency must track the analytic pmf
+    assert counts[1] / n == pytest.approx(z.pmf(1), rel=0.15)
+    assert all(1 <= r <= 50 for r in counts)
+
+
+def test_zipf_s_zero_is_uniform():
+    z = ZipfSampler(10, 0.0, seed=1)
+    for r in range(1, 11):
+        assert z.pmf(r) == pytest.approx(0.1, abs=1e-9)
+
+
+def test_zipf_key_names_are_stable_across_samplers():
+    a = ZipfSampler(100, 1.0, seed=1, prefix="ch-")
+    b = ZipfSampler(100, 2.0, seed=99, prefix="ch-")
+    # different skew, different seed — same rank must map to the same
+    # key string or multi-client storms would never collide
+    assert a.key(3) == b.key(3) == "ch-000003"
+
+
+# -- the conflict dial ---------------------------------------------------
+
+
+def test_collision_p_strictly_monotone_in_s():
+    n = 256
+    vals = [expected_collision_p(n, s)
+            for s in (0.0, 0.4, 0.8, 1.0, 1.2, 1.6, 2.0)]
+    assert all(b > a for a, b in zip(vals, vals[1:]))
+    # uniform floor: sum (1/n)^2 = 1/n
+    assert vals[0] == pytest.approx(1.0 / n, abs=1e-12)
+
+
+def test_empirical_collisions_follow_the_dial():
+    # draw pairs from two independent samplers over the same keyspace
+    # (what two in-flight clients do) and count same-key picks: the
+    # empirical rate must rise with s and sit near the analytic value
+    def collision_rate(s, n=64, pairs=8000):
+        a = ZipfSampler(n, s, seed=21)
+        b = ZipfSampler(n, s, seed=22)
+        hits = sum(1 for _ in range(pairs) if a.rank() == b.rank())
+        return hits / pairs
+
+    lo, hi = collision_rate(0.2), collision_rate(1.5)
+    assert hi > 2 * lo
+    assert hi == pytest.approx(expected_collision_p(64, 1.5), rel=0.2)
+
+
+def test_traffic_mix_reproducible_and_blended():
+    spec = [{"channel": "ch", "chaincode": "assets", "weight": 1.0,
+             "keys": 128, "zipf_s": 1.0,
+             "blend": {"read": 0.3, "write": 0.6, "range": 0.1}}]
+    ops_a = TrafficMix(spec, seed=13).ops(500)
+    ops_b = TrafficMix(spec, seed=13).ops(500)
+    assert [o.as_dict() for o in ops_a] == [o.as_dict() for o in ops_b]
+    kinds = collections.Counter(o.kind for o in ops_a)
+    assert kinds["write"] > kinds["read"] > kinds["range"] > 0
+    for o in ops_a:
+        assert (o.end_key is not None) == (o.kind == "range")
+        if o.kind == "range":
+            assert o.end_key >= o.key      # scan window goes forward
+
+
+def test_traffic_mix_weights_split_channels():
+    mix = TrafficMix([
+        {"channel": "hot", "weight": 3.0, "keys": 16, "zipf_s": 0.0},
+        {"channel": "cold", "weight": 1.0, "keys": 16, "zipf_s": 0.0},
+    ], seed=5)
+    counts = collections.Counter(o.channel for o in mix.ops(4000))
+    assert counts["hot"] / counts["cold"] == pytest.approx(3.0, rel=0.2)
+    assert mix.conflict_dial() == pytest.approx(1.0 / 16, abs=1e-9)
+
+
+def test_traffic_mix_validates_inputs():
+    with pytest.raises(ValueError, match="at least one channel"):
+        TrafficMix([])
+    with pytest.raises(ValueError, match="unknown op kinds"):
+        TrafficMix([{"blend": {"write": 0.5, "burn": 0.5}}])
+
+
+# -- fault-schedule envelopes (satellite: comm/faults) -------------------
+
+
+def test_fault_schedule_shapes():
+    ramp = FaultSchedule(kind="ramp", start_s=10.0, ramp_s=10.0)
+    assert ramp.factor(5.0) == 0.0                 # before start
+    assert ramp.factor(15.0) == pytest.approx(0.5)  # halfway up
+    assert ramp.factor(30.0) == 1.0                # held at full
+
+    burst = FaultSchedule(kind="burst", period_s=10.0, duty=0.3,
+                          floor=0.1)
+    assert burst.factor(2.0) == 1.0                # inside the duty
+    assert burst.factor(5.0) == 0.1                # floor between bursts
+    assert burst.factor(12.0) == 1.0               # periodic
+
+    window = FaultSchedule(kind="window", start_s=5.0, end_s=8.0)
+    assert window.factor(4.9) == 0.0
+    assert window.factor(5.0) == 1.0
+    assert window.factor(8.0) == 0.0               # end is exclusive
+
+
+def _apply_n(plan, n):
+    """Drive n frames through the plan; return the sent/dropped mask."""
+    mask = []
+    for i in range(n):
+        sent = []
+        plan.apply(1, "broadcast", ("h", 1), "req",
+                   lambda: sent.append(1))
+        mask.append(bool(sent))
+    return mask
+
+
+def test_window_schedule_gates_faults_by_plan_time():
+    clk = [0.0]
+    plan = FaultPlan(seed=2, clock=lambda: clk[0]).rule(
+        method="*", drop=1.0,
+        schedule={"kind": "window", "start_s": 10.0, "end_s": 20.0})
+    plan.installed_at = 0.0
+    assert _apply_n(plan, 5) == [True] * 5        # before the window
+    clk[0] = 12.0
+    assert _apply_n(plan, 5) == [False] * 5       # drop=1.0 inside it
+    clk[0] = 25.0
+    assert _apply_n(plan, 5) == [True] * 5        # after it
+
+
+def test_schedule_preserves_draw_sequence():
+    # the envelope scales the PROBABILITY, not the draw count: a plan
+    # whose schedule is always active must fault the exact same frame
+    # indexes as the same-seeded plan with no schedule at all
+    def run(schedule):
+        clk = [0.0]
+        plan = FaultPlan(seed=31, clock=lambda: clk[0]).rule(
+            method="*", drop=0.5, schedule=schedule)
+        plan.installed_at = 0.0
+        return _apply_n(plan, 60)
+
+    bare = run(None)
+    always = run({"kind": "window", "start_s": 0.0})
+    never = run({"kind": "window", "start_s": 1e9})
+    assert always == bare
+    assert all(never)                             # factor 0: no faults
+    assert not all(bare)                          # drop=0.5 really fires
+
+
+def test_ramp_schedule_fires_more_late_than_early():
+    clk = [0.0]
+    plan = FaultPlan(seed=17, clock=lambda: clk[0]).rule(
+        method="*", drop=0.5,
+        schedule={"kind": "ramp", "start_s": 0.0, "ramp_s": 100.0})
+    plan.installed_at = 0.0
+    early = late = 0
+    for i in range(200):
+        clk[0] = i * 0.5                          # t sweeps 0 -> 100
+        sent = []
+        plan.apply(1, "m", None, "req", lambda: sent.append(1))
+        if not sent:
+            if clk[0] < 50.0:
+                early += 1
+            else:
+                late += 1
+    assert late > early                            # chaos builds with t
+    assert plan.fired["drop"] == early + late
+
+
+def test_schedule_survives_rule_round_trip():
+    plan = FaultPlan(seed=1).rule(
+        method="x", drop=0.1,
+        schedule=FaultSchedule(kind="burst", period_s=5.0, duty=0.5))
+    d = plan.rules[0].as_dict()
+    assert d["schedule"]["kind"] == "burst"
+    assert d["schedule"]["duty"] == 0.5
