@@ -492,6 +492,115 @@ def bench_commit_stage(n_tx: int = 300, n_blocks: int = 4) -> dict:
     return det
 
 
+def bench_device_validate(n_tx: int = 96, n_blocks: int = 6) -> dict:
+    """Fused device validation (ISSUE 11 proof point): the SAME envelope
+    stream through two full Committer stacks — host gate + serial MVCC
+    vs the one-dispatch fused gate+MVCC program — with commit-hash
+    equality asserted.  Reports wall time per block, the host work the
+    fused path actually removes (gate fold + commit-stage MVCC walk,
+    from the validator_stage_seconds histogram + CommitStats), and the
+    dispatch counter (exactly 1 per device-validated block).  Envelope
+    construction and XLA compilation happen outside the timed region.
+    CAVEAT: on this box the "device" is XLA:CPU on shared cores — the
+    numbers prove dispatch count and host-work elimination, not TPU
+    wall-clock."""
+    import random as _random
+    import time as _time
+
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    from fabric_tpu.committer import Committer, PolicyRegistry, TxValidator
+    from fabric_tpu.committer.device_validate import DeviceValidator
+    from fabric_tpu.ledger import KVLedger, LedgerConfig
+    from fabric_tpu.msp import CachedMSP
+    from fabric_tpu.msp.ca import DevOrg
+    from fabric_tpu.ops_plane import registry
+    from fabric_tpu.policy import parse_policy
+    from fabric_tpu.protocol import KVRead, KVWrite, NsRwSet, TxRwSet, Version
+    from fabric_tpu.protocol import build
+
+    prov = init_factories(FactoryOpts(default="SW"))
+    org = DevOrg("Org1")
+    msps = {org.mspid: CachedMSP(org.msp())}
+    signer = org.new_identity("bench")
+
+    def env_of(rwset):
+        return build.endorser_tx("ch", "cc", "1.0", rwset, signer, [signer])
+
+    # block 0 seeds one key per tx slot; later blocks read-modify-write
+    # their own key with a 25% stale-read (conflict) fraction
+    streams = [[env_of(TxRwSet((NsRwSet(
+        "cc", writes=(KVWrite(f"k{t:03d}", b"v0"),)),)))
+        for t in range(n_tx)]]
+    rng = _random.Random(7)
+    last = {t: (0, t) for t in range(n_tx)}
+    for blk in range(1, n_blocks):
+        envs = []
+        for t in range(n_tx):
+            stale = rng.random() < 0.25
+            ver = Version(9, 9) if stale else Version(*last[t])
+            envs.append(env_of(TxRwSet((NsRwSet(
+                "cc", reads=(KVRead(f"k{t:03d}", ver),),
+                writes=(KVWrite(f"k{t:03d}", bytes([blk, t & 0xff])),)),))))
+            if not stale:
+                last[t] = (blk, t)
+        streams.append(envs)
+
+    def gate_sum() -> float:
+        h = registry.get("validator_stage_seconds")
+        if h is None:
+            return 0.0
+        return h.state_by("stage").get("gate", ([], 0.0, 0))[1]
+
+    def run(device):
+        policies = PolicyRegistry()
+        policies.set_policy("cc", parse_policy("OR('Org1.member')"))
+        lg = KVLedger("ch", LedgerConfig(device_validate=device))
+        dv = None
+        if device:
+            dv = DeviceValidator(lg.statedb, "ch")
+            lg.set_prepared_source(dv.take_prepared)
+        committer = Committer(lg, TxValidator("ch", msps, prov, policies,
+                                              device_validate=dv))
+        mvcc_s, g0 = 0.0, gate_sum()
+        t0 = _time.perf_counter()
+        for envs in streams:
+            prev = (lg.blockstore.chain_info().current_hash
+                    if lg.height else b"\x00" * 32)
+            committer.store_block(build.new_block(lg.height, prev, envs))
+            mvcc_s += lg.last_stats.state_validation_s
+        wall = _time.perf_counter() - t0
+        return lg, wall, mvcc_s, gate_sum() - g0
+
+    run(True)   # warm pass: XLA compile + caches outside the timed region
+    disp0 = registry.counter("validator_device_dispatches_total").value(
+        channel="ch")
+    lg_h, wall_h, mvcc_h, gate_h = run(False)
+    lg_d, wall_d, mvcc_d, gate_d = run(True)
+    disp = registry.counter("validator_device_dispatches_total").value(
+        channel="ch") - disp0
+    assert lg_h.commit_hash == lg_d.commit_hash, \
+        "host/device validation divergence in bench stream"
+    val_h, val_d = gate_h + mvcc_h, gate_d + mvcc_d
+    return {
+        "devval_blocks": n_blocks,
+        "devval_block_txs": n_tx,
+        "devval_host_us_per_block": round(wall_h / n_blocks * 1e6, 1),
+        "devval_device_us_per_block": round(wall_d / n_blocks * 1e6, 1),
+        "devval_wall_speedup": round(wall_h / wall_d, 2),
+        # gate fold + commit-stage MVCC: the host work the fused
+        # dispatch replaces (sig verify, equal on both paths, excluded)
+        "devval_host_validation_us_per_block":
+            round(val_h / n_blocks * 1e6, 1),
+        "devval_device_validation_us_per_block":
+            round(val_d / n_blocks * 1e6, 1),
+        "devval_validation_speedup": round(val_h / max(val_d, 1e-9), 2),
+        "devval_dispatches_per_block": round(disp / n_blocks, 3),
+        "devval_note": ("cpu-virtual: XLA:CPU on shared cores — proves "
+                        "dispatch count + host-work elimination, not TPU "
+                        "wall-clock"),
+    }
+
+
 def bench_overload(over_factor: float = 2.2) -> dict:
     """Open-loop overload probe (ISSUE 10 proof point): boot a one-
     orderer topology with a structurally throttled gateway drain
@@ -975,6 +1084,17 @@ def main():
             detail.update(bench_commit_stage(n_tx=commit_tx))
         except Exception as exc:
             detail["commit_stage_error"] = str(exc)[:200]
+
+    # -- device-resident validation: fused gate+MVCC vs host oracle ----------
+    # (ISSUE 11 proof point: same envelope stream through both stacks,
+    # commit-hash equality asserted, exactly one dispatch per block.
+    # Re-inits the SW provider, so it sits with overload at the tail.)
+    if os.environ.get("BENCH_SKIP_DEVVAL") != "1":
+        try:
+            devval_tx = int(os.environ.get("BENCH_DEVVAL_TXS", "96"))
+            detail.update(bench_device_validate(n_tx=devval_tx))
+        except Exception as exc:
+            detail["devval_error"] = str(exc)[:200]
 
     # -- overload: open-loop 2.2x-saturation drill through admission ---------
     # (ISSUE 10 proof point: measured saturation, then an open-loop

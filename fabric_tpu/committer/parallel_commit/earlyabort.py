@@ -16,11 +16,28 @@ so V ∉ M proves the tx loses MVCC no matter which txs turn out valid.
 Such a tx is flagged MVCC_READ_CONFLICT by the txvalidator before its
 VerifyItems are ever enqueued.
 
+Range queries doom too, when decidable: a scanned interval that is
+provably untouched by every preceding in-block write (no recorded
+put/delete key falls in [start, end) of that namespace) merges to
+exactly the committed range no matter which writers land, so replaying
+it against committed state alone decides the oracle's verdict — a
+mismatch dooms the tx PHANTOM_READ_CONFLICT.  A touched interval stays
+undecidable and suppresses dooming, never flags.
+
+Because the oracle stamps the code of the FIRST failing check in rwset
+walk order (reads then ranges, per namespace), a certain failure only
+dooms when no EARLIER check of the OTHER kind is uncertain: an
+uncertain read before a certainly-failing range could fail first with
+MVCC_READ_CONFLICT (and vice versa with PHANTOM_READ_CONFLICT), so
+such a tx is known dead but its code byte is not — it is skipped (its
+writes still never land) rather than doomed with a guess.
+
 Scope guards (all conservative — any doubt means "doom nothing"):
   - only endorser txs that parse cleanly; parse failures stay on the
     BAD_RWSET path;
-  - txs with range queries are never doomed (interval phantoms depend
-    on which writers land);
+  - range queries over intervals touched by any preceding in-block
+    write are never doomed (interval phantoms then depend on which
+    writers land);
   - the committed version must be exactly the pre-block state:
     statedb.savepoint == block_num - 1, which holds under the standard
     Committer.store_block driver (validate runs strictly after the
@@ -29,7 +46,8 @@ Scope guards (all conservative — any doubt means "doom nothing"):
     early aborts for that block — never a wrong flag.
 
 Consensus note: the final flag byte of a doomed tx is MVCC_READ_CONFLICT
-even when the skipped signature gate would have said BAD_CREATOR_
+(or PHANTOM_READ_CONFLICT for a doomed range) even when the skipped
+signature gate would have said BAD_CREATOR_
 SIGNATURE / ENDORSEMENT_POLICY_FAILURE — the tx is invalid either way,
 but the byte feeds the commit hash, so `parallel_commit.early_abort`
 must be configured uniformly across peers of a channel (README
@@ -43,8 +61,8 @@ from typing import Dict, Optional, Set, Tuple
 from fabric_tpu.protocol import Envelope
 from fabric_tpu.protocol.txflags import ValidationCode
 
-from fabric_tpu.ledger.mvcc import parse_endorser_tx
-from fabric_tpu.ledger.statedb import StateDB
+from fabric_tpu.ledger.mvcc import _validate_range_query, parse_endorser_tx
+from fabric_tpu.ledger.statedb import StateDB, UpdateBatch
 
 
 class EarlyAbortAnalyzer:
@@ -66,6 +84,7 @@ class EarlyAbortAnalyzer:
         doomed: Dict[int, ValidationCode] = {}
         puts: Dict[Tuple[str, str], Set[Tuple[int, int]]] = {}
         deleted: Set[Tuple[str, str]] = set()
+        touched_keys: Set[Tuple[str, str]] = set()  # puts ∪ deleted
         committed_memo: Dict[Tuple[str, str],
                              Optional[Tuple[int, int]]] = {}
 
@@ -85,33 +104,60 @@ class EarlyAbortAnalyzer:
             if parsed is None:
                 continue
             _txid, rwset = parsed
-            if any(ns_rw.range_queries for ns_rw in rwset.ns_rwsets):
-                continue                 # ranges: never doomed here
             dead = False
+            dead_code: Optional[ValidationCode] = None
+            read_unc = False    # an earlier read COULD fail (code 11)
+            range_unc = False   # an earlier range COULD fail (code 12)
             for ns_rw in rwset.ns_rwsets:
                 ns = ns_rw.namespace
                 for read in ns_rw.reads:
                     k = (ns, read.key)
                     v = read.version
                     vt = None if v is None else (v.block_num, v.tx_num)
-                    if vt == committed(k):
+                    touched = k in deleted or k in puts
+                    if vt == committed(k) and not touched:
+                        continue         # certainly passes
+                    in_m = (vt == committed(k)
+                            or (vt is None and k in deleted)
+                            or (vt is not None and vt in puts.get(k, ())))
+                    if in_m:
+                        read_unc = True  # outcome depends on writers
                         continue
-                    if vt is None:
-                        if k in deleted:
-                            continue
-                    elif vt in puts.get(k, ()):
+                    dead = True          # V ∉ M: certainly fails
+                    if not range_unc:
+                        dead_code = ValidationCode.MVCC_READ_CONFLICT
+                    break
+                if dead:
+                    break
+                for rq in ns_rw.range_queries:
+                    start, end = rq.start_key, rq.end_key
+                    if any(ns2 == ns and k2 >= start
+                           and (not end or k2 < end)
+                           for ns2, k2 in touched_keys):
+                        range_unc = True  # interval touched: undecidable
                         continue
+                    # untouched interval: the oracle's merged range IS
+                    # the committed range — replay decides the verdict
+                    if _validate_range_query(db, UpdateBatch(), ns, rq):
+                        continue         # certainly passes
                     dead = True
+                    if not read_unc:
+                        dead_code = ValidationCode.PHANTOM_READ_CONFLICT
                     break
                 if dead:
                     break
             if dead:
-                doomed[tx_num] = ValidationCode.MVCC_READ_CONFLICT
-                continue                 # a doomed tx's writes never land
+                # dead_code None: certainly invalid but the first-failure
+                # code is ambiguous (earlier uncertain check of the other
+                # kind) — don't doom, but its writes still never land
+                if dead_code is not None:
+                    doomed[tx_num] = dead_code
+                continue
             for ns_rw in rwset.ns_rwsets:
                 ns = ns_rw.namespace
                 for w in ns_rw.writes:
                     k = (ns, w.key)
+                    touched_keys.add(k)
                     if w.is_delete:
                         deleted.add(k)
                     else:

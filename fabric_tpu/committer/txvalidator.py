@@ -190,7 +190,7 @@ class TxValidator:
                  sbe_lookup=None,
                  validation_plugin: str = "DefaultValidation",
                  provider_source=None, verify_cache=None,
-                 early_abort=None):
+                 early_abort=None, device_validate=None):
         self.channel_id = channel_id
         self._static_msps = msps
         self._provider = provider
@@ -237,6 +237,14 @@ class TxValidator:
         # VerifyItems never reach the device — don't burn verify slots
         # on txs that lose MVCC anyway
         self.early_abort = early_abort
+        # fused device validation (device_validate.DeviceValidator or
+        # None): on the deep path the gate fold AND MVCC run as one
+        # device dispatch; the prepared UpdateBatch is stashed for the
+        # ledger.  A demoted block (hash collision, range query, ...)
+        # silently falls back to the host gate below — correctness
+        # never depends on the device path.  Requires sbe_lookup=None
+        # (key-level endorsement keeps the classic host tail).
+        self.device_validate = device_validate
         # live pipeline-economics window (overlap gauge for the SLO plane)
         self._econ = _PipelineEconomics()
 
@@ -698,7 +706,9 @@ class TxValidator:
                                          items, memo, n_txs=n,
                                          has_txid=has_txid, doomed=doomed)
             if work is None and doomed is not None and tx_num in doomed \
-                    and flags.flag(tx_num) == ValidationCode.MVCC_READ_CONFLICT:
+                    and flags.flag(tx_num) in (
+                        ValidationCode.MVCC_READ_CONFLICT,
+                        ValidationCode.PHANTOM_READ_CONFLICT):
                 n_aborted += 1
             if work is not None:
                 works.append(work)
@@ -947,9 +957,17 @@ class TxValidator:
                         "unique_items": len(index)})
 
         t0 = time.perf_counter()
-        _fastcollect.gate(state["plans"], verdict, codes,
-                          self.validation_plugin, self.evaluator, {})
-        flags = TxFlags.from_bytes(bytes(codes))
+        flags = None
+        if self.device_validate is not None:
+            # fused device path: gate fold + MVCC in one dispatch; the
+            # prepared batch is stashed for the ledger.  None = demoted
+            # (collision / range / ...) — fall through to the host gate.
+            flags = self.device_validate.run(
+                state, verdict, self.validation_plugin, self.evaluator)
+        if flags is None:
+            _fastcollect.gate(state["plans"], verdict, codes,
+                              self.validation_plugin, self.evaluator, {})
+            flags = TxFlags.from_bytes(bytes(codes))
         gate_s = time.perf_counter() - t0
         tracing.tracer.record_span(
             "validator.gate", t0, t0 + gate_s,

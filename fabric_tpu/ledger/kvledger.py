@@ -84,6 +84,13 @@ class LedgerConfig:
     # adaptive sizing: the pool tracks the rolling max conflict-graph
     # wave width, clamped to commit_workers (scheduler.target_workers)
     commit_adaptive: bool = True
+    # fused device validation (committer/device_validate.py): commit()
+    # consumes the validator's prepared UpdateBatch via the registered
+    # prepared-source instead of re-running host MVCC — the flags in
+    # block metadata and the statedb savepoint must still match what
+    # the device validated against, else host MVCC runs (always safe).
+    # Default OFF until parity is proven per deployment.
+    device_validate: bool = False
 
 
 @dataclass
@@ -115,6 +122,10 @@ class KVLedger:
                           if self.config.enable_history else None)
         self._commit_hash = b"\x00" * 32
         self.last_stats = CommitStats()
+        # DeviceValidator.take_prepared when device_validate is wired:
+        # (number, flags_bytes, savepoint) -> (final_flags, batch,
+        # history) | None
+        self._prepared_source = None
         self._commit_scheduler = None
         if self.config.parallel_commit:
             # function-level import: ledger <- committer.parallel_commit
@@ -173,6 +184,26 @@ class KVLedger:
         if self.historydb is not None:
             self.historydb.commit(num, history)  # savepoint-guarded, idempotent
 
+    def set_prepared_source(self, fn) -> None:
+        """Register the device validator's prepared-batch source
+        (DeviceValidator.take_prepared).  None unregisters."""
+        self._prepared_source = fn
+
+    def _take_prepared(self, block: Block):
+        """(final_flags_bytes, batch, history) from the device
+        validator's stash, or None when absent/stale (host MVCC runs)."""
+        if self._prepared_source is None or not self.config.device_validate:
+            return None
+        try:
+            return self._prepared_source(
+                block.header.number,
+                block.metadata.items[META_TXFLAGS],
+                self.statedb.savepoint)
+        except Exception:
+            logger.exception("prepared-batch source failed; "
+                             "falling back to host MVCC")
+            return None
+
     def _validate_and_prepare(self, num: int, envelopes, flags: TxFlags):
         """MVCC pass: the wavefront scheduler when parallel_commit is
         on, the serial oracle otherwise — identical output either way."""
@@ -224,12 +255,20 @@ class KVLedger:
                 f"block {block.header.number} previous_hash mismatch")
         stats = CommitStats(block_num=block.header.number,
                             total_txs=len(block.data))
-        flags = TxFlags.from_bytes(block.metadata.items[META_TXFLAGS])
-        envelopes = _safe_envelopes(block)
 
         t0 = time.perf_counter()
-        batch, history = self._validate_and_prepare(
-            block.header.number, envelopes, flags)
+        prepared = self._take_prepared(block)
+        if prepared is not None:
+            # fused device validation already ran MVCC in the
+            # validator's single dispatch: consume the prepared batch —
+            # no envelope materialization, no host MVCC walk
+            final_bytes, batch, history = prepared
+            flags = TxFlags.from_bytes(final_bytes)
+        else:
+            flags = TxFlags.from_bytes(block.metadata.items[META_TXFLAGS])
+            envelopes = _safe_envelopes(block)
+            batch, history = self._validate_and_prepare(
+                block.header.number, envelopes, flags)
         stats.state_validation_s = time.perf_counter() - t0
         stats.valid_txs = flags.valid_count()
         # MVCC may have flipped more flags — write the final bitmap back

@@ -617,6 +617,583 @@ reject:
 }
 
 /* ------------------------------------------------------------------ */
+/* rwset_lanes — device-resident validation lane extractor.
+ *
+ * rwset_lanes(base_buf, spans_buf) walks every envelope span of a
+ * block (spans_buf = n × (u64 off, u64 len) pairs, the same layout
+ * parse_block emits) and classifies each tx against the EXACT
+ * semantics of ledger/mvcc.parse_endorser_tx + protocol/types
+ * from_dict laxity, emitting fixed-width uint64 lanes for the fused
+ * XLA gate+MVCC program (committer/device_validate.py):
+ *
+ *   status 0 OK       strict endorser tx; lanes emitted
+ *   status 1 SKIP     parse_endorser_tx provably returns None
+ *                     (non-endorser channel-header type, or an empty
+ *                     actions list)
+ *   status 2 BAD      parse_endorser_tx provably RAISES (the oracle
+ *                     stamps BAD_RWSET on a gate-valid tx)
+ *   status 3 RANGE    well-formed endorser tx carrying a non-empty
+ *                     range_queries list (interval replay is host work)
+ *   status 4 UNKNOWN  host outcome is deterministic but device-
+ *                     inexpressible (non-str keys, bignum/odd version
+ *                     shapes, non-bool is_delete, non-bytes payload…)
+ *
+ * RANGE/UNKNOWN txs that could pass the signature gate force the host
+ * path for the block (demotion); BAD/SKIP never do.  rw-set keys are
+ * interned by a 64-bit djb2 hash over ns||0x00||key through an
+ * open-addressed table with byte-exact comparison: two DISTINCT keys
+ * sharing a hash set the collision flag and the whole call returns
+ * flags=1 so the caller demotes — correctness never depends on hash
+ * uniqueness.
+ *
+ * Return: (flags, n_tx, n_keys, n_reads, n_writes, arena) where the
+ * arena holds native-endian u64 cells in four sections:
+ *   tx      n_tx    × 3  [status, txid_off, txid_len]
+ *   reads   n_reads × 5  [tx, slot, has_version, block_num, tx_num]
+ *   writes  n_writes× 5  [tx, slot, is_delete, value_off, value_len]
+ *   keys    n_keys  × 5  [hash, ns_off, ns_len, key_off, key_len]
+ * All offsets index base_buf.  On collision: (1, 0, 0, 0, 0, None).
+ * None for inputs that are not a valid span table over base_buf.
+ * Scratch buffers are module-global PyMem_Raw allocations reused
+ * across calls — the parse stage stays O(1) Python allocations.      */
+
+enum {
+    LN_OK = 0, LN_SKIP = 1, LN_BAD = 2, LN_RANGE = 3, LN_UNKNOWN = 4,
+    LN_COLL = -1, LN_OOM = -2,
+};
+
+static uint64_t *g_tx = NULL;   static size_t g_tx_cap = 0;
+static uint64_t *g_rd = NULL;   static size_t g_rd_cap = 0, g_rd_n = 0;
+static uint64_t *g_wr = NULL;   static size_t g_wr_cap = 0, g_wr_n = 0;
+static uint64_t *g_keys = NULL; static size_t g_keys_cap = 0, g_keys_n = 0;
+static uint32_t *g_tab = NULL;  static size_t g_tab_cap = 0;
+
+static uint64_t st_rw_accept = 0;     /* lane calls that produced lanes */
+static uint64_t st_rw_reject = 0;     /* invalid span-table inputs      */
+static uint64_t st_rw_collision = 0;  /* calls demoted on hash collision */
+static uint64_t st_rw_keys = 0;       /* unique rw keys interned (cum.) */
+static uint64_t st_rw_lanes = 0;      /* read+write lanes emitted (cum.) */
+
+static int grow_u64(uint64_t **buf, size_t *cap, size_t need)
+{
+    if (*cap >= need) return 0;
+    size_t ncap = *cap ? *cap : 256;
+    while (ncap < need) ncap <<= 1;
+    uint64_t *nb = PyMem_RawRealloc(*buf, ncap * sizeof(uint64_t));
+    if (!nb) { PyErr_NoMemory(); return -1; }
+    *buf = nb;
+    *cap = ncap;
+    return 0;
+}
+
+static int tab_grow(void)
+{
+    size_t ncap = g_tab_cap ? g_tab_cap * 2 : 64;
+    uint32_t *nt = PyMem_RawMalloc(ncap * sizeof(uint32_t));
+    if (!nt) { PyErr_NoMemory(); return -1; }
+    memset(nt, 0, ncap * sizeof(uint32_t));
+    for (size_t j = 0; j < g_keys_n; j++) {
+        size_t i = (size_t)g_keys[5 * j] & (ncap - 1);
+        while (nt[i]) i = (i + 1) & (ncap - 1);
+        nt[i] = (uint32_t)(j + 1);
+    }
+    PyMem_RawFree(g_tab);
+    g_tab = nt;
+    g_tab_cap = ncap;
+    return 0;
+}
+
+/* slot index, or LN_COLL (same hash, different key bytes) / LN_OOM */
+static int64_t intern_key(const uint8_t *base,
+                          uint64_t ns_off, uint64_t ns_len,
+                          uint64_t key_off, uint64_t key_len)
+{
+    uint64_t h = 5381, i_;
+    const uint8_t *p = base + ns_off;
+    for (i_ = 0; i_ < ns_len; i_++) h = h * 33 + p[i_];
+    h = h * 33;                        /* the 0x00 ns/key separator */
+    p = base + key_off;
+    for (i_ = 0; i_ < key_len; i_++) h = h * 33 + p[i_];
+
+    if ((g_keys_n + 1) * 2 > g_tab_cap && tab_grow() < 0)
+        return LN_OOM;
+    size_t mask = g_tab_cap - 1;
+    size_t i = (size_t)h & mask;
+    while (g_tab[i]) {
+        uint64_t *rec = &g_keys[5 * (size_t)(g_tab[i] - 1)];
+        if (rec[0] == h) {
+            if (rec[2] == ns_len && rec[4] == key_len
+                && memcmp(base + rec[1], base + ns_off, (size_t)ns_len) == 0
+                && memcmp(base + rec[3], base + key_off, (size_t)key_len) == 0)
+                return (int64_t)(g_tab[i] - 1);
+            return LN_COLL;
+        }
+        i = (i + 1) & mask;
+    }
+    if (grow_u64(&g_keys, &g_keys_cap, (g_keys_n + 1) * 5) < 0)
+        return LN_OOM;
+    uint64_t *rec = &g_keys[5 * g_keys_n];
+    rec[0] = h;
+    rec[1] = ns_off; rec[2] = ns_len;
+    rec[3] = key_off; rec[4] = key_len;
+    g_tab[i] = (uint32_t)(g_keys_n + 1);
+    st_rw_keys++;
+    return (int64_t)g_keys_n++;
+}
+
+/* Version.from_list mirror: None -> absent; list len<2 raises
+ * (IndexError -> BAD); both ints must be fixed 'I' within i32, else
+ * the host compare is device-inexpressible (UNKNOWN); extra elements
+ * are ignored by from_list.  On any non-OK status the caller abandons
+ * the whole envelope, so the cursor may be left mid-value. */
+static int walk_version(cur_t *c, uint64_t *has, uint64_t *blk,
+                        uint64_t *txn)
+{
+    if (c->p >= c->end) return LN_BAD;
+    uint8_t tag = *c->p;
+    if (tag == 'N') { c->p++; return LN_OK; }
+    if (tag != 'L') return LN_UNKNOWN;
+    c->p++;
+    uint32_t n;
+    if (rd_u32(c, &n) < 0) return LN_BAD;
+    if (n < 2) return LN_BAD;          /* v[0]/v[1] IndexError */
+    int64_t v0, v1;
+    if (rd_i64(c, &v0) < 0 || v0 < INT32_MIN || v0 > INT32_MAX)
+        return LN_UNKNOWN;
+    if (rd_i64(c, &v1) < 0 || v1 < INT32_MIN || v1 > INT32_MAX)
+        return LN_UNKNOWN;
+    for (uint32_t i = 2; i < n; i++)
+        if (canon_value_d(c, 1) < 0) return LN_BAD;
+    *has = 1;
+    *blk = (uint64_t)v0;
+    *txn = (uint64_t)v1;
+    return LN_OK;
+}
+
+static int walk_read(cur_t *c, const uint8_t *base, int emit, uint64_t tx,
+                     uint64_t ns_off, uint64_t ns_len)
+{
+    uint32_t n;
+    if (dict_enter(c, &n) < 0) return LN_BAD;  /* d["key"] raises */
+    const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+    uint64_t key_off = 0, key_len = 0, has = 0, blk = 0, txn = 0;
+    int have_key = 0;
+    while (n--) {
+        const uint8_t *k; uint32_t kn;
+        if (dict_key(c, &kprev, &kprev_n, &k, &kn) < 0) return LN_BAD;
+        if (key_is(k, kn, "key")) {
+            const uint8_t *sp; uint32_t sn;
+            if (c->p >= c->end || *c->p != 'S') return LN_UNKNOWN;
+            if (rd_str(c, &sp, &sn) < 0) return LN_BAD;
+            key_off = (uint64_t)(sp - base);
+            key_len = sn;
+            have_key = 1;
+        } else if (key_is(k, kn, "version")) {
+            int st = walk_version(c, &has, &blk, &txn);
+            if (st != LN_OK) return st;
+        } else {
+            if (canon_value_d(c, 1) < 0) return LN_BAD;
+        }
+    }
+    if (!have_key) return LN_BAD;
+    if (!emit) return LN_OK;
+    int64_t slot = intern_key(base, ns_off, ns_len, key_off, key_len);
+    if (slot < 0) return (int)slot;
+    if (grow_u64(&g_rd, &g_rd_cap, (g_rd_n + 1) * 5) < 0) return LN_OOM;
+    uint64_t *r = &g_rd[5 * g_rd_n++];
+    r[0] = tx; r[1] = (uint64_t)slot; r[2] = has; r[3] = blk; r[4] = txn;
+    return LN_OK;
+}
+
+static int walk_write(cur_t *c, const uint8_t *base, int emit, uint64_t tx,
+                      uint64_t ns_off, uint64_t ns_len)
+{
+    uint32_t n;
+    if (dict_enter(c, &n) < 0) return LN_BAD;
+    const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+    uint64_t key_off = 0, key_len = 0, del = 0, voff = 0, vlen = 0;
+    int have_key = 0;
+    while (n--) {
+        const uint8_t *k; uint32_t kn;
+        if (dict_key(c, &kprev, &kprev_n, &k, &kn) < 0) return LN_BAD;
+        if (key_is(k, kn, "key")) {
+            const uint8_t *sp; uint32_t sn;
+            if (c->p >= c->end || *c->p != 'S') return LN_UNKNOWN;
+            if (rd_str(c, &sp, &sn) < 0) return LN_BAD;
+            key_off = (uint64_t)(sp - base);
+            key_len = sn;
+            have_key = 1;
+        } else if (key_is(k, kn, "is_delete")) {
+            if (c->p >= c->end) return LN_BAD;
+            if (*c->p == 'T') del = 1;
+            else if (*c->p == 'F') del = 0;
+            else return LN_UNKNOWN;    /* truthy non-bool: mirrorable
+                                        * host-side only */
+            c->p++;
+        } else if (key_is(k, kn, "value")) {
+            const uint8_t *bp; uint32_t bn;
+            if (c->p >= c->end || *c->p != 'B') return LN_UNKNOWN;
+            if (rd_bytes(c, &bp, &bn) < 0) return LN_BAD;
+            voff = (uint64_t)(bp - base);
+            vlen = bn;
+        } else {
+            if (canon_value_d(c, 1) < 0) return LN_BAD;
+        }
+    }
+    if (!have_key) return LN_BAD;
+    if (!emit) return LN_OK;
+    int64_t slot = intern_key(base, ns_off, ns_len, key_off, key_len);
+    if (slot < 0) return (int)slot;
+    if (grow_u64(&g_wr, &g_wr_cap, (g_wr_n + 1) * 5) < 0) return LN_OOM;
+    uint64_t *w = &g_wr[5 * g_wr_n++];
+    w[0] = tx; w[1] = (uint64_t)slot; w[2] = del; w[3] = voff; w[4] = vlen;
+    return LN_OK;
+}
+
+/* One NsRwSet dict.  Canonical key order namespace < range_queries <
+ * reads < writes guarantees the namespace span is known before any
+ * lane is emitted; a reads/writes key reached without it means
+ * d["namespace"] raises (sorted keys cannot produce it later). */
+static int walk_ns(cur_t *c, const uint8_t *base, int emit, uint64_t tx)
+{
+    uint32_t n;
+    if (dict_enter(c, &n) < 0) return LN_BAD;
+    const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+    uint64_t ns_off = 0, ns_len = 0;
+    int have_ns = 0, have_reads = 0, have_writes = 0, saw_range = 0;
+    while (n--) {
+        const uint8_t *k; uint32_t kn;
+        if (dict_key(c, &kprev, &kprev_n, &k, &kn) < 0) return LN_BAD;
+        if (key_is(k, kn, "namespace")) {
+            const uint8_t *sp; uint32_t sn;
+            if (c->p >= c->end || *c->p != 'S') return LN_UNKNOWN;
+            if (rd_str(c, &sp, &sn) < 0) return LN_BAD;
+            ns_off = (uint64_t)(sp - base);
+            ns_len = sn;
+            have_ns = 1;
+        } else if (key_is(k, kn, "reads")) {
+            if (!have_ns) return LN_BAD;
+            if (c->p >= c->end || *c->p != 'L') return LN_UNKNOWN;
+            c->p++;
+            uint32_t rn;
+            if (rd_u32(c, &rn) < 0) return LN_BAD;
+            while (rn--) {
+                int st = walk_read(c, base, emit, tx, ns_off, ns_len);
+                if (st != LN_OK) return st;
+            }
+            have_reads = 1;
+        } else if (key_is(k, kn, "writes")) {
+            if (!have_ns) return LN_BAD;
+            if (c->p >= c->end || *c->p != 'L') return LN_UNKNOWN;
+            c->p++;
+            uint32_t wn;
+            if (rd_u32(c, &wn) < 0) return LN_BAD;
+            while (wn--) {
+                int st = walk_write(c, base, emit, tx, ns_off, ns_len);
+                if (st != LN_OK) return st;
+            }
+            have_writes = 1;
+        } else if (key_is(k, kn, "range_queries")) {
+            if (c->p >= c->end || *c->p != 'L') return LN_UNKNOWN;
+            cur_t peek = *c;
+            peek.p++;
+            uint32_t qn;
+            if (rd_u32(&peek, &qn) < 0) return LN_BAD;
+            if (canon_value_d(c, 1) < 0) return LN_BAD;
+            if (qn > 0) saw_range = 1;
+        } else {
+            if (canon_value_d(c, 1) < 0) return LN_BAD;
+        }
+    }
+    if (!have_ns || !have_reads || !have_writes) return LN_BAD;
+    return saw_range ? LN_RANGE : LN_OK;
+}
+
+static int walk_rwset(cur_t *c, const uint8_t *base, int emit, uint64_t tx)
+{
+    uint32_t n;
+    if (dict_enter(c, &n) < 0) return LN_BAD;  /* d["ns"] raises */
+    const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+    int have_ns_list = 0;
+    while (n--) {
+        const uint8_t *k; uint32_t kn;
+        if (dict_key(c, &kprev, &kprev_n, &k, &kn) < 0) return LN_BAD;
+        if (key_is(k, kn, "ns")) {
+            if (c->p >= c->end || *c->p != 'L') return LN_UNKNOWN;
+            c->p++;
+            uint32_t ln;
+            if (rd_u32(c, &ln) < 0) return LN_BAD;
+            while (ln--) {
+                int st = walk_ns(c, base, emit, tx);
+                if (st != LN_OK) return st;
+            }
+            have_ns_list = 1;
+        } else {
+            if (canon_value_d(c, 1) < 0) return LN_BAD;
+        }
+    }
+    return have_ns_list ? LN_OK : LN_BAD;
+}
+
+static int walk_endorsement(cur_t *c)
+{
+    uint32_t n;
+    if (dict_enter(c, &n) < 0) return LN_BAD;
+    const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+    int have_e = 0, have_s = 0;
+    while (n--) {
+        const uint8_t *k; uint32_t kn;
+        if (dict_key(c, &kprev, &kprev_n, &k, &kn) < 0) return LN_BAD;
+        if (key_is(k, kn, "endorser")) have_e = 1;
+        else if (key_is(k, kn, "signature")) have_s = 1;
+        if (canon_value_d(c, 1) < 0) return LN_BAD;
+    }
+    return (have_e && have_s) ? LN_OK : LN_BAD;
+}
+
+static int walk_cc_action(cur_t *c, const uint8_t *base, int emit,
+                          uint64_t tx)
+{
+    uint32_t n;
+    if (dict_enter(c, &n) < 0) return LN_BAD;
+    const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+    int have_id = 0, have_ver = 0, have_rw = 0;
+    while (n--) {
+        const uint8_t *k; uint32_t kn;
+        if (dict_key(c, &kprev, &kprev_n, &k, &kn) < 0) return LN_BAD;
+        if (key_is(k, kn, "chaincode_id")) {
+            have_id = 1;
+            if (canon_value_d(c, 1) < 0) return LN_BAD;
+        } else if (key_is(k, kn, "chaincode_version")) {
+            have_ver = 1;
+            if (canon_value_d(c, 1) < 0) return LN_BAD;
+        } else if (key_is(k, kn, "rwset")) {
+            int st = walk_rwset(c, base, emit, tx);
+            if (st != LN_OK) return st;
+            have_rw = 1;
+        } else {
+            if (canon_value_d(c, 1) < 0) return LN_BAD;
+        }
+    }
+    return (have_id && have_ver && have_rw) ? LN_OK : LN_BAD;
+}
+
+static int walk_action(cur_t *c, const uint8_t *base, int emit, uint64_t tx)
+{
+    uint32_t n;
+    if (dict_enter(c, &n) < 0) return LN_BAD;
+    const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+    int have_ph = 0, have_act = 0, have_end = 0;
+    while (n--) {
+        const uint8_t *k; uint32_t kn;
+        if (dict_key(c, &kprev, &kprev_n, &k, &kn) < 0) return LN_BAD;
+        if (key_is(k, kn, "action")) {
+            int st = walk_cc_action(c, base, emit, tx);
+            if (st != LN_OK) return st;
+            have_act = 1;
+        } else if (key_is(k, kn, "endorsements")) {
+            if (c->p >= c->end || *c->p != 'L') return LN_UNKNOWN;
+            c->p++;
+            uint32_t en;
+            if (rd_u32(c, &en) < 0) return LN_BAD;
+            while (en--) {
+                int st = walk_endorsement(c);
+                if (st != LN_OK) return st;
+            }
+            have_end = 1;
+        } else if (key_is(k, kn, "proposal_hash")) {
+            have_ph = 1;
+            if (canon_value_d(c, 1) < 0) return LN_BAD;
+        } else {
+            if (canon_value_d(c, 1) < 0) return LN_BAD;
+        }
+    }
+    return (have_ph && have_act && have_end) ? LN_OK : LN_BAD;
+}
+
+/* Classify one envelope span; emit lanes for the first action's rwset
+ * of an OK endorser tx.  Every decision mirrors a step of
+ * Envelope.deserialize -> parse_endorser_tx (see module comment for
+ * the status contract); evaluation ORDER matters only where it
+ * changes the outcome class — notably ch["txid"] is only read after
+ * Transaction.from_dict and the empty-actions check. */
+static int walk_env(const uint8_t *base, const uint8_t *ep, size_t en,
+                    uint64_t tx, uint64_t *txid_off, uint64_t *txid_len)
+{
+    cur_t c = {ep, ep + en};
+    cur_t payload_v = {NULL, NULL};
+    int have_sig = 0;
+    uint32_t n;
+    if (dict_enter(&c, &n) < 0) return LN_BAD;
+    {
+        const uint8_t *kprev = NULL; uint32_t kprev_n = 0;
+        while (n--) {
+            const uint8_t *k; uint32_t kn;
+            if (dict_key(&c, &kprev, &kprev_n, &k, &kn) < 0) return LN_BAD;
+            const uint8_t *vstart = c.p;
+            if (canon_value_d(&c, 1) < 0) return LN_BAD;
+            if (key_is(k, kn, "payload")) {
+                payload_v.p = vstart;
+                payload_v.end = c.p;
+            } else if (key_is(k, kn, "signature")) {
+                have_sig = 1;
+            }
+        }
+    }
+    if (c.p != c.end) return LN_BAD;
+    if (!payload_v.p || !have_sig) return LN_BAD;   /* KeyError */
+    if (*payload_v.p != 'B') return LN_UNKNOWN;     /* decode(non-bytes) */
+
+    const uint8_t *pp; uint32_t pn;
+    if (rd_bytes(&payload_v, &pp, &pn) < 0) return LN_BAD;
+
+    cur_t header_v = {NULL, NULL};
+    {
+        cur_t pc = {pp, pp + pn};
+        int r = dict_find(&pc, "header", &header_v);
+        if (r != 1 || pc.p != pc.end) return LN_BAD;
+    }
+    cur_t ch_v = {NULL, NULL};
+    {
+        cur_t t = header_v;
+        if (dict_find(&t, "channel_header", &ch_v) != 1) return LN_BAD;
+    }
+    {
+        cur_t t = ch_v, type_v = {NULL, NULL};
+        if (dict_find(&t, "type", &type_v) != 1) return LN_BAD;
+        const uint8_t *sp; uint32_t sn;
+        if (type_v.p >= type_v.end || *type_v.p != 'S')
+            return LN_SKIP;            /* non-str != TX_ENDORSER */
+        if (rd_str(&type_v, &sp, &sn) < 0) return LN_BAD;
+        if (!key_is(sp, sn, "endorser_transaction")) return LN_SKIP;
+    }
+    cur_t data_v = {NULL, NULL};
+    {
+        cur_t pc = {pp, pp + pn};
+        if (dict_find(&pc, "data", &data_v) != 1) return LN_BAD;
+    }
+    cur_t actions_v = {NULL, NULL};
+    {
+        cur_t t = data_v;
+        if (dict_find(&t, "actions", &actions_v) != 1) return LN_BAD;
+    }
+    if (actions_v.p >= actions_v.end || *actions_v.p != 'L')
+        return LN_UNKNOWN;
+    {
+        cur_t t = actions_v;
+        t.p++;
+        uint32_t an;
+        if (rd_u32(&t, &an) < 0) return LN_BAD;
+        if (an == 0) return LN_SKIP;   /* `not tx.actions` -> None,
+                                        * BEFORE ch["txid"] is read */
+        for (uint32_t i = 0; i < an; i++) {
+            int st = walk_action(&t, base, i == 0, tx);
+            if (st != LN_OK) return st;
+        }
+    }
+    {
+        cur_t t = ch_v, txid_v = {NULL, NULL};
+        if (dict_find(&t, "txid", &txid_v) != 1) return LN_BAD;
+        const uint8_t *sp; uint32_t sn;
+        if (txid_v.p >= txid_v.end || *txid_v.p != 'S') return LN_UNKNOWN;
+        if (rd_str(&txid_v, &sp, &sn) < 0) return LN_BAD;
+        *txid_off = (uint64_t)(sp - base);
+        *txid_len = sn;
+    }
+    return LN_OK;
+}
+
+static PyObject *py_rwset_lanes(PyObject *self, PyObject *args)
+{
+    (void)self;
+    Py_buffer in, sp;
+    if (!PyArg_ParseTuple(args, "y*y*", &in, &sp))
+        return NULL;
+    if (sp.len % 16) {
+        PyBuffer_Release(&in);
+        PyBuffer_Release(&sp);
+        st_rw_reject++;
+        Py_RETURN_NONE;
+    }
+    const uint8_t *base = in.buf;
+    size_t blen = (size_t)in.len;
+    size_t T = (size_t)sp.len / 16;
+
+    g_rd_n = g_wr_n = g_keys_n = 0;
+    if (g_tab)
+        memset(g_tab, 0, g_tab_cap * sizeof(uint32_t));
+    if (grow_u64(&g_tx, &g_tx_cap, T ? T * 3 : 1) < 0)
+        goto error;
+
+    int collision = 0;
+    for (size_t t = 0; t < T; t++) {
+        uint64_t sv[2];
+        memcpy(sv, (const uint8_t *)sp.buf + 16 * t, 16);
+        if (sv[0] > blen || sv[1] > blen - sv[0]) {
+            st_rw_reject++;
+            goto reject;
+        }
+        size_t rd_mark = g_rd_n, wr_mark = g_wr_n;
+        uint64_t txo = 0, txl = 0;
+        int st = walk_env(base, base + sv[0], (size_t)sv[1],
+                          (uint64_t)t, &txo, &txl);
+        if (st == LN_OOM)
+            goto error;
+        if (st == LN_COLL) {
+            collision = 1;
+            break;
+        }
+        if (st != LN_OK) {             /* drop this tx's partial lanes */
+            g_rd_n = rd_mark;
+            g_wr_n = wr_mark;
+            txo = txl = 0;
+        }
+        g_tx[3 * t] = (uint64_t)st;
+        g_tx[3 * t + 1] = txo;
+        g_tx[3 * t + 2] = txl;
+    }
+    if (collision) {
+        PyBuffer_Release(&in);
+        PyBuffer_Release(&sp);
+        st_rw_collision++;
+        return Py_BuildValue("(iKKKKO)", 1, 0ULL, 0ULL, 0ULL, 0ULL,
+                             Py_None);
+    }
+    {
+        size_t R = g_rd_n, W = g_wr_n, K = g_keys_n;
+        size_t cells = T * 3 + (R + W + K) * 5;
+        FPArena *a = arena_acquire(cells ? cells * 8 : 8);
+        if (!a)
+            goto error;
+        uint64_t *o = (uint64_t *)a->buf;
+        if (T) { memcpy(o, g_tx, T * 3 * 8); o += T * 3; }
+        if (R) { memcpy(o, g_rd, R * 5 * 8); o += R * 5; }
+        if (W) { memcpy(o, g_wr, W * 5 * 8); o += W * 5; }
+        if (K) { memcpy(o, g_keys, K * 5 * 8); }
+        a->len = (Py_ssize_t)(cells * 8);
+        st_rw_accept++;
+        st_rw_lanes += R + W;
+        PyObject *res = Py_BuildValue(
+            "(iKKKKN)", 0,
+            (unsigned long long)T, (unsigned long long)K,
+            (unsigned long long)R, (unsigned long long)W,
+            (PyObject *)a);
+        PyBuffer_Release(&in);
+        PyBuffer_Release(&sp);
+        return res;
+    }
+
+reject:
+    PyBuffer_Release(&in);
+    PyBuffer_Release(&sp);
+    Py_RETURN_NONE;
+error:
+    PyBuffer_Release(&in);
+    PyBuffer_Release(&sp);
+    return NULL;
+}
+
+/* ------------------------------------------------------------------ */
 /* stats                                                               */
 
 static PyObject *py_stats(PyObject *self, PyObject *noarg)
@@ -624,7 +1201,8 @@ static PyObject *py_stats(PyObject *self, PyObject *noarg)
     (void)self;
     (void)noarg;
     return Py_BuildValue(
-        "{s:K,s:K,s:K,s:i,s:K,s:K,s:K,s:K}",
+        "{s:K,s:K,s:K,s:i,s:K,s:K,s:K,s:K,"
+        "s:K,s:K,s:K,s:K,s:K,s:K}",
         "pool_hit", (unsigned long long)st_pool_hit,
         "pool_miss", (unsigned long long)st_pool_miss,
         "pool_drop", (unsigned long long)st_pool_drop,
@@ -632,7 +1210,13 @@ static PyObject *py_stats(PyObject *self, PyObject *noarg)
         "block_accept", (unsigned long long)st_blk_accept,
         "block_reject", (unsigned long long)st_blk_reject,
         "env_accept", (unsigned long long)st_env_accept,
-        "env_reject", (unsigned long long)st_env_reject);
+        "env_reject", (unsigned long long)st_env_reject,
+        "rw_accept", (unsigned long long)st_rw_accept,
+        "rw_reject", (unsigned long long)st_rw_reject,
+        "rw_collision", (unsigned long long)st_rw_collision,
+        "rw_keys", (unsigned long long)st_rw_keys,
+        "rw_lanes", (unsigned long long)st_rw_lanes,
+        "rw_table_slots", (unsigned long long)g_tab_cap);
 }
 
 /* ------------------------------------------------------------------ */
@@ -643,8 +1227,11 @@ static PyMethodDef methods[] = {
      "data_end, n, spans, meta_val_off) | None"},
     {"envelope_summary", py_envelope_summary, METH_O,
      "envelope_summary(buf) -> (type, channel_id, txid) | None"},
+    {"rwset_lanes", py_rwset_lanes, METH_VARARGS,
+     "rwset_lanes(base, spans) -> (flags, n_tx, n_keys, n_reads, "
+     "n_writes, arena) | None"},
     {"stats", py_stats, METH_NOARGS,
-     "stats() -> arena-pool and accept/reject counters"},
+     "stats() -> arena-pool, accept/reject and rw-lane counters"},
     {NULL, NULL, 0, NULL},
 };
 

@@ -266,12 +266,20 @@ class PeerChannel:
         # signature gate would have said otherwise, and flags feed the
         # commit hash (see parallel_commit/earlyabort.py).
         pc_cfg = dict(node.cfg.get("parallel_commit", {}))
+        # fused device validation (committer/device_validate.py): gate
+        # fold + MVCC as one XLA dispatch per block, prepared batch
+        # consumed by the ledger.  Same uniformity note as early_abort
+        # (demotions fall back bit-identically, so only timing differs,
+        # but keep it uniform as an operational convention).
+        dv_cfg = dict(node.cfg.get("device_validate", {}))
+        dv_on = bool(dv_cfg.get("enabled", False))
         self.ledger = KVLedger(
             self.channel_id,
             LedgerConfig(root=f"{ch_dir}/ledger",
                          parallel_commit=bool(pc_cfg.get("enabled", False)),
                          commit_workers=int(pc_cfg.get("max_workers", 4)),
-                         commit_adaptive=bool(pc_cfg.get("adaptive", True))))
+                         commit_adaptive=bool(pc_cfg.get("adaptive", True)),
+                         device_validate=dv_on))
         early_abort = None
         if pc_cfg.get("early_abort", pc_cfg.get("enabled", False)):
             from fabric_tpu.committer.parallel_commit import (
@@ -279,6 +287,13 @@ class PeerChannel:
             )
             early_abort = EarlyAbortAnalyzer(self.ledger.statedb,
                                              self.channel_id)
+        device_validate = None
+        if dv_on:
+            from fabric_tpu.committer.device_validate import DeviceValidator
+            device_validate = DeviceValidator(
+                self.ledger.statedb, self.channel_id,
+                window=int(dv_cfg.get("window", 4096)))
+            self.ledger.set_prepared_source(device_validate.take_prepared)
 
         cfg = node.cfg
         self.policies = LifecyclePolicyProvider(self.ledger.statedb)
@@ -314,13 +329,20 @@ class PeerChannel:
         provider_source = (bccsp_factory.provider_for_channel
                            if bccsp_factory.get_placement() is not None
                            else None)
+        # device_validate needs the deep C collect tail, which key-level
+        # endorsement (sbe_lookup) disables — enabling the fused path
+        # trades away per-key validation-parameter overrides on this
+        # peer (README "Device-resident validation")
+        sbe = (None if device_validate is not None
+               else statedb_lookup(self.ledger.statedb))
         self.validator = TxValidator(
             self.channel_id, None, ch_provider, self.policies,
             bundle_source=self.bundle_source,
-            sbe_lookup=statedb_lookup(self.ledger.statedb),
+            sbe_lookup=sbe,
             provider_source=provider_source,
             verify_cache=node.verify_cache,
-            early_abort=early_abort)
+            early_abort=early_abort,
+            device_validate=device_validate)
         self.committer = Committer(self.ledger, self.validator,
                                    bundle_source=self.bundle_source,
                                    provider=ch_provider,

@@ -39,6 +39,7 @@ raw[:meta_val_off] + serde.encode(metadata), a pure splice.
 from __future__ import annotations
 
 import hashlib
+import struct
 from typing import Any, Dict, List, Optional, Tuple, Union
 
 from fabric_tpu.utils import serde
@@ -92,6 +93,14 @@ class BlockView:
     def data_spans(self):
         """(base, spans) pair for _fastcollect.digest_spans."""
         return self.raw, self._spans
+
+    @property
+    def rwset_lanes(self):
+        """Fixed-width uint64 validation lanes for the fused device
+        program: (flags, n_tx, n_keys, n_reads, n_writes, arena) —
+        see rwset_lanes() below.  Zero-copy like data_spans: no
+        per-tx Python objects are built."""
+        return rwset_lanes(self.raw, self._spans)
 
     @property
     def computed_data_hash(self) -> bytes:
@@ -210,6 +219,40 @@ def parse_block_py(raw: _Raw):
             d["metadata"], meta_off)
 
 
+# ---------------------------------------------------------------------------
+# rw-set validation lanes (device-resident block validation)
+#
+# rwset_lanes(base, spans) classifies every envelope span against the
+# exact semantics of ledger/mvcc.parse_endorser_tx and emits fixed-width
+# uint64 lane tables for the fused XLA gate+MVCC program
+# (committer/device_validate.py).  Statuses:
+#
+#   0 OK       strict endorser tx, lanes emitted
+#   1 SKIP     parse_endorser_tx provably returns None
+#   2 BAD      parse_endorser_tx provably raises (oracle stamps
+#              BAD_RWSET on a gate-valid tx)
+#   3 RANGE    endorser tx with a non-empty range_queries list
+#   4 UNKNOWN  host outcome deterministic but device-inexpressible
+#
+# Result tuple (flags, n_tx, n_keys, n_reads, n_writes, arena):
+#   flags  0 ok | 1 key-hash collision (arena is None; caller demotes)
+#   arena  native-endian u64 cells in four sections
+#          tx      n_tx    x 3  [status, txid_off, txid_len]
+#          reads   n_reads x 5  [tx, slot, has_version, block, txn]
+#          writes  n_writes x 5 [tx, slot, is_delete, value_off, value_len]
+#          keys    n_keys  x 5  [hash, ns_off, ns_len, key_off, key_len]
+# or None when spans is not a valid span table over base.
+
+LANE_OK, LANE_SKIP, LANE_BAD, LANE_RANGE, LANE_UNKNOWN = 0, 1, 2, 3, 4
+
+
+def rwset_lanes(base: _Raw, spans) -> Optional[tuple]:
+    """Native lane extraction when available, else the Python mirror."""
+    if _fastparse is not None:
+        return _fastparse.rwset_lanes(base, spans)
+    return rwset_lanes_py(base, spans)
+
+
 def envelope_summary_py(raw: _Raw) -> Optional[Tuple[str, str, str]]:
     """Mirror of _fastparse.envelope_summary."""
     try:
@@ -234,3 +277,526 @@ def envelope_summary_py(raw: _Raw) -> Optional[Tuple[str, str, str]]:
         return (t, cid, txid)
     except Exception:
         return None
+
+
+# -- rwset_lanes mirror ------------------------------------------------------
+# Line-for-line mirror of the C lane extractor (native/fastparse.c
+# py_rwset_lanes and its walk_* helpers).  Every status decision and
+# every emitted cell must match the native output byte-for-byte
+# (tests/test_device_validate.py drives them differentially); it is
+# also the no-compiler fallback wired through rwset_lanes() above.
+
+_M64 = (1 << 64) - 1
+
+
+class _LaneStat(Exception):
+    """Terminal per-envelope lane status (first terminal wins)."""
+
+    def __init__(self, st: int):
+        self.st = st
+
+
+class _LaneColl(Exception):
+    """Two distinct rw keys share a hash: the whole call demotes."""
+
+
+class _LaneCur:
+    """Byte cursor over the base buffer (mirror of the C cur_t)."""
+
+    __slots__ = ("b", "p", "end")
+
+    def __init__(self, b: bytes, p: int, end: int):
+        self.b = b
+        self.p = p
+        self.end = end
+
+
+class _LaneState:
+    """Per-call lane accumulators (mirror of the C module globals)."""
+
+    __slots__ = ("base", "reads", "writes", "keys", "by_hash")
+
+    def __init__(self, base: bytes):
+        self.base = base
+        self.reads: list = []
+        self.writes: list = []
+        self.keys: list = []
+        self.by_hash: dict = {}
+
+    def intern(self, ns_off, ns_len, key_off, key_len) -> int:
+        base = self.base
+        h = 5381
+        for byte in base[ns_off:ns_off + ns_len]:
+            h = (h * 33 + byte) & _M64
+        h = (h * 33) & _M64            # the 0x00 ns/key separator
+        for byte in base[key_off:key_off + key_len]:
+            h = (h * 33 + byte) & _M64
+        rec = self.by_hash.get(h)
+        if rec is not None:
+            slot, noff, nlen, koff, klen = rec
+            if (nlen == ns_len and klen == key_len
+                    and base[noff:noff + nlen] == base[ns_off:ns_off + ns_len]
+                    and base[koff:koff + klen]
+                    == base[key_off:key_off + key_len]):
+                return slot
+            raise _LaneColl()
+        slot = len(self.keys)
+        self.keys.append((h, ns_off, ns_len, key_off, key_len))
+        self.by_hash[h] = (slot, ns_off, ns_len, key_off, key_len)
+        return slot
+
+
+def _lane_u32(c: _LaneCur) -> int:
+    if c.end - c.p < 4:
+        raise _LaneStat(LANE_BAD)
+    v = int.from_bytes(c.b[c.p:c.p + 4], "big")
+    c.p += 4
+    return v
+
+
+def _lane_i64(c: _LaneCur):
+    """rd_i64 mirror: None on non-'I' tag / truncation, else the int."""
+    if c.p >= c.end or c.b[c.p] != 0x49 or c.end - c.p < 10:
+        return None
+    v = int.from_bytes(c.b[c.p + 1:c.p + 9], "big", signed=True)
+    c.p += 9
+    return v
+
+
+def _lane_str(c: _LaneCur):
+    """rd_str mirror: (off, len) span of an 'S' value, BAD otherwise."""
+    if c.p >= c.end or c.b[c.p] != 0x53:
+        raise _LaneStat(LANE_BAD)
+    c.p += 1
+    n = _lane_u32(c)
+    if c.end - c.p < n:
+        raise _LaneStat(LANE_BAD)
+    try:
+        c.b[c.p:c.p + n].decode("utf-8")
+    except UnicodeDecodeError:
+        raise _LaneStat(LANE_BAD) from None
+    off = c.p
+    c.p += n
+    return off, n
+
+
+def _lane_bytes(c: _LaneCur):
+    """rd_bytes mirror: (off, len) span of a 'B' value, BAD otherwise."""
+    if c.p >= c.end or c.b[c.p] != 0x42:
+        raise _LaneStat(LANE_BAD)
+    c.p += 1
+    n = _lane_u32(c)
+    if c.end - c.p < n:
+        raise _LaneStat(LANE_BAD)
+    off = c.p
+    c.p += n
+    return off, n
+
+
+def _lane_canon(c: _LaneCur, depth: int) -> None:
+    """canon_value_d mirror: skip one strict-canonical value or BAD."""
+    if depth > serde.MAX_DEPTH or c.p >= c.end:
+        raise _LaneStat(LANE_BAD)
+    tag = c.b[c.p]
+    c.p += 1
+    if tag in (0x4E, 0x54, 0x46):              # N T F
+        return
+    if tag == 0x49:                            # I
+        if c.end - c.p < 8:
+            raise _LaneStat(LANE_BAD)
+        c.p += 8
+        return
+    if tag == 0x56:                            # V
+        n = _lane_u32(c)
+        if (c.end - c.p < n or n < 8 or c.b[c.p] == 0
+                or (n == 8 and c.b[c.p] < 0x80)):
+            raise _LaneStat(LANE_BAD)
+        c.p += n
+        return
+    if tag == 0x42:                            # B
+        n = _lane_u32(c)
+        if c.end - c.p < n:
+            raise _LaneStat(LANE_BAD)
+        c.p += n
+        return
+    if tag == 0x53:                            # S
+        c.p -= 1
+        _lane_str(c)
+        return
+    if tag == 0x4C:                            # L
+        n = _lane_u32(c)
+        for _ in range(n):
+            _lane_canon(c, depth + 1)
+        return
+    if tag == 0x44:                            # D
+        n = _lane_u32(c)
+        prev = [None]
+        for _ in range(n):
+            _lane_dict_key(c, prev)
+            _lane_canon(c, depth + 1)
+        return
+    raise _LaneStat(LANE_BAD)
+
+
+def _lane_dict_enter(c: _LaneCur) -> int:
+    if c.p >= c.end or c.b[c.p] != 0x44:
+        raise _LaneStat(LANE_BAD)
+    c.p += 1
+    return _lane_u32(c)
+
+
+def _lane_dict_key(c: _LaneCur, prev: list) -> bytes:
+    kn = _lane_u32(c)
+    if c.end - c.p < kn:
+        raise _LaneStat(LANE_BAD)
+    k = c.b[c.p:c.p + kn]
+    c.p += kn
+    try:
+        k.decode("utf-8")
+    except UnicodeDecodeError:
+        raise _LaneStat(LANE_BAD) from None
+    if prev[0] is not None and prev[0] >= k:
+        raise _LaneStat(LANE_BAD)
+    prev[0] = k
+    return k
+
+
+def _lane_dict_find(c: _LaneCur, want: bytes):
+    """dict_find mirror: value span (off, end) or None; BAD on
+    malformation.  Canon-validates the full dict either way."""
+    n = _lane_dict_enter(c)
+    prev = [None]
+    found = None
+    for _ in range(n):
+        k = _lane_dict_key(c, prev)
+        vstart = c.p
+        _lane_canon(c, 1)
+        if k == want:
+            found = (vstart, c.p)
+    return found
+
+
+def _lane_version(c: _LaneCur):
+    if c.p >= c.end:
+        raise _LaneStat(LANE_BAD)
+    tag = c.b[c.p]
+    if tag == 0x4E:                            # N: absent version
+        c.p += 1
+        return 0, 0, 0
+    if tag != 0x4C:
+        raise _LaneStat(LANE_UNKNOWN)
+    c.p += 1
+    n = _lane_u32(c)
+    if n < 2:
+        raise _LaneStat(LANE_BAD)              # v[0]/v[1] IndexError
+    v0 = _lane_i64(c)
+    if v0 is None or not -(2 ** 31) <= v0 <= 2 ** 31 - 1:
+        raise _LaneStat(LANE_UNKNOWN)
+    v1 = _lane_i64(c)
+    if v1 is None or not -(2 ** 31) <= v1 <= 2 ** 31 - 1:
+        raise _LaneStat(LANE_UNKNOWN)
+    for _ in range(n - 2):
+        _lane_canon(c, 1)
+    return 1, v0 & _M64, v1 & _M64
+
+
+def _lane_read(c: _LaneCur, st: _LaneState, emit: bool, tx: int,
+               ns_off: int, ns_len: int) -> None:
+    n = _lane_dict_enter(c)
+    prev = [None]
+    key_off = key_len = 0
+    has = blk = txn = 0
+    have_key = False
+    for _ in range(n):
+        k = _lane_dict_key(c, prev)
+        if k == b"key":
+            if c.p >= c.end or c.b[c.p] != 0x53:
+                raise _LaneStat(LANE_UNKNOWN)
+            key_off, key_len = _lane_str(c)
+            have_key = True
+        elif k == b"version":
+            has, blk, txn = _lane_version(c)
+        else:
+            _lane_canon(c, 1)
+    if not have_key:
+        raise _LaneStat(LANE_BAD)
+    if emit:
+        slot = st.intern(ns_off, ns_len, key_off, key_len)
+        st.reads.append((tx, slot, has, blk, txn))
+
+
+def _lane_write(c: _LaneCur, st: _LaneState, emit: bool, tx: int,
+                ns_off: int, ns_len: int) -> None:
+    n = _lane_dict_enter(c)
+    prev = [None]
+    key_off = key_len = 0
+    delete = voff = vlen = 0
+    have_key = False
+    for _ in range(n):
+        k = _lane_dict_key(c, prev)
+        if k == b"key":
+            if c.p >= c.end or c.b[c.p] != 0x53:
+                raise _LaneStat(LANE_UNKNOWN)
+            key_off, key_len = _lane_str(c)
+            have_key = True
+        elif k == b"is_delete":
+            if c.p >= c.end:
+                raise _LaneStat(LANE_BAD)
+            if c.b[c.p] == 0x54:               # T
+                delete = 1
+            elif c.b[c.p] == 0x46:             # F
+                delete = 0
+            else:
+                raise _LaneStat(LANE_UNKNOWN)  # truthy non-bool
+            c.p += 1
+        elif k == b"value":
+            if c.p >= c.end or c.b[c.p] != 0x42:
+                raise _LaneStat(LANE_UNKNOWN)
+            voff, vlen = _lane_bytes(c)
+        else:
+            _lane_canon(c, 1)
+    if not have_key:
+        raise _LaneStat(LANE_BAD)
+    if emit:
+        slot = st.intern(ns_off, ns_len, key_off, key_len)
+        st.writes.append((tx, slot, delete, voff, vlen))
+
+
+def _lane_ns(c: _LaneCur, st: _LaneState, emit: bool, tx: int) -> bool:
+    """One NsRwSet dict; True when a non-empty range_queries list was
+    seen (caller escalates the whole envelope to RANGE)."""
+    n = _lane_dict_enter(c)
+    prev = [None]
+    ns_off = ns_len = 0
+    have_ns = have_reads = have_writes = saw_range = False
+    for _ in range(n):
+        k = _lane_dict_key(c, prev)
+        if k == b"namespace":
+            if c.p >= c.end or c.b[c.p] != 0x53:
+                raise _LaneStat(LANE_UNKNOWN)
+            ns_off, ns_len = _lane_str(c)
+            have_ns = True
+        elif k == b"reads":
+            if not have_ns:
+                raise _LaneStat(LANE_BAD)
+            if c.p >= c.end or c.b[c.p] != 0x4C:
+                raise _LaneStat(LANE_UNKNOWN)
+            c.p += 1
+            for _ in range(_lane_u32(c)):
+                _lane_read(c, st, emit, tx, ns_off, ns_len)
+            have_reads = True
+        elif k == b"writes":
+            if not have_ns:
+                raise _LaneStat(LANE_BAD)
+            if c.p >= c.end or c.b[c.p] != 0x4C:
+                raise _LaneStat(LANE_UNKNOWN)
+            c.p += 1
+            for _ in range(_lane_u32(c)):
+                _lane_write(c, st, emit, tx, ns_off, ns_len)
+            have_writes = True
+        elif k == b"range_queries":
+            if c.p >= c.end or c.b[c.p] != 0x4C:
+                raise _LaneStat(LANE_UNKNOWN)
+            peek = _LaneCur(c.b, c.p + 1, c.end)
+            qn = _lane_u32(peek)
+            _lane_canon(c, 1)
+            if qn > 0:
+                saw_range = True
+        else:
+            _lane_canon(c, 1)
+    if not (have_ns and have_reads and have_writes):
+        raise _LaneStat(LANE_BAD)
+    return saw_range
+
+
+def _lane_rwset(c: _LaneCur, st: _LaneState, emit: bool, tx: int) -> None:
+    n = _lane_dict_enter(c)
+    prev = [None]
+    saw_range = False
+    have_ns_list = False
+    for _ in range(n):
+        k = _lane_dict_key(c, prev)
+        if k == b"ns":
+            if c.p >= c.end or c.b[c.p] != 0x4C:
+                raise _LaneStat(LANE_UNKNOWN)
+            c.p += 1
+            for _ in range(_lane_u32(c)):
+                if _lane_ns(c, st, emit, tx):
+                    saw_range = True
+            have_ns_list = True
+        else:
+            _lane_canon(c, 1)
+    if not have_ns_list:
+        raise _LaneStat(LANE_BAD)
+    if saw_range:
+        raise _LaneStat(LANE_RANGE)
+
+
+def _lane_endorsement(c: _LaneCur) -> None:
+    n = _lane_dict_enter(c)
+    prev = [None]
+    have_e = have_s = False
+    for _ in range(n):
+        k = _lane_dict_key(c, prev)
+        if k == b"endorser":
+            have_e = True
+        elif k == b"signature":
+            have_s = True
+        _lane_canon(c, 1)
+    if not (have_e and have_s):
+        raise _LaneStat(LANE_BAD)
+
+
+def _lane_cc_action(c: _LaneCur, st: _LaneState, emit: bool,
+                    tx: int) -> None:
+    n = _lane_dict_enter(c)
+    prev = [None]
+    have_id = have_ver = have_rw = False
+    for _ in range(n):
+        k = _lane_dict_key(c, prev)
+        if k == b"chaincode_id":
+            have_id = True
+            _lane_canon(c, 1)
+        elif k == b"chaincode_version":
+            have_ver = True
+            _lane_canon(c, 1)
+        elif k == b"rwset":
+            _lane_rwset(c, st, emit, tx)
+            have_rw = True
+        else:
+            _lane_canon(c, 1)
+    if not (have_id and have_ver and have_rw):
+        raise _LaneStat(LANE_BAD)
+
+
+def _lane_action(c: _LaneCur, st: _LaneState, emit: bool, tx: int) -> None:
+    n = _lane_dict_enter(c)
+    prev = [None]
+    have_ph = have_act = have_end = False
+    for _ in range(n):
+        k = _lane_dict_key(c, prev)
+        if k == b"action":
+            _lane_cc_action(c, st, emit, tx)
+            have_act = True
+        elif k == b"endorsements":
+            if c.p >= c.end or c.b[c.p] != 0x4C:
+                raise _LaneStat(LANE_UNKNOWN)
+            c.p += 1
+            for _ in range(_lane_u32(c)):
+                _lane_endorsement(c)
+            have_end = True
+        elif k == b"proposal_hash":
+            have_ph = True
+            _lane_canon(c, 1)
+        else:
+            _lane_canon(c, 1)
+    if not (have_ph and have_act and have_end):
+        raise _LaneStat(LANE_BAD)
+
+
+def _lane_env(base: bytes, off: int, ln: int, tx: int, st: _LaneState):
+    """walk_env mirror: (txid_off, txid_len) of an OK endorser tx, or a
+    _LaneStat with the terminal status."""
+    c = _LaneCur(base, off, off + ln)
+    payload_span = None
+    have_sig = False
+    n = _lane_dict_enter(c)
+    prev = [None]
+    for _ in range(n):
+        k = _lane_dict_key(c, prev)
+        vstart = c.p
+        _lane_canon(c, 1)
+        if k == b"payload":
+            payload_span = (vstart, c.p)
+        elif k == b"signature":
+            have_sig = True
+    if c.p != c.end:
+        raise _LaneStat(LANE_BAD)              # trailing bytes
+    if payload_span is None or not have_sig:
+        raise _LaneStat(LANE_BAD)              # KeyError
+    if base[payload_span[0]] != 0x42:
+        raise _LaneStat(LANE_UNKNOWN)          # decode(non-bytes)
+    pc = _LaneCur(base, payload_span[0], payload_span[1])
+    poff, pn = _lane_bytes(pc)
+
+    pc = _LaneCur(base, poff, poff + pn)
+    header_v = _lane_dict_find(pc, b"header")
+    if header_v is None or pc.p != pc.end:
+        raise _LaneStat(LANE_BAD)
+    ch_v = _lane_dict_find(_LaneCur(base, *header_v), b"channel_header")
+    if ch_v is None:
+        raise _LaneStat(LANE_BAD)
+    type_v = _lane_dict_find(_LaneCur(base, *ch_v), b"type")
+    if type_v is None:
+        raise _LaneStat(LANE_BAD)
+    tv = _LaneCur(base, *type_v)
+    if tv.p >= tv.end or base[tv.p] != 0x53:
+        raise _LaneStat(LANE_SKIP)             # non-str != TX_ENDORSER
+    soff, sn = _lane_str(tv)
+    if base[soff:soff + sn] != b"endorser_transaction":
+        raise _LaneStat(LANE_SKIP)
+
+    pc = _LaneCur(base, poff, poff + pn)
+    data_v = _lane_dict_find(pc, b"data")
+    if data_v is None:
+        raise _LaneStat(LANE_BAD)
+    actions_v = _lane_dict_find(_LaneCur(base, *data_v), b"actions")
+    if actions_v is None:
+        raise _LaneStat(LANE_BAD)
+    av = _LaneCur(base, *actions_v)
+    if av.p >= av.end or base[av.p] != 0x4C:
+        raise _LaneStat(LANE_UNKNOWN)
+    av.p += 1
+    an = _lane_u32(av)
+    if an == 0:
+        raise _LaneStat(LANE_SKIP)             # `not tx.actions` -> None,
+                                               # BEFORE ch["txid"] is read
+    for i in range(an):
+        _lane_action(av, st, i == 0, tx)
+
+    txid_v = _lane_dict_find(_LaneCur(base, *ch_v), b"txid")
+    if txid_v is None:
+        raise _LaneStat(LANE_BAD)
+    xv = _LaneCur(base, *txid_v)
+    if xv.p >= xv.end or base[xv.p] != 0x53:
+        raise _LaneStat(LANE_UNKNOWN)
+    return _lane_str(xv)
+
+
+def rwset_lanes_py(base: _Raw, spans) -> Optional[tuple]:
+    """Mirror of _fastparse.rwset_lanes (same result tuple, same arena
+    bytes — see the lane-layout comment above rwset_lanes())."""
+    base = bytes(base)
+    sp = bytes(spans)
+    if len(sp) % 16:
+        return None
+    blen = len(base)
+    n_tx = len(sp) // 16
+    st = _LaneState(base)
+    txs = []
+    for t in range(n_tx):
+        off, ln = struct.unpack_from("QQ", sp, 16 * t)
+        if off > blen or ln > blen - off:
+            return None
+        rd_mark, wr_mark = len(st.reads), len(st.writes)
+        try:
+            txid_off, txid_len = _lane_env(base, off, ln, t, st)
+            stat = LANE_OK
+        except _LaneStat as e:
+            del st.reads[rd_mark:]             # drop partial lanes;
+            del st.writes[wr_mark:]            # interned keys stay (C
+            stat, txid_off, txid_len = e.st, 0, 0  # parity)
+        except _LaneColl:
+            return (1, 0, 0, 0, 0, None)
+        txs.append((stat, txid_off, txid_len))
+    cells: list = []
+    for rec in txs:
+        cells.extend(rec)
+    for rec in st.reads:
+        cells.extend(rec)
+    for rec in st.writes:
+        cells.extend(rec)
+    for rec in st.keys:
+        cells.extend(rec)
+    arena = struct.pack(f"{len(cells)}Q", *cells)
+    return (0, n_tx, len(st.keys), len(st.reads), len(st.writes), arena)

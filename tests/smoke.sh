@@ -52,6 +52,9 @@ python tests/smoke_parallel_commit.py
 echo "== overload probe (open-loop 2x saturation, admission shed + recovery) =="
 python tests/smoke_overload.py
 
+echo "== device validation probe (fused gate+MVCC vs host oracle, two-stack gate) =="
+python tests/smoke_device_validate.py
+
 echo "== ASan/UBSan fuzz corpus vs the native wire parser =="
 # Build _fastparse with the sanitizers and drive the full adversarial
 # corpus (tests/test_fastparse.py --asan-corpus) through it: any heap

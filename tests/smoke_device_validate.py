@@ -1,0 +1,191 @@
+"""Smoke probe for device-resident block validation (called by smoke.sh).
+
+Two-stack divergence gate over 8 virtual devices: the same adversarial
+block stream (shared envelope bytes — ww chains, stale reads, deletes,
+a policy failure, a corrupted creator signature, and an engineered
+uint64 key-hash collision block) runs through a host-oracle Committer
+and a device_validate Committer side by side.  Flags, state, history,
+and every block's commit hash must be bit-identical; the fused path
+must issue EXACTLY one device dispatch per device-validated block
+(collision block demotes, zero dispatches); and the verify-once
+invariant `verify_plane_duplicate_device_verifications_total` must
+stay 0.
+
+Named smoke_* (not test_*) on purpose: this is a script for the shell
+gate, not a pytest module.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+from fabric_tpu.committer import Committer, PolicyRegistry, TxValidator
+from fabric_tpu.committer.device_validate import DeviceValidator
+from fabric_tpu.ledger import KVLedger, LedgerConfig
+from fabric_tpu.msp import CachedMSP
+from fabric_tpu.msp.ca import DevOrg
+from fabric_tpu.ops_plane import registry
+from fabric_tpu.policy import parse_policy
+from fabric_tpu.protocol import (Envelope, KVRead, KVWrite, NsRwSet,
+                                 TxRwSet, Version)
+from fabric_tpu.protocol import build
+
+
+def _fail(msg) -> int:
+    print(f"FAIL: {msg}", file=sys.stderr)
+    return 1
+
+
+def _stream(org1, org2):
+    """Built ONCE — endorser_tx mints fresh signatures per call, so both
+    stacks must see identical envelope bytes."""
+    def tx(rwset, endorsers=None):
+        endorsers = endorsers or [org1.new_identity("e1"),
+                                  org2.new_identity("e2")]
+        return build.endorser_tx("ch", "cc", "1.0", rwset,
+                                 org1.new_identity("client"), endorsers)
+
+    def rw(reads=(), writes=()):
+        return TxRwSet((NsRwSet("cc", reads=tuple(reads),
+                                writes=tuple(writes)),))
+
+    seed = [tx(rw(writes=[KVWrite(f"k{i:02d}", b"v0")])) for i in range(8)]
+    bad_sig = tx(rw(writes=[KVWrite("k06", b"evil")]))
+    bad_sig = Envelope(bad_sig.payload, bad_sig.signature[:-2] + b"\x00\x01")
+    mixed = [
+        # ww chain: first reader wins, the next two lose MVCC
+        tx(rw(reads=[KVRead("k00", Version(0, 0))],
+              writes=[KVWrite("k00", b"a")])),
+        tx(rw(reads=[KVRead("k00", Version(0, 0))],
+              writes=[KVWrite("k00", b"b")])),
+        tx(rw(reads=[KVRead("k00", Version(0, 0))])),
+        # delete-then-read inside the block
+        tx(rw(reads=[KVRead("k01", Version(0, 1))],
+              writes=[KVWrite("k01", b"", True)])),
+        tx(rw(reads=[KVRead("k01", Version(0, 1))])),
+        # AND(Org1, Org2) policy with a single endorser -> 10
+        tx(rw(writes=[KVWrite("k05", b"x")]),
+           endorsers=[org1.new_identity("solo")]),
+        bad_sig,
+    ]
+    # engineered djb2-64 collision: "ab" and "bA" hash identically; the
+    # interner detects it byte-wise and the block demotes to host
+    collide = [tx(rw(writes=[KVWrite("ab", b"1")])),
+               tx(rw(writes=[KVWrite("bA", b"2")])),
+               tx(rw(reads=[KVRead("k02", Version(0, 2))],
+                     writes=[KVWrite("k02", b"c")]))]
+    tail = [tx(rw(reads=[KVRead("k02", Version(2, 2))],
+                  writes=[KVWrite("k02", b"d")])),
+            tx(rw(reads=[KVRead("ab", Version(2, 0))]))]
+    return [seed, mixed, collide, tail]
+
+
+def _run(provider, orgs, blocks, device):
+    org1, org2 = orgs
+    msps = {o.mspid: CachedMSP(o.msp()) for o in (org1, org2)}
+    policies = PolicyRegistry()
+    policies.set_policy("cc", parse_policy("AND('Org1.member', "
+                                           "'Org2.member')"))
+    lg = KVLedger("ch", LedgerConfig(device_validate=device))
+    dv = None
+    if device:
+        dv = DeviceValidator(lg.statedb, "ch")
+        lg.set_prepared_source(dv.take_prepared)
+    committer = Committer(lg, TxValidator("ch", msps, provider, policies,
+                                          device_validate=dv))
+    hashes, flags = [], []
+    for envs in blocks:
+        prev = (lg.blockstore.chain_info().current_hash
+                if lg.height else b"\x00" * 32)
+        res = committer.store_block(build.new_block(lg.height, prev, envs))
+        hashes.append(lg.commit_hash)
+        flags.append(res.final_flags.codes())
+    return lg, hashes, flags
+
+
+def _cval(name, **labels) -> float:
+    try:
+        return registry.counter(name).value(**labels)
+    except Exception:
+        return 0.0
+
+
+def main() -> int:
+    import jax
+    n_dev = len(jax.devices())
+    if n_dev != 8:
+        return _fail(f"expected 8 virtual devices, got {n_dev}")
+
+    provider = init_factories(FactoryOpts(default="SW"))
+    orgs = (DevOrg("Org1"), DevOrg("Org2"))
+    blocks = _stream(*orgs)
+
+    d0 = _cval("validator_device_dispatches_total", channel="ch")
+    b0 = _cval("validator_device_blocks_total", channel="ch")
+    c0 = _cval("validator_device_demotions_total", channel="ch",
+               reason="hash_collision")
+
+    host_lg, host_h, host_f = _run(provider, orgs, blocks, device=False)
+    if _cval("validator_device_dispatches_total", channel="ch") != d0:
+        return _fail("host stack touched the device dispatch counter")
+    dev_lg, dev_h, dev_f = _run(provider, orgs, blocks, device=True)
+
+    if host_f != dev_f:
+        return _fail(f"flags diverged: {host_f} != {dev_f}")
+    for i, (a, b) in enumerate(zip(host_h, dev_h)):
+        if a != b:
+            return _fail(f"commit hash diverged at block {i}: "
+                         f"{a.hex()[:16]} != {b.hex()[:16]}")
+    print(f"OK: {len(blocks)} blocks, flags + commit hashes identical "
+          f"(…{dev_h[-1].hex()[:16]})")
+
+    keys = sorted({k for _ns, k in host_lg.statedb._data} |
+                  {k for _ns, k in dev_lg.statedb._data})
+    for k in keys:
+        if host_lg.get_state("cc", k) != dev_lg.get_state("cc", k):
+            return _fail(f"state diverged at {k}")
+        hh = [(m.block_num, m.tx_num, m.txid, m.value, m.is_delete)
+              for m in host_lg.get_history("cc", k)]
+        hd = [(m.block_num, m.tx_num, m.txid, m.value, m.is_delete)
+              for m in dev_lg.get_history("cc", k)]
+        if hh != hd:
+            return _fail(f"history diverged at {k}")
+    print(f"OK: state + history identical across {len(keys)} keys")
+
+    dispatches = _cval("validator_device_dispatches_total",
+                       channel="ch") - d0
+    dev_blocks = _cval("validator_device_blocks_total", channel="ch") - b0
+    collisions = _cval("validator_device_demotions_total", channel="ch",
+                       reason="hash_collision") - c0
+    # 4 blocks, 1 demoted by the engineered collision -> exactly 3
+    # dispatches, one per device-validated block
+    if dispatches != dev_blocks:
+        return _fail(f"dispatch contract broken: {dispatches} dispatches "
+                     f"for {dev_blocks} device-validated blocks")
+    if dev_blocks != len(blocks) - 1:
+        return _fail(f"expected {len(blocks) - 1} device-validated "
+                     f"blocks, got {dev_blocks}")
+    if collisions != 1:
+        return _fail(f"expected 1 hash_collision demotion, "
+                     f"got {collisions}")
+    dup = registry.counter(
+        "verify_plane_duplicate_device_verifications_total").total() \
+        if registry.get(
+            "verify_plane_duplicate_device_verifications_total") else 0.0
+    if dup != 0:
+        return _fail(f"verify-once invariant broken: {dup} duplicate "
+                     f"device verifications")
+    print(f"OK: exactly one dispatch per device-validated block "
+          f"({int(dispatches)}/{int(dev_blocks)}), collision demoted, "
+          f"0 duplicate device verifications")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

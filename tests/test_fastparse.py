@@ -32,7 +32,8 @@ from fabric_tpu.msp.ca import DevOrg
 from fabric_tpu.policy import parse_policy
 from fabric_tpu.protocol import build, wire
 from fabric_tpu.protocol.types import (Block, BlockHeader, BlockMetadata,
-                                       KVWrite, NsRwSet, TxRwSet,
+                                       KVRead, KVWrite, NsRwSet,
+                                       RangeQueryInfo, TxRwSet, Version,
                                        block_data_hash)
 from fabric_tpu.utils import serde
 
@@ -366,29 +367,143 @@ def test_arena_ring_reuse():
     assert after["block_accept"] > before["block_accept"]
 
 
+# -- rwset lane extraction: native vs mirror ---------------------------------
+
+def _lane_envs(org1, org2):
+    """Serialized envelopes with adversarial rw-set shapes (lane corpus
+    building blocks; built once per call — signing is the slow part)."""
+    def env(rwset):
+        return build.endorser_tx(
+            "ch", "cc", "1.0", rwset, org1.new_identity("c"),
+            [org1.new_identity("e1")]).serialize()
+
+    V = Version
+    envs = [
+        env(TxRwSet(())),                              # empty rwset
+        env(TxRwSet((NsRwSet("cc", reads=(
+            KVRead("a", None), KVRead("b", V(0, 1)),
+            KVRead("a", V(3, 4)))),))),                # dup key interning
+        env(TxRwSet((NsRwSet("cc", writes=(
+            KVWrite("a", b""), KVWrite("del", b"", True),
+            KVWrite("big", bytes(range(256)) * 7))),))),
+        env(TxRwSet((NsRwSet("cc", range_queries=(
+            RangeQueryInfo("a", "z", True, ()),)),))),  # status RANGE
+        env(TxRwSet((NsRwSet("ns-β", reads=(
+            KVRead("κ-key", V(1, 2)),),
+            writes=(KVWrite("κ-key", "vé".encode()),)),))),
+        env(TxRwSet((NsRwSet("cc", writes=(
+            KVWrite("ab", b"1"), KVWrite("bA", b"2"))),))),  # djb2 collision
+        env(TxRwSet((NsRwSet("cc", reads=(
+            KVRead("k", V(1 << 40, (1 << 40) + 3)),)),))),   # > i32 versions
+        env(TxRwSet((NsRwSet("x", writes=(KVWrite("k", b"1"),)),
+                     NsRwSet("y", writes=(KVWrite("k", b"2"),))))),
+    ]
+    return envs
+
+
+def _span_table(parts):
+    spans, off = bytearray(), 0
+    for p in parts:
+        spans += struct.pack("QQ", off, len(p))
+        off += len(p)
+    return b"".join(parts), bytes(spans)
+
+
+def lane_fuzz_corpus(seed, org1=None, org2=None, envs=None):
+    """(base, spans) pairs for rwset_lanes: well-formed blocks over the
+    adversarial rw-set envelopes, plus mutated bases and bogus/ragged
+    span tables — deterministic per seed."""
+    rng = random.Random(seed)
+    if envs is None:
+        if org1 is None:
+            org1, org2 = _org_world()
+        envs = _lane_envs(org1, org2)
+    pool = envs + [b"", b"junk", envs[1][:30]]         # junk -> status BAD
+    out = []
+    groups = [[rng.choice(pool) for _ in range(rng.randrange(0, 5))]
+              for _ in range(10)]
+    groups.append(list(envs))                           # incl. collision
+    groups.append(envs[:5])                             # collision-free mix
+    for parts in groups:
+        base, spans = _span_table(parts)
+        out.append((base, spans))
+        if spans:
+            mut = bytearray(spans)
+            mut[rng.randrange(len(mut))] ^= 1 << rng.randrange(8)
+            out.append((base, bytes(mut)))              # bogus offset/len
+            out.append((base, spans[:rng.randrange(len(spans))]))  # ragged
+        if base:
+            mb = bytearray(base)
+            mb[rng.randrange(len(mb))] ^= 1 << rng.randrange(8)
+            out.append((bytes(mb), spans))              # bitflipped envelope
+    out.append((b"", b""))
+    out.append((b"x", struct.pack("QQ", 1 << 63, 1 << 63)))  # huge offsets
+    out.append((b"x" * 64, struct.pack("QQ", 60, 10)))       # end past base
+    return out
+
+
+def test_rwset_lanes_native_matches_mirror():
+    """Full-tuple bit identity: accept/reject/collision decision, lane
+    counts, and every arena byte (the device validator consumes these
+    lanes verbatim — tests/test_device_validate.py gates end-to-end)."""
+    org1, org2 = _org_world()
+    envs = _lane_envs(org1, org2)
+    n_accept = n_collide = 0
+    for seed in (11, 22, 33):
+        for base, spans in lane_fuzz_corpus(seed, envs=envs):
+            nat = wire._fastparse.rwset_lanes(base, spans)
+            mir = wire.rwset_lanes_py(base, spans)
+            assert (nat is None) == (mir is None), (spans.hex()[:64],)
+            if nat is None:
+                continue
+            nf, nt, nk, nr, nw, narena = nat
+            mf, mt, mk, mr, mw, marena = mir
+            assert (nf, nt, nk, nr, nw) == (mf, mt, mk, mr, mw)
+            if nf:
+                n_collide += 1
+                assert narena is None and marena is None
+                continue
+            n_accept += 1
+            assert bytes(memoryview(narena)) == bytes(marena)
+    assert n_accept > 10 and n_collide > 0  # corpus exercised both paths
+
+
 # -- ASan/UBSan smoke driver (tests/smoke.sh) --------------------------------
 
 def run_sanitizer_corpus(mod, seeds=(11, 22, 33)):
     """Drive a (sanitizer-built) _fastparse module over the full corpus;
     any memory error aborts the process — that IS the gate."""
     org1, org2 = _org_world()
-    n_blk = n_env = 0
+    lane_envs = _lane_envs(org1, org2)
+    n_blk = n_env = n_lane = 0
     for seed in seeds:
         for raw in fuzz_corpus(seed, org1, org2):
             r = mod.parse_block(raw)
             if r is not None:
                 n_blk += 1
                 memoryview(r[6])[:]                  # touch the arena
+                # key-hash lane extraction over the parsed span table
+                # (bounds-stress: spans index the full block buffer)
+                lanes = mod.rwset_lanes(raw, bytes(memoryview(r[6])))
+                if lanes is not None and lanes[5] is not None:
+                    memoryview(lanes[5])[:]          # touch the lane arena
         for raw in env_fuzz_corpus(seed, org1, org2):
             if mod.envelope_summary(raw) is not None:
                 n_env += 1
-    return n_blk, n_env
+        for base, spans in lane_fuzz_corpus(seed, envs=lane_envs):
+            lanes = mod.rwset_lanes(base, spans)
+            if lanes is not None:
+                if lanes[5] is not None:
+                    memoryview(lanes[5])[:]
+                n_lane += 1
+    return n_blk, n_env, n_lane
 
 
 if __name__ == "__main__":
     if "--asan-corpus" in sys.argv:
         import importlib
         mod = importlib.import_module("_fastparse")
-        n_blk, n_env = run_sanitizer_corpus(mod)
+        n_blk, n_env, n_lane = run_sanitizer_corpus(mod)
         print(f"sanitizer corpus clean: {n_blk} blocks, "
-              f"{n_env} envelopes accepted; stats={mod.stats()}")
+              f"{n_env} envelopes, {n_lane} lane tables accepted; "
+              f"stats={mod.stats()}")

@@ -280,7 +280,7 @@ def test_early_abort_analyzer_doom_set(org):
         tx(org, rw(reads=[KVRead("k02", None)])),               # sees delete
         tx(org, rw(reads=[KVRead("k02", Version(8, 8))])),      # doomed
         tx(org, rw(reads=[KVRead("k03", Version(9, 9))],
-                   rqs=[RangeQueryInfo("k0", "k1", True, ())])),  # rq: spared
+                   rqs=[RangeQueryInfo("k0", "k1", True, ())])),  # read dooms
         tx(org, rw(reads=[KVRead("nope", None)])),              # nil ok
     ]
     block = _block_of(envs)
@@ -288,7 +288,8 @@ def test_early_abort_analyzer_doom_set(org):
     analyzer = EarlyAbortAnalyzer(db, "ch")
     assert analyzer.doomed(block) == {
         0: ValidationCode.MVCC_READ_CONFLICT,
-        6: ValidationCode.MVCC_READ_CONFLICT}
+        6: ValidationCode.MVCC_READ_CONFLICT,
+        7: ValidationCode.MVCC_READ_CONFLICT}
 
 
 def test_early_abort_savepoint_guard(org):
@@ -312,6 +313,110 @@ def test_early_abort_doomed_writes_never_mask_later_reads(org):
     ]
     doomed = EarlyAbortAnalyzer(db, "ch").doomed(_block_of(envs))
     assert sorted(doomed) == [0, 1]
+
+
+def _rec(i):
+    return KVRead(f"k{i:02d}", Version(1, i))
+
+
+def test_early_abort_range_doom_set(org):
+    """Ranges over intervals provably untouched by preceding in-block
+    writes are decided against committed state; touched intervals are
+    spared (mirrors the point-read guards)."""
+    db = seeded_db()
+    envs = [
+        tx(org, rw(rqs=[RangeQueryInfo(
+            "k05", "k08", True, (_rec(5),))])),         # wrong: 3 keys live
+        tx(org, rw(rqs=[RangeQueryInfo(
+            "k05", "k08", True, (_rec(5), _rec(6), _rec(7)))])),  # correct
+        tx(org, rw(writes=[KVWrite("k06", b"new")])),   # touches [k05,k08)
+        tx(org, rw(rqs=[RangeQueryInfo(
+            "k05", "k08", True, (_rec(5), _rec(6), _rec(7)))])),  # undecidable
+        tx(org, rw(rqs=[RangeQueryInfo(
+            "k17", "k19", True,
+            (_rec(17), KVRead("k18x", None), _rec(18)))])),  # phantom recorded
+        tx(org, rw(rqs=[RangeQueryInfo(
+            "k19", "", True, (_rec(19),))])),  # open end: k06 put outside? no —
+        #   open interval [k19, ns-end) is untouched by the k06 put -> decided
+    ]
+    doomed = EarlyAbortAnalyzer(db, "ch").doomed(_block_of(envs))
+    assert doomed == {
+        0: ValidationCode.PHANTOM_READ_CONFLICT,
+        4: ValidationCode.PHANTOM_READ_CONFLICT}
+
+
+def test_early_abort_range_doom_matches_oracle_codes(org):
+    """Every doomed code must equal the byte the serial oracle stamps —
+    dooming is a prediction of the oracle, never a divergence."""
+    db = seeded_db()
+    envs = [
+        tx(org, rw(writes=[KVWrite("k01", b"x")])),
+        tx(org, rw(rqs=[RangeQueryInfo("k05", "k08", True, (_rec(5),))])),
+        tx(org, rw(reads=[KVRead("k09", Version(9, 9))],
+                   rqs=[RangeQueryInfo("k10", "k12", True,
+                                       (_rec(10), _rec(11)))])),
+        tx(org, rw(rqs=[RangeQueryInfo("k10", "k12", True, (_rec(10),))],
+                   reads=[])),
+    ]
+    doomed = EarlyAbortAnalyzer(db, "ch").doomed(_block_of(envs))
+    assert doomed == {1: ValidationCode.PHANTOM_READ_CONFLICT,
+                      2: ValidationCode.MVCC_READ_CONFLICT,
+                      3: ValidationCode.PHANTOM_READ_CONFLICT}
+    flags = TxFlags(len(envs), ValidationCode.VALID)
+    validate_and_prepare_batch(
+        seeded_db(), 2, [Envelope.deserialize(e.serialize())
+                         for e in envs], flags)
+    for t, code in doomed.items():
+        assert flags.flag(t) == code, f"tx{t}: doomed {code} != oracle"
+
+
+def test_early_abort_range_code_ambiguity_suppresses_doom(org):
+    """A certain failure after an uncertain check of the OTHER kind is
+    dead but undoomable: the oracle's first-failure code is unknown."""
+    db = seeded_db()
+    envs = [
+        tx(org, TxRwSet((
+            NsRwSet("aa", writes=(KVWrite("k10", b"w"),)),
+            NsRwSet("cc", writes=(KVWrite("k00", b"w"),))))),
+        # uncertain read (k00 touched) BEFORE certainly-failing range
+        # (reads precede ranges in walk order): could fail 11 first ->
+        # no doom
+        tx(org, rw(reads=[KVRead("k00", Version(1, 0))],
+                   rqs=[RangeQueryInfo("k05", "k08", True, (_rec(5),))])),
+        # uncertain range in an EARLIER namespace (aa:[k10,k12) touched
+        # by tx0) before a certainly-failing cc read: could fail 12
+        # first -> no doom
+        tx(org, TxRwSet((
+            NsRwSet("aa", range_queries=(
+                RangeQueryInfo("k10", "k12", True, ()),)),
+            NsRwSet("cc", reads=(KVRead("k15", Version(9, 9)),))))),
+        # uncertain READ before certainly-failing read: both are 11 ->
+        # doom stands
+        tx(org, rw(reads=[KVRead("k00", Version(1, 0)),
+                          KVRead("k15", Version(9, 9))])),
+    ]
+    doomed = EarlyAbortAnalyzer(db, "ch").doomed(_block_of(envs))
+    assert doomed == {3: ValidationCode.MVCC_READ_CONFLICT}
+
+
+def test_early_abort_range_dead_tx_writes_never_land(org):
+    """A tx dead from a certain range failure (even undoomable) never
+    records its writes, so later intervals it would have touched stay
+    decidable."""
+    db = seeded_db()
+    envs = [
+        # certainly-failing range + a write INTO [k10,k12)
+        tx(org, rw(rqs=[RangeQueryInfo("k05", "k08", True, (_rec(5),))],
+                   writes=[KVWrite("k11", b"never")])),
+        # the interval is untouched (tx0 dead) -> decidable -> doomed
+        tx(org, rw(rqs=[RangeQueryInfo("k10", "k12", True, (_rec(10),))])),
+        # and a correct one survives
+        tx(org, rw(rqs=[RangeQueryInfo("k10", "k12", True,
+                                       (_rec(10), _rec(11)))])),
+    ]
+    doomed = EarlyAbortAnalyzer(db, "ch").doomed(_block_of(envs))
+    assert doomed == {0: ValidationCode.PHANTOM_READ_CONFLICT,
+                      1: ValidationCode.PHANTOM_READ_CONFLICT}
 
 
 class CountingProvider:
