@@ -41,6 +41,15 @@ Production cost: the hot path is a single module-attribute load
 (`faults._PLAN is None`) per frame — no plan, no work.  `install()` is
 for tests and chaos drills only.
 
+Addressable targets: unary requests match their RPC method name
+(kind="req"), deliver stream frames match method="deliver"
+(kind="stream"), and multiplexed gossip casts are addressable by their
+INNER message type via the transport's fault_label —
+method="gossip.msg/<type>" (e.g. "gossip.msg/gossip.block",
+"gossip.msg/gossip.pull_req"), kind="cast".  Snapshot state-transfer
+chunks match method="state.snapshot_chunk" (kind="req"), so a chaos
+drill can drop/delay/dup the transfer itself.
+
 Observability: every fired fault bumps `fault_injected_total` in the
 ops-plane registry, emits a `fault.<action>` span event into the
 ambient trace (so /traces/<id> shows WHY a tx was slow under chaos),

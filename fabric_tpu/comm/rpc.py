@@ -134,13 +134,17 @@ class RpcConnection:
                 except Exception:
                     pass
 
-    def cast(self, method: str, body: dict) -> None:
+    def cast(self, method: str, body: dict,
+             fault_label: Optional[str] = None) -> None:
+        """fault_label refines what the fault plane matches as the
+        `method` of this frame (e.g. "gossip.msg/gossip.block" for a
+        multiplexed gossip cast) — the wire method is unchanged."""
         frame = {"kind": "cast", "method": method, "body": body}
         tp = tracing.tracer.traceparent()
         if tp:
             frame["tp"] = tp
         try:
-            _send_frame(self.channel, frame, method, "cast")
+            _send_frame(self.channel, frame, fault_label or method, "cast")
         except _faults.FaultInjected as exc:
             raise RpcError(str(exc)) from None
         except OSError as exc:

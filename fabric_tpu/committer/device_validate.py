@@ -260,6 +260,9 @@ class DeviceValidator:
 
         batch, history = self._rebuild(final, tx_sec, wr, key_strs,
                                        base, num, lw)
+        # pre-split by state shard off the commit lock path; the
+        # ledger's apply_updates consumes the cached split
+        batch.preshard(getattr(self.statedb, "n_shards", 1))
         final_bytes = bytes(final)
         with self._lock:
             self._stash[num] = (gate_bytes, sp, final_bytes, batch, history)

@@ -212,6 +212,9 @@ class ParallelCommitScheduler:
         self.last_edges = graph.n_edges
         self.last_max_width = graph.max_wave_width
         self._observe(graph)
+        # pre-split the batch by state shard here, off the ledger's
+        # commit lock path — apply_updates consumes the cached split
+        batch.preshard(getattr(db, "n_shards", 1))
         return batch, history
 
     def _observe(self, graph: ConflictGraph) -> None:

@@ -155,7 +155,11 @@ class SecureGossipTransport:
                     else:
                         self._conns[to] = conn
                         self._down_until.pop(to, None)
-            conn.cast("gossip.msg", payload)
+            # fault_label exposes the inner gossip type to the fault
+            # plane: rules can target e.g. "gossip.msg/gossip.block"
+            # instead of the opaque multiplexed wire method
+            conn.cast("gossip.msg", payload,
+                      fault_label=f"gossip.msg/{msg_type}")
         except Exception:
             with self._lock:
                 conn = self._conns.pop(to, None)

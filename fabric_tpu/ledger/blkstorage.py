@@ -14,10 +14,18 @@ blockfile_mgr's partial-write recovery).
 
 A native C++ segment backend (fabric_tpu/native) can replace the Python
 file I/O transparently; the index and API stay identical.
+
+Snapshot bootstrap: a store created from a shipped state snapshot has no
+blocks below the snapshot height.  A `BOOTSTRAP.json` marker records the
+base height and the chain hashes at the boundary (bootstrapFromSnapshot
++ bootstrappingSnapshotInfo in the reference's blockfile_mgr), so the
+chain check for the first delivered block and commit-hash chaining both
+survive the gap; blocks below `base` read as pruned.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import struct
 import threading
@@ -31,6 +39,7 @@ from fabric_tpu.protocol.txflags import TxFlags, ValidationCode
 
 _LEN = struct.Struct("<Q")
 SEGMENT_MAX_BYTES = 64 * 1024 * 1024
+BOOTSTRAP_FILE = "BOOTSTRAP.json"
 
 
 class BlockStoreError(Exception):
@@ -67,14 +76,51 @@ class BlockStore:
         self._cur_hash = b"\x00" * 32
         self._prev_hash = b"\x00" * 32
         self._open_segment_no = 0
+        # snapshot-bootstrap boundary: blocks < base are pruned
+        self.base = 0
+        self.bootstrap_commit_hash: Optional[bytes] = None
+        self._base_cur_hash = b"\x00" * 32
+        self._base_prev_hash = b"\x00" * 32
         if root is not None:
             os.makedirs(root, exist_ok=True)
+            self._load_bootstrap()
             self._recover()
 
     # -- recovery / files ---------------------------------------------------
 
     def _seg_path(self, n: int) -> str:
         return os.path.join(self.root, f"blocks_{n:06d}.bin")
+
+    def _load_bootstrap(self) -> None:
+        path = os.path.join(self.root, BOOTSTRAP_FILE)
+        if not os.path.exists(path):
+            return
+        with open(path, "r", encoding="utf-8") as f:
+            info = json.load(f)
+        self.base = int(info["base"])
+        self._base_cur_hash = bytes.fromhex(info["current_hash"])
+        self._base_prev_hash = bytes.fromhex(info["previous_hash"])
+        self.bootstrap_commit_hash = bytes.fromhex(info["commit_hash"])
+        self._cur_hash = self._base_cur_hash
+        self._prev_hash = self._base_prev_hash
+
+    @staticmethod
+    def write_bootstrap(root: str, base: int, current_hash: bytes,
+                        previous_hash: bytes, commit_hash: bytes) -> None:
+        """Durably stamp a snapshot-bootstrap boundary.  Written LAST by
+        the snapshot installer — its presence is the commit point that
+        makes an installed snapshot visible."""
+        os.makedirs(root, exist_ok=True)
+        path = os.path.join(root, BOOTSTRAP_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump({"base": int(base),
+                       "current_hash": current_hash.hex(),
+                       "previous_hash": previous_hash.hex(),
+                       "commit_hash": commit_hash.hex()}, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
 
     def _segments(self) -> List[int]:
         out = []
@@ -112,9 +158,10 @@ class BlockStore:
 
     def _index_block(self, block: Block, loc: _Loc) -> None:
         num = block.header.number
-        if num != len(self._by_number):
+        if num != self.base + len(self._by_number):
             raise BlockStoreError(
-                f"block {num} out of order (height {len(self._by_number)})")
+                f"block {num} out of order "
+                f"(height {self.base + len(self._by_number)})")
         self._by_number.append(loc)
         h = block_header_hash(block.header)
         self._by_hash[h] = num
@@ -167,17 +214,19 @@ class BlockStore:
         """Drop every block numbered >= new_height (the storage half of
         ledger rollback, blkstorage ResetBlockStore/rollback).  Rewrites
         the retained prefix — an administrative operation, not a hot
-        path."""
+        path.  Cannot descend below a snapshot-bootstrap base (those
+        blocks were never stored)."""
         with self._lock:
-            if new_height < 0 or new_height >= self.height:
+            if new_height < self.base or new_height >= self.height:
                 return
-            blocks = [self.get_by_number(i) for i in range(new_height)]
+            blocks = [self.get_by_number(i)
+                      for i in range(self.base, new_height)]
             self._by_number = []
             self._mem_blocks = []
             self._by_hash = {}
             self._by_txid = {}
-            self._cur_hash = b"\x00" * 32
-            self._prev_hash = b"\x00" * 32
+            self._cur_hash = self._base_cur_hash
+            self._prev_hash = self._base_prev_hash
             self._open_segment_no = 0
             if self.root is not None:
                 for seg in self._segments():
@@ -189,7 +238,7 @@ class BlockStore:
 
     @property
     def height(self) -> int:
-        return len(self._by_number)
+        return self.base + len(self._by_number)
 
     def chain_info(self) -> ChainInfo:
         with self._lock:
@@ -205,9 +254,12 @@ class BlockStore:
 
     def get_by_number(self, number: int) -> Block:
         with self._lock:
-            if not 0 <= number < self.height:
+            if 0 <= number < self.base:
+                raise BlockStoreError(
+                    f"block {number} pruned below snapshot base {self.base}")
+            if not self.base <= number < self.height:
                 raise BlockStoreError(f"no block {number} (height {self.height})")
-            return self._read(self._by_number[number])
+            return self._read(self._by_number[number - self.base])
 
     def get_by_hash(self, block_hash: bytes) -> Block:
         with self._lock:
@@ -239,12 +291,13 @@ class BlockStore:
 
     def iter_blocks(self, start: int = 0,
                     end: Optional[int] = None) -> Iterator[Block]:
-        """Blocks [start, end) — ledger.ResultsIterator over blocks."""
-        n = start
+        """Blocks [start, end) — ledger.ResultsIterator over blocks.
+        Starts at the snapshot base when asked for pruned history."""
+        n = max(start, self.base)
         while end is None or n < end:
             with self._lock:
                 if n >= self.height:
                     return
-                loc = self._by_number[n]
+                loc = self._by_number[n - self.base]
             yield self._read(loc)
             n += 1

@@ -74,6 +74,10 @@ class LedgerConfig:
     root: Optional[str] = None          # None = fully in-memory
     enable_history: bool = True
     snapshot_every: int = 256
+    # key-hash stripe width for the state plane (statedb + historydb):
+    # independently locked + independently flushable shards; 1 = the
+    # flat store (differential oracle)
+    state_shards: int = 8
     # parallel MVCC commit plane (committer/parallel_commit/): wavefront
     # scheduler replaces the serial validate_and_prepare_batch walk —
     # bit-identical output, enforced differentially.  Must be configured
@@ -117,11 +121,14 @@ class KVLedger:
             sdir = os.path.join(base, "state")
             hdir = os.path.join(base, "history")
         self.blockstore = BlockStore(bdir)
-        self.statedb = StateDB(sdir, snapshot_every=self.config.snapshot_every)
-        self.historydb = (HistoryDB(hdir)
+        self.statedb = self._new_statedb(sdir)
+        self.historydb = (self._new_historydb(hdir)
                           if self.config.enable_history else None)
         self._commit_hash = b"\x00" * 32
         self.last_stats = CommitStats()
+        # set by _recover: how much work reopening this ledger cost
+        self.last_recovery: Dict[str, int] = {
+            "replayed_blocks": 0, "start": 0, "height": 0}
         # DeviceValidator.take_prepared when device_validate is wired:
         # (number, flags_bytes, savepoint) -> (final_flags, batch,
         # history) | None
@@ -140,15 +147,34 @@ class KVLedger:
 
     # -- recovery (recovery.go) --------------------------------------------
 
+    def _new_statedb(self, sdir: Optional[str]) -> StateDB:
+        return StateDB(sdir, snapshot_every=self.config.snapshot_every,
+                       n_shards=self.config.state_shards,
+                       channel=self.channel_id)
+
+    def _new_historydb(self, hdir: Optional[str]) -> HistoryDB:
+        return HistoryDB(hdir, n_shards=self.config.state_shards,
+                         checkpoint_every=self.config.snapshot_every,
+                         channel=self.channel_id)
+
     def _recover(self) -> None:
-        """Replay blocks above each derived DB's savepoint."""
+        """Replay blocks above each derived DB's savepoint (bounded to
+        the post-checkpoint tail now that the derived DBs checkpoint)."""
         height = self.blockstore.height
+        base = self.blockstore.base
+        self.last_recovery = {"replayed_blocks": 0, "start": height,
+                              "height": height}
         if height == 0:
             return
-        # restore the commit-hash chain from the last block's metadata
-        last = self.blockstore.get_by_number(height - 1)
-        self._commit_hash = last.metadata.items.get(
-            META_COMMIT_HASH, b"\x00" * 32)
+        # restore the commit-hash chain: from the last block's metadata
+        # when stored, else from the snapshot-bootstrap marker (a freshly
+        # installed snapshot has base == height, no blocks yet)
+        if height - 1 >= base:
+            last = self.blockstore.get_by_number(height - 1)
+            self._commit_hash = last.metadata.items.get(
+                META_COMMIT_HASH, b"\x00" * 32)
+        elif self.blockstore.bootstrap_commit_hash is not None:
+            self._commit_hash = self.blockstore.bootstrap_commit_hash
         # replay from the LOWEST derived-DB savepoint: a crash between the
         # state commit and the history commit leaves history one block
         # behind, and both commits are idempotent via their savepoint guards
@@ -157,11 +183,25 @@ class KVLedger:
             savepoints.append(self.historydb.savepoint)
         lowest = min((-1 if sp is None else sp) for sp in savepoints)
         start = lowest + 1
+        if start < base:
+            # blocks below the snapshot base are pruned; the installed
+            # state checkpoint is the only source for them.  If a derived
+            # DB lost its checkpoint this replay CANNOT reconstruct the
+            # pre-snapshot writes — re-bootstrap from a serving peer.
+            logger.warning(
+                "%s: derived-DB savepoint %d below snapshot base %d — "
+                "pre-snapshot history is pruned; replaying from base",
+                self.channel_id, lowest, base)
+            start = base
+        replayed = 0
         for num in range(start, height):
             block = self.blockstore.get_by_number(num)
             self._apply_derived(block)
+            replayed += 1
             logger.info("%s: recovered block %d into state/history",
                         self.channel_id, num)
+        self.last_recovery = {"replayed_blocks": replayed, "start": start,
+                              "height": height}
 
     def _apply_derived(self, block: Block) -> None:
         """Recovery replay of one stored block (final txflags in metadata)
@@ -269,6 +309,9 @@ class KVLedger:
             envelopes = _safe_envelopes(block)
             batch, history = self._validate_and_prepare(
                 block.header.number, envelopes, flags)
+        # split the batch by shard before the apply takes shard locks
+        # (the parallel-commit / device-validate planes do the same)
+        batch.preshard(getattr(self.statedb, "n_shards", 1))
         stats.state_validation_s = time.perf_counter() - t0
         stats.valid_txs = flags.valid_count()
         # MVCC may have flipped more flags — write the final bitmap back
@@ -327,6 +370,29 @@ class KVLedger:
             raise RuntimeError("history DB disabled")
         return self.historydb.get_history(ns, key)
 
+    def state_status(self) -> dict:
+        """Shard/checkpoint/recovery introspection (the /state ops route)."""
+        out = {
+            "channel": self.channel_id,
+            "height": self.height,
+            "commit_hash": self._commit_hash.hex(),
+            "block_base": self.blockstore.base,
+            "last_recovery": dict(self.last_recovery),
+            "state": self.statedb.status(),
+        }
+        if self.historydb is not None:
+            out["history"] = self.historydb.status()
+        return out
+
+    def snapshot_export(self):
+        """Force a checkpoint of both derived DBs so a consistent
+        (manifest + shard files) set exists on disk for state transfer.
+        -> (state_manifest, history_manifest|None); None when in-memory
+        or before the first block."""
+        sm = self.statedb.checkpoint()
+        hm = self.historydb.checkpoint() if self.historydb is not None else None
+        return sm, hm
+
     # -- admin (reset.go / rollback.go / pause_resume.go / rebuild_dbs.go) --
 
     @property
@@ -377,8 +443,8 @@ class KVLedger:
         for d in (sdir, hdir):
             if d and os.path.isdir(d):
                 shutil.rmtree(d)
-        self.statedb = StateDB(sdir, snapshot_every=self.config.snapshot_every)
+        self.statedb = self._new_statedb(sdir)
         if self.config.enable_history:
-            self.historydb = HistoryDB(hdir)
+            self.historydb = self._new_historydb(hdir)
         self._commit_hash = b"\x00" * 32
         self._recover()

@@ -1,0 +1,275 @@
+"""Snapshot state-transfer: bootstrap a new/wiped peer from a serving
+peer's checkpoint instead of replaying the chain from genesis.
+
+Reference parity: core/ledger/kvledger snapshot generation +
+`peer node join-by-snapshot` — a snapshot is the derived DBs at one
+block height plus enough chain metadata (block hash, commit hash) to
+verify and continue from there.
+
+Protocol (two unary verbs over the existing authenticated comm/rpc
+plane — the transport handshake already restricts callers to channel
+MSPs):
+
+  state.snapshot_meta  {channel} ->
+      {height, base, current_hash, previous_hash, commit_hash,
+       state_manifest, history_manifest, files:[{db,gen,file,sha256,bytes}]}
+  state.snapshot_chunk {channel, db, gen, file, offset} ->
+      {data, eof, size}          (CHUNK_BYTES per call)
+
+The serving peer forces a checkpoint of both derived DBs
+(kvledger.snapshot_export) so a consistent manifest + shard-file set
+exists, then streams the exact on-disk files.  Integrity is end-to-end:
+the manifest carries each shard file's sha256 and the installer refuses
+any assembled file whose hash mismatches — a corrupted/truncated
+transfer is re-fetched, never installed.
+
+Install ordering is the commit protocol: state files → state MANIFEST →
+history files → history MANIFEST → blocks/BOOTSTRAP.json LAST.  The
+bootstrap marker is the commit point — a kill mid-install leaves no
+marker, `needs_bootstrap` stays true, and the next attempt wipes the
+partial install and re-fetches.  After install the peer opens its
+ledger at the snapshot height and the deliver/gossip plane tail-replays
+to tip (blocks below the base read as pruned).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import shutil
+import time
+from typing import Dict, List, Optional, Tuple
+
+from fabric_tpu.ledger import checkpoint as ckpt
+from fabric_tpu.ledger.blkstorage import BOOTSTRAP_FILE, BlockStore
+from fabric_tpu.protocol import block_header_hash
+from fabric_tpu.protocol.types import META_COMMIT_HASH
+
+logger = logging.getLogger("fabric_tpu.ledger.snapshot")
+
+CHUNK_BYTES = 256 * 1024
+META_VERB = "state.snapshot_meta"
+CHUNK_VERB = "state.snapshot_chunk"
+
+
+class SnapshotError(Exception):
+    pass
+
+
+# -- serving side -----------------------------------------------------------
+
+def export_meta(ledger) -> dict:
+    """Force-checkpoint the ledger's derived DBs and describe the
+    resulting snapshot (the state.snapshot_meta handler)."""
+    t0 = time.monotonic()
+    state_manifest, history_manifest = ledger.snapshot_export()
+    if state_manifest is None:
+        raise SnapshotError("nothing to snapshot (empty or in-memory ledger)")
+    savepoint = int(state_manifest["savepoint"])
+    blk = ledger.blockstore.get_by_number(savepoint)
+    files = [{"db": "state", "gen": state_manifest["gen"],
+              "file": ent["file"], "sha256": ent["sha256"],
+              "bytes": ent["bytes"]}
+             for ent in state_manifest["shards"]]
+    if history_manifest is not None:
+        files += [{"db": "history", "gen": history_manifest["gen"],
+                   "file": ent["file"], "sha256": ent["sha256"],
+                   "bytes": ent["bytes"]}
+                  for ent in history_manifest["shards"]]
+    meta = {
+        "channel": ledger.channel_id,
+        "height": savepoint + 1,          # ledger height at the snapshot
+        "current_hash": block_header_hash(blk.header),
+        "previous_hash": blk.header.previous_hash,
+        "commit_hash": blk.metadata.items.get(META_COMMIT_HASH,
+                                              b"\x00" * 32),
+        "state_manifest": state_manifest,
+        "history_manifest": history_manifest,
+        "files": files,
+    }
+    try:
+        from fabric_tpu.ops_plane import tracing
+        tracing.event("state.snapshot_export", channel=ledger.channel_id,
+                      height=savepoint + 1, files=len(files),
+                      seconds=round(time.monotonic() - t0, 6))
+    except Exception:
+        pass
+    return meta
+
+
+def serve_chunk(ledger, db: str, gen: int, file: str, offset: int) -> dict:
+    """One CHUNK_BYTES read of a checkpoint shard file (the
+    state.snapshot_chunk handler)."""
+    if db == "state":
+        droot = ledger.statedb.root
+    elif db == "history":
+        droot = None if ledger.historydb is None else ledger.historydb.root
+    else:
+        raise SnapshotError(f"unknown snapshot db {db!r}")
+    if droot is None:
+        raise SnapshotError(f"{db} store is not durable on this peer")
+    # only shard payload files live in a generation dir; reject anything
+    # that could traverse out of it
+    if (os.path.basename(file) != file or not file.startswith("shard_")
+            or not file.endswith(".bin")):
+        raise SnapshotError(f"invalid snapshot file name {file!r}")
+    path = os.path.join(ckpt.gen_dir(droot, int(gen)), file)
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            f.seek(int(offset))
+            data = f.read(CHUNK_BYTES)
+    except OSError as exc:
+        # the generation may have been GC'd by later checkpoints; the
+        # client re-fetches meta and restarts
+        raise SnapshotError(f"snapshot file gone: {exc}") from None
+    try:
+        from fabric_tpu.ops_plane import registry
+        registry.counter("state_snapshot_chunks_total",
+                         "Snapshot chunks served").add(
+                             1, channel=ledger.channel_id, db=db)
+        registry.counter("state_snapshot_bytes_total",
+                         "Snapshot bytes served").add(
+                             float(len(data)), channel=ledger.channel_id,
+                             db=db)
+    except Exception:
+        pass
+    return {"data": bytes(data), "eof": int(offset) + len(data) >= size,
+            "size": size}
+
+
+# -- receiving side ---------------------------------------------------------
+
+def needs_bootstrap(ledger_root: str, channel_id: str) -> bool:
+    """True when this channel has no blocks AND no installed snapshot —
+    the states in which joining by snapshot is safe (never clobbers an
+    existing chain)."""
+    bdir = os.path.join(ledger_root, channel_id, "blocks")
+    if not os.path.isdir(bdir):
+        return True
+    names = os.listdir(bdir)
+    has_segments = any(n.startswith("blocks_") and n.endswith(".bin")
+                       for n in names)
+    return not has_segments and BOOTSTRAP_FILE not in names
+
+
+def install(ledger_root: str, channel_id: str, meta: dict,
+            payloads: Dict[str, List[bytes]]) -> None:
+    """Install fetched snapshot payloads; BOOTSTRAP.json written last is
+    the commit point.  Any pre-existing partial install is wiped first."""
+    t0 = time.monotonic()
+    base = os.path.join(ledger_root, channel_id)
+    for sub in ("state", "history", "blocks"):
+        shutil.rmtree(os.path.join(base, sub), ignore_errors=True)
+    ckpt.install(os.path.join(base, "state"), meta["state_manifest"],
+                 payloads["state"])
+    if meta.get("history_manifest") is not None and "history" in payloads:
+        ckpt.install(os.path.join(base, "history"),
+                     meta["history_manifest"], payloads["history"])
+    BlockStore.write_bootstrap(
+        os.path.join(base, "blocks"), int(meta["height"]),
+        meta["current_hash"], meta["previous_hash"], meta["commit_hash"])
+    try:
+        from fabric_tpu.ops_plane import tracing
+        tracing.event("state.snapshot_install", channel=channel_id,
+                      height=int(meta["height"]),
+                      seconds=round(time.monotonic() - t0, 6))
+    except Exception:
+        pass
+
+
+class _Fetcher:
+    """One peer's fetch session: short per-chunk timeouts + redial-on-
+    close so seeded transfer faults (drop/delay/dup) cost a retry, not
+    the drill."""
+
+    def __init__(self, addr, signer, msps, chunk_timeout_s: float,
+                 attempts: int):
+        self.addr = addr
+        self.signer = signer
+        self.msps = msps
+        self.chunk_timeout_s = chunk_timeout_s
+        self.attempts = attempts
+        self._conn = None
+
+    def _connection(self):
+        if self._conn is None:
+            from fabric_tpu.comm.rpc import connect
+            self._conn = connect(tuple(self.addr), self.signer, self.msps,
+                                 timeout=self.chunk_timeout_s)
+        return self._conn
+
+    def call(self, method: str, body: dict) -> dict:
+        from fabric_tpu.comm.rpc import RpcError
+        last: Optional[Exception] = None
+        for attempt in range(self.attempts):
+            try:
+                return self._connection().call(
+                    method, body, timeout=self.chunk_timeout_s)
+            except RpcError as exc:      # includes RpcTimeout/RpcClosed
+                last = exc
+                self.close()
+                time.sleep(min(0.05 * (attempt + 1), 0.5))
+        raise SnapshotError(
+            f"{method} failed after {self.attempts} attempts "
+            f"against {self.addr}: {last}")
+
+    def fetch_file(self, channel_id: str, ent: dict) -> bytes:
+        buf = bytearray()
+        while True:
+            resp = self.call(CHUNK_VERB, {
+                "channel": channel_id, "db": ent["db"],
+                "gen": ent["gen"], "file": ent["file"],
+                "offset": len(buf)})
+            buf += resp["data"]
+            if resp["eof"]:
+                return bytes(buf)
+
+    def close(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except Exception:
+                pass
+            self._conn = None
+
+
+def bootstrap_from_peers(ledger_root: str, channel_id: str, peers,
+                         signer, msps, chunk_timeout_s: float = 2.0,
+                         attempts: int = 12) -> dict:
+    """Fetch + verify + install a snapshot from the first peer that can
+    serve one.  -> {"height", "from", "files", "bytes", "seconds"}."""
+    t0 = time.monotonic()
+    last: Optional[Exception] = None
+    for addr in peers:
+        fetcher = _Fetcher(addr, signer, msps, chunk_timeout_s, attempts)
+        try:
+            meta = fetcher.call(META_VERB, {"channel": channel_id})
+            payloads: Dict[str, List[bytes]] = {"state": [], "history": []}
+            total = 0
+            for ent in meta["files"]:
+                data = fetcher.fetch_file(channel_id, ent)
+                import hashlib
+                if hashlib.sha256(data).hexdigest() != ent["sha256"]:
+                    raise SnapshotError(
+                        f"hash mismatch for {ent['db']}/{ent['file']} "
+                        f"from {addr}")
+                payloads[ent["db"]].append(data)
+                total += len(data)
+            install(ledger_root, channel_id, meta, payloads)
+            seconds = time.monotonic() - t0
+            logger.info(
+                "[%s] snapshot installed from %s: height=%d files=%d "
+                "bytes=%d in %.2fs", channel_id, addr, int(meta["height"]),
+                len(meta["files"]), total, seconds)
+            return {"height": int(meta["height"]), "from": list(addr),
+                    "files": len(meta["files"]), "bytes": total,
+                    "seconds": seconds}
+        except Exception as exc:
+            last = exc
+            logger.warning("[%s] snapshot fetch from %s failed: %s",
+                           channel_id, addr, exc)
+        finally:
+            fetcher.close()
+    raise SnapshotError(
+        f"no peer could serve a snapshot for {channel_id!r}: {last}")

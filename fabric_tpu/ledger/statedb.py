@@ -1,34 +1,78 @@
-"""Versioned key-value state database with savepoint + crash recovery.
+"""Versioned key-value state database: key-hash sharded, savepoint +
+crash-consistent checkpoint recovery.
 
 Reference parity: core/ledger/kvledger/txmgmt/statedb/statedb.go interface
 and the stateleveldb implementation — versioned values (value, Height),
 update batches applied atomically with a savepoint, ordered range scans.
 
-Durability model: an append-only WAL of update batches (one record per
-block) plus periodic full snapshots for compaction.  On open: load the
-newest snapshot, replay WAL records past it, truncate any torn tail.
-Savepoint = block number of the last applied batch; the kvledger recovery
-path replays blocks above the savepoint from the block store
-(core/ledger/kvledger/recovery.go semantics).
+Layout: keys stripe across ``n_shards`` independently-locked shards by a
+deterministic hash of (namespace, key) — `shard_of`.  Batched applies
+land shard-parallel (the parallel-commit and device-validate planes
+pre-split their prepared batches with `UpdateBatch.preshard`, so the
+split cost is off the commit lock path), while point reads take only the
+owning shard's lock.
+
+Durability model: ONE append-only WAL of update batches (a single fsync
+per block keeps the savepoint atomic ACROSS shards — per-shard WALs
+could tear a block between shards on crash), plus periodic sharded
+checkpoints for compaction: every shard flushed to its own
+content-hashed file and an atomically-renamed manifest recording
+(generation, savepoint, per-shard sha256) — see ledger/checkpoint.py for
+the kill-at-any-instant story.  On open: load the newest verifiable
+manifest (falling back MANIFEST → MANIFEST.prev → legacy state.snapshot
+→ empty), replay WAL records past its savepoint, truncate any torn
+tail.  Savepoint = block number of the last applied batch; the kvledger
+recovery path replays blocks above the savepoint from the block store
+(core/ledger/kvledger/recovery.go semantics), so losing a checkpoint
+never loses data — only recovery time.
 
 Keys are (namespace, key) pairs, ordered lexicographically for range
-scans (leveldb iterator parity).
+scans (leveldb iterator parity); cross-shard scans are heap-merged back
+into one ordered stream, bit-identical to the flat store's iteration
+order.
+
+Consistency note: `get` synchronizes only on the owning shard, so a
+reader racing a multi-shard apply may observe a block partially applied
+across shards (never within one).  Commit-path correctness does not
+ride on this — MVCC re-validates reads at commit, same as the
+reference's leveldb store, and the global lock covers scans/queries.
 """
 
 from __future__ import annotations
 
 import bisect
+import heapq
 import os
 import struct
 import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Tuple
 
+from fabric_tpu.ledger import checkpoint as ckpt
 from fabric_tpu.protocol import Version
 from fabric_tpu.utils import serde
 
 _LEN = struct.Struct("<Q")
-SNAPSHOT_EVERY = 256  # batches between snapshot compactions
+SNAPSHOT_EVERY = 256  # batches between checkpoint compactions
+N_SHARDS = 8          # default key-hash stripe width
+
+_FNV_OFFSET = 0xCBF29CE484222325
+_FNV_PRIME = 0x100000001B3
+_MASK64 = 0xFFFFFFFFFFFFFFFF
+
+
+def shard_of(ns: str, key: str, n_shards: int) -> int:
+    """Deterministic shard for a (namespace, key): FNV-1a 64 over the
+    NUL-joined pair.  Stable across processes/restarts — checkpoints,
+    prepared batches, and snapshot transfers all agree on placement."""
+    if n_shards <= 1:
+        return 0
+    h = _FNV_OFFSET
+    for b in (ns + "\x00" + key).encode("utf-8"):
+        h = ((h ^ b) * _FNV_PRIME) & _MASK64
+    return h % n_shards
 
 
 @dataclass(frozen=True)
@@ -38,17 +82,24 @@ class VersionedValue:
 
 
 class UpdateBatch:
-    """statedb.UpdateBatch: puts/deletes staged by MVCC validation."""
+    """statedb.UpdateBatch: puts/deletes staged by MVCC validation.
+
+    `preshard` / `items_by_shard` cache the per-shard split so the
+    parallel-commit scheduler and the device-validate rebuild can pay
+    the hash cost outside the store's apply lock."""
 
     def __init__(self):
         self._updates: Dict[Tuple[str, str], Optional[VersionedValue]] = {}
+        self._by_shard = None  # (n_shards, per-shard item lists)
 
     def put(self, ns: str, key: str, value: bytes, version: Version) -> None:
         self._updates[(ns, key)] = VersionedValue(value, version)
+        self._by_shard = None
 
     def delete(self, ns: str, key: str, version: Version) -> None:
         # deletes still carry the deleting tx's version (stateleveldb tombstone)
         self._updates[(ns, key)] = None
+        self._by_shard = None
 
     def get(self, ns: str, key: str):
         """(found, vv) — distinguishes absent from staged-delete."""
@@ -60,6 +111,26 @@ class UpdateBatch:
 
     def __len__(self):
         return len(self._updates)
+
+    def items_by_shard(self, n_shards: int) -> List[list]:
+        cached = self._by_shard
+        if cached is not None and cached[0] == n_shards:
+            return cached[1]
+        lists: List[list] = [[] for _ in range(n_shards)]
+        if n_shards <= 1:
+            lists[0] = list(self._updates.items())
+        else:
+            for item in self._updates.items():
+                ns, key = item[0]
+                lists[shard_of(ns, key, n_shards)].append(item)
+        self._by_shard = (n_shards, lists)
+        return lists
+
+    def preshard(self, n_shards: int) -> "UpdateBatch":
+        """Warm the per-shard split (idempotent; invalidated by put/delete)."""
+        if n_shards > 1:
+            self.items_by_shard(n_shards)
+        return self
 
 
 def _doc_of(value) -> Optional[dict]:
@@ -164,24 +235,44 @@ class _FieldIndex:
         return [k for _, k in self.sorted[i:j]]
 
 
+class _StateShard:
+    """One stripe: its own lock, key map, ordered key list, and slice of
+    every registered field index."""
+
+    __slots__ = ("lock", "data", "sorted_keys", "indexes")
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.data: Dict[Tuple[str, str], VersionedValue] = {}
+        self.sorted_keys: List[Tuple[str, str]] = []
+        self.indexes: Dict[Tuple[str, str], _FieldIndex] = {}
+
+
 class StateDB:
     """Versioned state store (VersionedDB iface, statedb.go)."""
 
     def __init__(self, root: Optional[str] = None,
-                 snapshot_every: int = SNAPSHOT_EVERY):
+                 snapshot_every: int = SNAPSHOT_EVERY,
+                 n_shards: int = N_SHARDS,
+                 channel: str = ""):
         self.root = root
         self.snapshot_every = snapshot_every
+        self.n_shards = max(1, int(n_shards))
+        self.channel = channel  # metric label only; "" = unlabeled/quiet
         self._lock = threading.RLock()
-        self._data: Dict[Tuple[str, str], VersionedValue] = {}
-        self._sorted_keys: List[Tuple[str, str]] = []
+        self._shards = [_StateShard() for _ in range(self.n_shards)]
         self._savepoint: Optional[int] = None
-        self._batches_since_snapshot = 0
-        # field indexes: (ns, field) -> _FieldIndex, maintained at every
-        # apply_updates (the statecouchdb index slot — reference indexes
-        # ship in chaincode META-INF/statedb/couchdb/indexes and are
-        # created at deploy; here create_index is called at chaincode
-        # install, node/peer.py)
-        self._indexes: Dict[Tuple[str, str], _FieldIndex] = {}
+        self._batches_since_ckpt = 0
+        self._ckpt_gen = 0
+        # registered (ns, field) pairs; each shard holds its own
+        # _FieldIndex slice (the statecouchdb index slot — reference
+        # indexes ship in chaincode META-INF/statedb/couchdb/indexes and
+        # are created at deploy; here create_index is called at
+        # chaincode install, node/peer.py)
+        self._index_fields: set = set()
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self.last_recovery = {"source": "fresh", "wal_blocks": 0,
+                              "savepoint": None}
         if root is not None:
             os.makedirs(root, exist_ok=True)
             self._recover()
@@ -189,8 +280,9 @@ class StateDB:
     # -- reads --------------------------------------------------------------
 
     def get(self, ns: str, key: str) -> Optional[VersionedValue]:
-        with self._lock:
-            return self._data.get((ns, key))
+        sh = self._shards[shard_of(ns, key, self.n_shards)]
+        with sh.lock:
+            return sh.data.get((ns, key))
 
     def get_version(self, ns: str, key: str) -> Optional[Version]:
         vv = self.get(ns, key)
@@ -199,17 +291,27 @@ class StateDB:
     def range_scan(self, ns: str, start_key: str, end_key: str,
                    limit: int = 0) -> Iterator[Tuple[str, VersionedValue]]:
         """Ordered scan over [start_key, end_key) within a namespace;
-        empty end_key = scan to namespace end (stateleveldb iterator)."""
+        empty end_key = scan to namespace end (stateleveldb iterator).
+        Per-shard ordered slices are heap-merged — identical order to
+        the flat store (keys are globally unique, so the merge never
+        compares VersionedValues)."""
         with self._lock:
-            lo = bisect.bisect_left(self._sorted_keys, (ns, start_key))
-            out = []
-            for i in range(lo, len(self._sorted_keys)):
-                kns, key = self._sorted_keys[i]
-                if kns != ns or (end_key and key >= end_key):
-                    break
-                out.append((key, self._data[(kns, key)]))
-                if limit and len(out) >= limit:
-                    break
+            slices = []
+            for sh in self._shards:
+                part = []
+                lo = bisect.bisect_left(sh.sorted_keys, (ns, start_key))
+                for i in range(lo, len(sh.sorted_keys)):
+                    kns, key = sh.sorted_keys[i]
+                    if kns != ns or (end_key and key >= end_key):
+                        break
+                    part.append((key, sh.data[(kns, key)]))
+                    if limit and len(part) >= limit:
+                        break
+                if part:
+                    slices.append(part)
+            out = list(heapq.merge(*slices))
+            if limit:
+                out = out[:limit]
         return iter(out)
 
     # -- field indexes + rich queries ---------------------------------------
@@ -219,21 +321,30 @@ class StateDB:
         namespace.  Idempotent — peers re-register at startup and the
         index rebuilds from the recovered state."""
         with self._lock:
-            idx_key = (ns, field)
-            idx = _FieldIndex()
-            self._indexes[idx_key] = idx
-            lo = bisect.bisect_left(self._sorted_keys, (ns, ""))
-            for i in range(lo, len(self._sorted_keys)):
-                kns, key = self._sorted_keys[i]
-                if kns != ns:
-                    break
-                doc = _doc_of(self._data[(kns, key)].value)
-                if doc is not None:
-                    idx.put(key, doc.get(field))
+            self._index_fields.add((ns, field))
+            for sh in self._shards:
+                idx = _FieldIndex()
+                sh.indexes[(ns, field)] = idx
+                lo = bisect.bisect_left(sh.sorted_keys, (ns, ""))
+                for i in range(lo, len(sh.sorted_keys)):
+                    kns, key = sh.sorted_keys[i]
+                    if kns != ns:
+                        break
+                    doc = _doc_of(sh.data[(kns, key)].value)
+                    if doc is not None:
+                        idx.put(key, doc.get(field))
 
     def indexes_for(self, ns: str) -> List[str]:
         with self._lock:
-            return [f for (n, f) in self._indexes if n == ns]
+            return [f for (n, f) in self._index_fields if n == ns]
+
+    def _gather_candidates(self, ns: str, field: str, lo, hi) -> List[str]:
+        out: List[str] = []
+        for sh in self._shards:
+            idx = sh.indexes.get((ns, field))
+            if idx is not None:
+                out.extend(idx.candidates(lo, hi))
+        return out
 
     def _index_candidates(self, ns: str, selector: dict):
         """Planner: if some top-level selector field is indexed with an
@@ -248,14 +359,13 @@ class StateDB:
         for field_name, cond in selector.items():
             if field_name.startswith("$"):
                 continue
-            idx = self._indexes.get((ns, field_name))
-            if idx is None:
+            if (ns, field_name) not in self._index_fields:
                 continue
             if not isinstance(cond, dict):
                 sk = _index_sort_key(cond)
                 if sk is None:
                     continue
-                return idx.candidates(sk, sk)
+                return self._gather_candidates(ns, field_name, sk, sk)
             lo = hi = None
             usable = False
             bad = False
@@ -283,13 +393,15 @@ class StateDB:
                         out = []
                         for w in want:
                             sw = _index_sort_key(w)
-                            out.extend(idx.candidates(sw, sw))
+                            out.extend(
+                                self._gather_candidates(ns, field_name,
+                                                        sw, sw))
                         return sorted(set(out))
             if bad or not usable:
                 continue
             # inclusive float bounds: candidate superset, exact
             # re-check downstream (strictness enforced by the matcher)
-            return idx.candidates(lo, hi)
+            return self._gather_candidates(ns, field_name, lo, hi)
         return None
 
     def execute_query(self, ns: str, selector: dict, limit: int = 0,
@@ -332,17 +444,26 @@ class StateDB:
         with self._lock:
             cand = self._index_candidates(ns, selector)
             if cand is None:
-                lo = bisect.bisect_left(self._sorted_keys, (ns, ""))
-                keys = []
-                for i in range(lo, len(self._sorted_keys)):
-                    kns, key = self._sorted_keys[i]
-                    if kns != ns:
-                        break
-                    keys.append(key)
+                per_shard = []
+                for sh in self._shards:
+                    part = []
+                    lo = bisect.bisect_left(sh.sorted_keys, (ns, ""))
+                    for i in range(lo, len(sh.sorted_keys)):
+                        kns, key = sh.sorted_keys[i]
+                        if kns != ns:
+                            break
+                        part.append(key)
+                    if part:
+                        per_shard.append(part)
+                keys = list(heapq.merge(*per_shard))
             else:
                 keys = sorted(cand)
-            pairs = [(k, self._data.get((ns, k))) for k in keys
-                     if k > bookmark]
+            pairs = []
+            for k in keys:
+                if k <= bookmark:
+                    continue
+                sh = self._shards[shard_of(ns, k, self.n_shards)]
+                pairs.append((k, sh.data.get((ns, k))))
         out = []
         for key, vv in pairs:
             if vv is None:
@@ -361,13 +482,39 @@ class StateDB:
             return self._savepoint
 
     def __len__(self):
-        return len(self._data)
+        return sum(len(sh.data) for sh in self._shards)
+
+    @property
+    def _data(self) -> Dict[Tuple[str, str], VersionedValue]:
+        """Merged read-only view of every shard (flat-store compat for
+        tests/tooling; the shards are the real storage)."""
+        merged: Dict[Tuple[str, str], VersionedValue] = {}
+        for sh in self._shards:
+            merged.update(sh.data)
+        return merged
+
+    def shard_sizes(self) -> List[int]:
+        return [len(sh.data) for sh in self._shards]
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "n_shards": self.n_shards,
+                "savepoint": self._savepoint,
+                "keys": sum(len(sh.data) for sh in self._shards),
+                "shard_keys": [len(sh.data) for sh in self._shards],
+                "checkpoint_gen": self._ckpt_gen,
+                "batches_since_checkpoint": self._batches_since_ckpt,
+                "last_recovery": dict(self.last_recovery),
+            }
 
     # -- writes -------------------------------------------------------------
 
     def apply_updates(self, batch: UpdateBatch, block_num: int) -> None:
         """Atomically apply one block's updates + advance the savepoint
-        (statedb ApplyUpdates with sp)."""
+        (statedb ApplyUpdates with sp).  One WAL record + fsync covers
+        every shard; the in-memory apply fans out shard-parallel for
+        large batches."""
         with self._lock:
             if self._savepoint is not None and block_num <= self._savepoint:
                 raise ValueError(
@@ -376,43 +523,70 @@ class StateDB:
                 self._wal_append(batch, block_num)
             self._apply_in_memory(batch, block_num)
             if self.root is not None:
-                self._batches_since_snapshot += 1
-                if self._batches_since_snapshot >= self.snapshot_every:
-                    self._write_snapshot()
+                self._batches_since_ckpt += 1
+                if self._batches_since_ckpt >= self.snapshot_every:
+                    self._checkpoint_locked()
+        self._observe_shards()
 
     # below this many updates the per-key bisect path wins; above it the
-    # coalesced one-pass merge of _sorted_keys is O(N + B log B) instead
+    # coalesced one-pass merge of sorted_keys is O(N + B log B) instead
     # of O(B * N) list insert/pop churn
     _BATCH_APPLY_MIN = 64
+    # below this many TOTAL updates (or with only one busy shard) the
+    # thread fan-out costs more than it buys
+    _PARALLEL_APPLY_MIN = 512
+    # on a single-core host the fan-out is pure GIL thrash — the serial
+    # per-shard loop (still sharded: smaller sorted-key merges) wins
+    _HOST_CORES = os.cpu_count() or 1
 
     def _apply_in_memory(self, batch: UpdateBatch, block_num: int) -> None:
-        if len(batch) >= self._BATCH_APPLY_MIN:
-            self._apply_batched(batch)
+        per_shard = batch.items_by_shard(self.n_shards)
+        busy = [i for i, items in enumerate(per_shard) if items]
+        if (self._HOST_CORES > 1 and len(busy) > 1
+                and len(batch) >= self._PARALLEL_APPLY_MIN):
+            pool = self._get_pool()
+            futs = [pool.submit(self._apply_shard, self._shards[i],
+                                per_shard[i])
+                    for i in busy]
+            for f in futs:
+                f.result()
         else:
-            self._apply_per_key(batch)
+            for i in busy:
+                self._apply_shard(self._shards[i], per_shard[i])
         self._savepoint = block_num
 
-    def _apply_per_key(self, batch: UpdateBatch) -> None:
-        ns_indexed = {n for (n, _f) in self._indexes}
-        for (ns, key), vv in batch.items():
-            k = (ns, key)
+    @classmethod
+    def _apply_shard(cls, shard: _StateShard, items: list) -> None:
+        with shard.lock:
+            if len(items) >= cls._BATCH_APPLY_MIN:
+                cls._apply_shard_batched(shard, items)
+            else:
+                cls._apply_shard_per_key(shard, items)
+
+    @staticmethod
+    def _apply_shard_per_key(shard: _StateShard, items: list) -> None:
+        ns_indexed = {n for (n, _f) in shard.indexes}
+        data = shard.data
+        sorted_keys = shard.sorted_keys
+        for k, vv in items:
+            ns, key = k
             if vv is None:
-                if k in self._data:
-                    del self._data[k]
-                    i = bisect.bisect_left(self._sorted_keys, k)
-                    if i < len(self._sorted_keys) and self._sorted_keys[i] == k:
-                        self._sorted_keys.pop(i)
+                if k in data:
+                    del data[k]
+                    i = bisect.bisect_left(sorted_keys, k)
+                    if i < len(sorted_keys) and sorted_keys[i] == k:
+                        sorted_keys.pop(i)
                 if ns in ns_indexed:
-                    for (n, f), idx in self._indexes.items():
+                    for (n, f), idx in shard.indexes.items():
                         if n == ns:
                             idx.remove(key)
             else:
-                if k not in self._data:
-                    bisect.insort(self._sorted_keys, k)
-                self._data[k] = vv
+                if k not in data:
+                    bisect.insort(sorted_keys, k)
+                data[k] = vv
                 if ns in ns_indexed:
                     doc = _doc_of(vv.value)
-                    for (n, f), idx in self._indexes.items():
+                    for (n, f), idx in shard.indexes.items():
                         if n != ns:
                             continue
                         if doc is None:
@@ -420,22 +594,23 @@ class StateDB:
                         else:
                             idx.put(key, doc.get(f))
 
-    def _apply_batched(self, batch: UpdateBatch) -> None:
-        """One coalesced pass: mutate _data/_FieldIndexes per key, then
-        rebuild _sorted_keys with a single merge of the surviving keys
+    @staticmethod
+    def _apply_shard_batched(shard: _StateShard, items: list) -> None:
+        """One coalesced pass: mutate data/_FieldIndexes per key, then
+        rebuild sorted_keys with a single merge of the surviving keys
         and the sorted set of newly-added ones."""
-        ns_indexed = {n for (n, _f) in self._indexes}
+        ns_indexed = {n for (n, _f) in shard.indexes}
         removed = set()
         added = set()
-        data = self._data
-        for k, vv in batch.items():
+        data = shard.data
+        for k, vv in items:
             ns, key = k
             if vv is None:
                 if k in data:
                     del data[k]
                     removed.add(k)
                 if ns in ns_indexed:
-                    for (n, f), idx in self._indexes.items():
+                    for (n, f), idx in shard.indexes.items():
                         if n == ns:
                             idx.remove(key)
             else:
@@ -444,7 +619,7 @@ class StateDB:
                 data[k] = vv
                 if ns in ns_indexed:
                     doc = _doc_of(vv.value)
-                    for (n, f), idx in self._indexes.items():
+                    for (n, f), idx in shard.indexes.items():
                         if n != ns:
                             continue
                         if doc is None:
@@ -458,7 +633,7 @@ class StateDB:
         append = merged.append
         i = 0
         n_new = len(new_keys)
-        for k in self._sorted_keys:
+        for k in shard.sorted_keys:
             if k in removed:
                 continue
             while i < n_new and new_keys[i] < k:
@@ -466,7 +641,14 @@ class StateDB:
                 i += 1
             append(k)
         merged.extend(new_keys[i:])
-        self._sorted_keys = merged
+        shard.sorted_keys = merged
+
+    def _get_pool(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            workers = min(self.n_shards, max(2, os.cpu_count() or 2))
+            self._pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="statedb-apply")
+        return self._pool
 
     # -- persistence --------------------------------------------------------
 
@@ -474,6 +656,7 @@ class StateDB:
         return os.path.join(self.root, "state.wal")
 
     def _snap_path(self) -> str:
+        # legacy (pre-sharding) single-file snapshot; read-only fallback
         return os.path.join(self.root, "state.snapshot")
 
     @staticmethod
@@ -493,58 +676,162 @@ class StateDB:
             f.flush()
             os.fsync(f.fileno())
 
-    def _write_snapshot(self) -> None:
-        recs = []
-        for (ns, key) in self._sorted_keys:
-            vv = self._data[(ns, key)]
-            recs.append({"ns": ns, "key": key, "value": vv.value,
-                         "version": vv.version.to_list()})
-        payload = serde.encode({"savepoint": self._savepoint, "data": recs})
-        tmp = self._snap_path() + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(payload)
-            f.flush()
-            os.fsync(f.fileno())
-        os.replace(tmp, self._snap_path())
+    def checkpoint(self) -> Optional[dict]:
+        """Flush every shard + flip the manifest; returns the manifest
+        (reusing the current one when nothing changed since the last
+        checkpoint).  None for in-memory stores or before any block."""
+        with self._lock:
+            if self.root is None or self._savepoint is None:
+                return None
+            if self._batches_since_ckpt == 0:
+                m = ckpt.read_manifest(self.root)
+                if m is not None and m.get("savepoint") == self._savepoint:
+                    return m
+            return self._checkpoint_locked()
+
+    def _checkpoint_locked(self) -> dict:
+        t0 = time.monotonic()
+        gen = self._ckpt_gen + 1
+        payloads = []
+        for i, sh in enumerate(self._shards):
+            recs = []
+            for k in sh.sorted_keys:
+                vv = sh.data[k]
+                recs.append({"ns": k[0], "key": k[1], "value": vv.value,
+                             "version": vv.version.to_list()})
+            payloads.append(serde.encode(
+                {"savepoint": self._savepoint, "shard": i,
+                 "n_shards": self.n_shards, "data": recs}))
+        manifest = ckpt.write_checkpoint(
+            self.root, gen, payloads,
+            meta={"savepoint": self._savepoint, "kind": "state"})
+        # WAL content is now ≤ the manifest savepoint: safe to drop.  A
+        # crash before this truncate only re-skips records on recovery.
         with open(self._wal_path(), "wb") as f:
             f.truncate(0)
-        self._batches_since_snapshot = 0
+        try:
+            os.remove(self._snap_path())   # retire any legacy snapshot
+        except OSError:
+            pass
+        ckpt.gc_generations(self.root, {gen, gen - 1})
+        self._ckpt_gen = gen
+        self._batches_since_ckpt = 0
+        self._observe_checkpoint(time.monotonic() - t0, gen)
+        return manifest
 
     def _recover(self) -> None:
-        if os.path.exists(self._snap_path()):
+        source = "empty"
+        manifest, payloads, src = ckpt.recover(self.root)
+        if manifest is not None and manifest.get("kind", "state") == "state":
+            self._load_checkpoint_payloads(payloads)
+            self._savepoint = manifest.get("savepoint")
+            self._ckpt_gen = int(manifest["gen"])
+            source = src
+        elif os.path.exists(self._snap_path()):
             with open(self._snap_path(), "rb") as f:
                 snap = serde.decode(f.read())
             self._savepoint = snap["savepoint"]
             for rec in snap["data"]:
-                k = (rec["ns"], rec["key"])
-                self._data[k] = VersionedValue(
+                sh = self._shards[shard_of(rec["ns"], rec["key"],
+                                           self.n_shards)]
+                sh.data[(rec["ns"], rec["key"])] = VersionedValue(
                     rec["value"], Version.from_list(rec["version"]))
-            self._sorted_keys = sorted(self._data.keys())
-        if not os.path.exists(self._wal_path()):
+            for sh in self._shards:
+                sh.sorted_keys = sorted(sh.data.keys())
+            source = "legacy_snapshot"
+        wal_blocks = 0
+        if os.path.exists(self._wal_path()):
+            with open(self._wal_path(), "rb") as f:
+                data = f.read()
+            off, good_end = 0, 0
+            while off + _LEN.size <= len(data):
+                (n,) = _LEN.unpack_from(data, off)
+                if off + _LEN.size + n > len(data):
+                    break
+                try:
+                    rec = serde.decode(
+                        data[off + _LEN.size:off + _LEN.size + n])
+                except ValueError:
+                    break
+                off += _LEN.size + n
+                good_end = off
+                if (self._savepoint is not None
+                        and rec["block"] <= self._savepoint):
+                    continue  # already in checkpoint
+                batch = UpdateBatch()
+                for u in rec["updates"]:
+                    if u["value"] is None:
+                        batch.delete(u["ns"], u["key"],
+                                     Version(rec["block"], 0))
+                    else:
+                        batch.put(u["ns"], u["key"], u["value"],
+                                  Version.from_list(u["version"]))
+                self._apply_in_memory(batch, rec["block"])
+                wal_blocks += 1
+            if good_end != len(data):
+                with open(self._wal_path(), "r+b") as f:
+                    f.truncate(good_end)
+        self.last_recovery = {"source": source, "wal_blocks": wal_blocks,
+                              "savepoint": self._savepoint}
+
+    def _load_checkpoint_payloads(self, payloads: List[bytes]) -> None:
+        decoded = [serde.decode(p) for p in payloads]
+        direct = (len(decoded) == self.n_shards
+                  and all(d.get("n_shards") == self.n_shards
+                          and d.get("shard") == i
+                          for i, d in enumerate(decoded)))
+        if direct:
+            for sh, d in zip(self._shards, decoded):
+                for rec in d["data"]:
+                    sh.data[(rec["ns"], rec["key"])] = VersionedValue(
+                        rec["value"], Version.from_list(rec["version"]))
+        else:
+            # shard count changed since the checkpoint: re-stripe
+            for d in decoded:
+                for rec in d["data"]:
+                    sh = self._shards[shard_of(rec["ns"], rec["key"],
+                                               self.n_shards)]
+                    sh.data[(rec["ns"], rec["key"])] = VersionedValue(
+                        rec["value"], Version.from_list(rec["version"]))
+        for sh in self._shards:
+            sh.sorted_keys = sorted(sh.data.keys())
+
+    # -- observability ------------------------------------------------------
+
+    def _observe_shards(self) -> None:
+        if not self.channel:
             return
-        with open(self._wal_path(), "rb") as f:
-            data = f.read()
-        off, good_end = 0, 0
-        while off + _LEN.size <= len(data):
-            (n,) = _LEN.unpack_from(data, off)
-            if off + _LEN.size + n > len(data):
-                break
-            try:
-                rec = serde.decode(data[off + _LEN.size:off + _LEN.size + n])
-            except ValueError:
-                break
-            off += _LEN.size + n
-            good_end = off
-            if self._savepoint is not None and rec["block"] <= self._savepoint:
-                continue  # already in snapshot
-            batch = UpdateBatch()
-            for u in rec["updates"]:
-                if u["value"] is None:
-                    batch.delete(u["ns"], u["key"], Version(rec["block"], 0))
-                else:
-                    batch.put(u["ns"], u["key"], u["value"],
-                              Version.from_list(u["version"]))
-            self._apply_in_memory(batch, rec["block"])
-        if good_end != len(data):
-            with open(self._wal_path(), "r+b") as f:
-                f.truncate(good_end)
+        try:
+            from fabric_tpu.ops_plane.metrics import registry
+            g = registry.gauge("state_shard_keys",
+                               "Keys resident per state shard")
+            for i, sh in enumerate(self._shards):
+                g.set(float(len(sh.data)), channel=self.channel,
+                      shard=str(i))
+        except Exception:
+            pass
+
+    def _observe_checkpoint(self, seconds: float, gen: int) -> None:
+        try:
+            from fabric_tpu.ops_plane import tracing
+            tracing.event("state.checkpoint", channel=self.channel,
+                          gen=gen, savepoint=self._savepoint,
+                          seconds=round(seconds, 6))
+        except Exception:
+            pass
+        if not self.channel:
+            return
+        try:
+            from fabric_tpu.ops_plane.metrics import registry
+            registry.counter("state_checkpoint_total",
+                             "State checkpoints written").add(
+                                 1, channel=self.channel)
+            registry.gauge("state_checkpoint_height",
+                           "Savepoint of the newest state checkpoint").set(
+                               float(self._savepoint or 0),
+                               channel=self.channel)
+            registry.histogram("state_checkpoint_seconds",
+                               "Wall time per state checkpoint").observe(
+                                   seconds, channel=self.channel)
+        except Exception:
+            pass

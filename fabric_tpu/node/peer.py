@@ -273,9 +273,21 @@ class PeerChannel:
         # but keep it uniform as an operational convention).
         dv_cfg = dict(node.cfg.get("device_validate", {}))
         dv_on = bool(dv_cfg.get("enabled", False))
+        # sharded state plane knobs: `state: {shards, checkpoint_every}`
+        st_cfg = dict(node.cfg.get("state", {}))
+        ledger_root = f"{ch_dir}/ledger"
+        # join-by-snapshot: `bootstrap_snapshot: {enabled, from:[[host,
+        # port],...]}` — only attempted when this channel has no chain
+        # yet; failure falls back to genesis replay via deliver
+        snap_cfg = dict(node.cfg.get("bootstrap_snapshot", {}))
+        if snap_cfg.get("enabled"):
+            self._bootstrap_from_snapshot(ledger_root, snap_cfg)
         self.ledger = KVLedger(
             self.channel_id,
-            LedgerConfig(root=f"{ch_dir}/ledger",
+            LedgerConfig(root=ledger_root,
+                         state_shards=int(st_cfg.get("shards", 8)),
+                         snapshot_every=int(
+                             st_cfg.get("checkpoint_every", 256)),
                          parallel_commit=bool(pc_cfg.get("enabled", False)),
                          commit_workers=int(pc_cfg.get("max_workers", 4)),
                          commit_adaptive=bool(pc_cfg.get("adaptive", True)),
@@ -400,6 +412,37 @@ class PeerChannel:
         self.deliver_healthy = True
         self._thread = threading.Thread(target=self._deliver_loop,
                                         daemon=True)
+
+    # -- snapshot bootstrap ---------------------------------------------
+
+    def _bootstrap_from_snapshot(self, ledger_root: str,
+                                 snap_cfg: dict) -> None:
+        """Join-by-snapshot (the reference's `peer node
+        join-by-snapshot`): when this channel has no chain yet, fetch +
+        install a snapshot from a serving peer so recovery opens at the
+        snapshot height and deliver only tail-replays to tip.  Never
+        fatal — failure falls back to genesis replay."""
+        from fabric_tpu.ledger import snapshot as snapmod
+        try:
+            if not snapmod.needs_bootstrap(ledger_root, self.channel_id):
+                return
+            sources = [tuple(a[:2]) for a in snap_cfg.get("from", [])]
+            if not sources:
+                sources = [tuple(p[:2]) for p in self.node.peers]
+            if not sources:
+                logger.warning("[%s] bootstrap_snapshot enabled but no "
+                               "serving peers configured", self.channel_id)
+                return
+            info = snapmod.bootstrap_from_peers(
+                ledger_root, self.channel_id, sources, self.node.signer,
+                self.msps,
+                chunk_timeout_s=float(snap_cfg.get("chunk_timeout_s", 2.0)),
+                attempts=int(snap_cfg.get("attempts", 12)))
+            logger.info("[%s] joined by snapshot: %s", self.channel_id,
+                        info)
+        except Exception:
+            logger.exception("[%s] snapshot bootstrap failed; falling "
+                             "back to genesis replay", self.channel_id)
 
     # -- privdata client side -------------------------------------------
 
@@ -682,6 +725,11 @@ class PeerNode:
         self.rpc.serve("lifecycle.installed", self._rpc_cc_installed)
         self.rpc.serve("privdata.fetch", self._rpc_privdata_fetch)
         self.rpc.serve_cast("privdata.push", self._rpc_privdata_push)
+        # snapshot state-transfer (ledger/snapshot.py): meta + chunked
+        # shard-file reads; the transport handshake already restricts
+        # callers to channel MSP identities
+        self.rpc.serve("state.snapshot_meta", self._rpc_snapshot_meta)
+        self.rpc.serve("state.snapshot_chunk", self._rpc_snapshot_chunk)
 
         # gateway: the batched client front door (needs orderers to
         # broadcast to; a peer with no orderer list serves peers only)
@@ -733,6 +781,9 @@ class PeerNode:
             # production — the plan only exists during chaos drills)
             from fabric_tpu.comm import faults as _faults
             _faults.register_routes(self.ops)
+            # GET /state: per-channel shard sizes, checkpoint generation/
+            # savepoint, and how much the last reopen had to replay
+            self.ops.register_route("GET", "/state", self._state_route)
             # GET /gateway: front-door queue + breaker snapshot (the
             # gateway shares the peer process and ops surface)
             if self.gateway is not None:
@@ -1014,6 +1065,24 @@ class PeerNode:
                 "channels": sorted(self.channels),
                 "height": ch.ledger.height,
                 "commit_hash": (ch.ledger.commit_hash or b"").hex()}
+
+    def _rpc_snapshot_meta(self, body: dict, peer_identity) -> dict:
+        """Serve a snapshot description: force-checkpoint the channel's
+        derived DBs and return manifests + chain metadata at the
+        checkpoint height (ledger/snapshot.py protocol)."""
+        from fabric_tpu.ledger import snapshot as snapmod
+        return snapmod.export_meta(self._chan(body).ledger)
+
+    def _rpc_snapshot_chunk(self, body: dict, peer_identity) -> dict:
+        from fabric_tpu.ledger import snapshot as snapmod
+        return snapmod.serve_chunk(
+            self._chan(body).ledger, str(body["db"]), int(body["gen"]),
+            str(body["file"]), int(body["offset"]))
+
+    def _state_route(self, path, body):
+        return 200, {"channels": {
+            cid: ch.ledger.state_status()
+            for cid, ch in sorted(self.channels.items())}}
 
     def _rpc_chain_info(self, body: dict, peer_identity) -> dict:
         return self._chan(body).qscc.get_chain_info(peer_identity)

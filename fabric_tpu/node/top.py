@@ -149,6 +149,15 @@ def collect_node(addr: str, timeout: float = 2.0) -> dict:
     row["vcache"] = vh / (vh + vm) if (vh + vm) else None
     spec = [v for _, v in metrics.get("speculative_coverage_frac", ())]
     row["spec"] = (sum(spec) / len(spec)) if spec else None
+    # state plane: shard count + total keys from the per-shard gauge,
+    # last crash-consistent checkpoint height from the checkpoint gauge
+    shard_series = metrics.get("state_shard_keys", ()) or ()
+    shards = {labels.get("shard") for labels, _ in shard_series}
+    row["state_shards"] = len(shards) or None
+    row["state_keys"] = (_sum(metrics.get("state_shard_keys"))
+                         if shard_series else None)
+    ck = [v for _, v in metrics.get("state_checkpoint_height", ()) or ()]
+    row["ckpt_height"] = max(ck) if ck else None
 
     try:
         doc = _get_json(addr, "/spans/stats", timeout)
@@ -221,9 +230,9 @@ def _fmt_devices(devs) -> str:
 
 
 _COLS = ("NODE", "HT", "TX/S", "COLLECT", "DISP", "GATE", "COMMIT",
-         "OCC", "DEV", "OVLP", "VCACHE", "SPEC", "QD", "BRKR", "SHED",
-         "FAULTS", "SLO", "HEALTH")
-_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 4, 5, 9, 7, 12, 8)
+         "OCC", "DEV", "OVLP", "VCACHE", "SPEC", "STATE", "QD", "BRKR",
+         "SHED", "FAULTS", "SLO", "HEALTH")
+_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 11, 4, 5, 9, 7, 12, 8)
 
 # gateway_admission_state gauge value -> short cell tag
 _ADM_SHORT = {0: "ok", 1: "EVAL", 2: "PROB", 3: "HARD"}
@@ -239,6 +248,18 @@ def _fmt_shed(row: dict) -> str:
     name = _ADM_SHORT.get(int(st or 0), "?")
     return f"{name}/{shed:.0f}"
 
+
+def _fmt_state(row: dict) -> str:
+    """`<shards>sh/<keys>@<ckpt height>`: sharded-state keyspace size +
+    last durable checkpoint height; `-` before any shard gauge lands."""
+    n = row.get("state_shards")
+    if not n:
+        return "-"
+    keys = row.get("state_keys") or 0.0
+    k = f"{keys / 1000.0:.0f}k" if keys >= 1000 else f"{keys:.0f}"
+    ck = row.get("ckpt_height")
+    return f"{n}sh/{k}" + ("" if ck is None else f"@{ck:.0f}")
+
 # --sort column -> row key; None values sort last, numeric descending
 # (the interesting rows — hottest, furthest ahead, most alerting — rise)
 _SORT_KEYS = {
@@ -247,6 +268,7 @@ _SORT_KEYS = {
     "faults": "faults_fired", "slo": "slo_alerting", "height": "height",
     "rate": "rate", "occupancy": "occupancy", "dev": "devices",
     "vcache": "vcache", "spec": "spec", "shed": "shed_total",
+    "state": "state_keys",
 }
 
 
@@ -297,6 +319,7 @@ def render(rows: List[dict]) -> str:
             _fmt_pct(r.get("occupancy")), _fmt_devices(r.get("devices")),
             _fmt_pct(r.get("overlap")),
             _fmt_pct(r.get("vcache")), _fmt_pct(r.get("spec")),
+            _fmt_state(r),
             f"{r.get('queue_depth', 0):.0f}",
             f"{r.get('breakers_open', 0):.0f}",
             _fmt_shed(r),

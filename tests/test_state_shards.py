@@ -1,0 +1,365 @@
+"""Sharded state plane: placement determinism, flat-vs-sharded
+differential bit-identity, checkpoint/reopen recovery, and the snapshot
+export -> chunk -> install state-transfer roundtrip.
+
+The sharded StateDB claims EXACT observable identity with the flat
+(n_shards=1) store — same merged key map, same range-scan order, same
+rich-query results, same commit-hash chain when driven through the
+ledger.  Every corpus here runs at N ∈ {1, 4, 7} and the outputs are
+compared literally; 7 is deliberately coprime with the default 8 so the
+re-stripe recovery path gets a shard count that divides nothing.
+"""
+
+import hashlib
+import os
+import random
+
+import pytest
+
+from fabric_tpu.ledger import KVLedger, LedgerConfig, StateDB, UpdateBatch
+from fabric_tpu.ledger import checkpoint as ckpt
+from fabric_tpu.ledger import snapshot
+from fabric_tpu.ledger.historydb import HistoryDB
+from fabric_tpu.ledger.statedb import shard_of
+from fabric_tpu.protocol import (KVWrite, NsRwSet, TxFlags, TxRwSet,
+                                 ValidationCode, Version, build)
+from fabric_tpu.protocol.types import META_TXFLAGS
+
+SHARD_COUNTS = (1, 4, 7)
+
+
+@pytest.fixture(scope="module", autouse=True)
+def provider():
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    return init_factories(FactoryOpts(default="SW"))
+
+
+@pytest.fixture(scope="module")
+def org():
+    from fabric_tpu.msp.ca import DevOrg
+    return DevOrg("Org1")
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+def test_shard_of_deterministic_and_bounded():
+    for n in (1, 2, 7, 8, 64):
+        for i in range(200):
+            ns, key = f"ns{i % 3}", f"key-{i:04d}"
+            s = shard_of(ns, key, n)
+            assert 0 <= s < max(1, n)
+            assert s == shard_of(ns, key, n)     # stable
+    # n_shards <= 1 is always shard 0 (the flat store)
+    assert shard_of("cc", "anything", 1) == 0
+    assert shard_of("cc", "anything", 0) == 0
+
+
+def test_shard_of_separates_namespace_from_key():
+    # ("ab", "c") and ("a", "bc") must not collapse to one hash input
+    vals = {(shard_of("ab", "c", 1 << 30), shard_of("a", "bc", 1 << 30))}
+    assert len({v for pair in vals for v in pair}) == 2
+
+
+def test_shard_of_spreads_keys():
+    n = 8
+    counts = [0] * n
+    for i in range(4000):
+        counts[shard_of("cc", f"k{i:05d}", n)] += 1
+    assert min(counts) > 0
+    # FNV over short keys is not perfect, but no shard should hog
+    assert max(counts) < 3 * (4000 // n)
+
+
+def test_update_batch_preshard_cache_invalidation():
+    b = UpdateBatch()
+    b.put("cc", "k1", b"v", Version(1, 0))
+    first = b.items_by_shard(4)
+    assert b.items_by_shard(4) is first          # cached
+    b.put("cc", "k2", b"v", Version(1, 1))       # invalidates
+    second = b.items_by_shard(4)
+    assert second is not first
+    assert sum(len(x) for x in second) == 2
+    # a different width recomputes rather than serving the stale split
+    assert sum(len(x) for x in b.items_by_shard(7)) == 2
+
+
+# ---------------------------------------------------------------------------
+# flat vs sharded StateDB differential
+# ---------------------------------------------------------------------------
+
+def _random_batches(seed=7, blocks=6, keys=120):
+    rnd = random.Random(seed)
+    names = [f"k{i:04d}" for i in range(keys)]
+    batches = []
+    for blk in range(1, blocks + 1):
+        b = UpdateBatch()
+        for t, key in enumerate(rnd.sample(names, 40)):
+            if rnd.random() < 0.2:
+                b.delete("cc", key, Version(blk, t))
+            else:
+                b.put("cc", key, b"v-%d-%s" % (blk, key.encode()),
+                      Version(blk, t))
+        # a few JSON docs for the rich-query comparison
+        for t, i in enumerate(rnd.sample(range(keys), 10)):
+            b.put("docs", f"d{i:04d}",
+                  b'{"size": %d, "owner": "o%d"}' % (i, i % 3),
+                  Version(blk, 100 + t))
+        batches.append(b)
+    return batches
+
+
+def _dump(db):
+    return {k: (vv.value, vv.version.block_num, vv.version.tx_num)
+            for k, vv in db._data.items()}
+
+
+def test_sharded_statedb_matches_flat():
+    dbs = {n: StateDB(n_shards=n) for n in SHARD_COUNTS}
+    for n, db in dbs.items():
+        db.create_index("docs", "size")
+        for blk, batch in enumerate(_random_batches(), start=1):
+            db.apply_updates(batch, blk)
+    flat = dbs[1]
+    ref_dump = _dump(flat)
+    ref_scan = list(flat.range_scan("cc", "", ""))
+    ref_page = list(flat.range_scan("cc", "k0010", "k0050", limit=7))
+    ref_query = list(flat.execute_query(
+        "docs", {"size": {"$gte": 10, "$lt": 90}}))
+    for n in SHARD_COUNTS[1:]:
+        db = dbs[n]
+        assert _dump(db) == ref_dump, f"n_shards={n} state diverged"
+        assert list(db.range_scan("cc", "", "")) == ref_scan
+        assert list(db.range_scan("cc", "k0010", "k0050",
+                                  limit=7)) == ref_page
+        assert list(db.execute_query(
+            "docs", {"size": {"$gte": 10, "$lt": 90}})) == ref_query
+        assert sum(db.shard_sizes()) == len(ref_dump)
+        assert sum(1 for s in db.shard_sizes() if s) > 1  # actually striped
+
+
+# ---------------------------------------------------------------------------
+# checkpoint + reopen (incl. the re-stripe path)
+# ---------------------------------------------------------------------------
+
+def test_statedb_checkpoint_reopen_and_restripe(tmp_path):
+    root = str(tmp_path / "state")
+    db = StateDB(root, snapshot_every=2, n_shards=4)
+    for blk, batch in enumerate(_random_batches(blocks=5), start=1):
+        db.apply_updates(batch, blk)
+    ref = _dump(db)
+    assert db.status()["checkpoint_gen"] >= 1    # auto-checkpoint fired
+
+    re4 = StateDB(root, snapshot_every=2, n_shards=4)
+    assert re4.last_recovery["source"] == "manifest"
+    assert re4.savepoint == 5
+    assert _dump(re4) == ref
+
+    # shard-count change re-stripes the checkpoint payloads on load
+    re7 = StateDB(root, snapshot_every=2, n_shards=7)
+    assert _dump(re7) == ref
+    assert list(re7.range_scan("cc", "", "")) == list(
+        re4.range_scan("cc", "", ""))
+
+
+def test_statedb_checkpoint_reuse_when_clean(tmp_path):
+    root = str(tmp_path / "state")
+    db = StateDB(root, snapshot_every=100, n_shards=2)
+    b = UpdateBatch()
+    b.put("cc", "k", b"v", Version(1, 0))
+    db.apply_updates(b, 1)
+    m1 = db.checkpoint()
+    m2 = db.checkpoint()                 # nothing applied in between
+    assert m1["gen"] == m2["gen"] == 1
+    assert m1["savepoint"] == 1
+
+
+def test_historydb_sharded_checkpoint_reopen(tmp_path):
+    root = str(tmp_path / "history")
+    h = HistoryDB(root, n_shards=4, checkpoint_every=2)
+    for blk in range(1, 6):
+        h.commit(blk, [(0, f"tx{blk}", "cc", f"k{blk % 3}",
+                        b"v%d" % blk, False)])
+    mods = h.get_history("cc", "k1")
+    re4 = HistoryDB(root, n_shards=4, checkpoint_every=2)
+    assert re4.last_recovery["source"] in ("manifest", "manifest_prev")
+    assert re4.savepoint == 5
+    assert re4.get_history("cc", "k1") == mods
+    # re-stripe
+    re3 = HistoryDB(root, n_shards=3, checkpoint_every=2)
+    assert re3.get_history("cc", "k1") == mods
+
+
+# ---------------------------------------------------------------------------
+# ledger-level differential: commit hash + state across shard widths
+# ---------------------------------------------------------------------------
+
+def _endorser_envs(org, n_blocks=4, txs_per_block=6):
+    """Deterministic envelope matrix, built ONCE and committed to every
+    ledger — byte-identical blocks in, bit-identical chains out."""
+    rnd = random.Random(11)
+    blocks = []
+    for blk in range(n_blocks):
+        envs = []
+        for t in range(txs_per_block):
+            key = f"k{rnd.randrange(18):03d}"
+            writes = [KVWrite(key, b"b%d-t%d" % (blk, t))]
+            if rnd.random() < 0.25:
+                writes.append(KVWrite(f"gone{t}", b"", True))
+            rwset = TxRwSet((NsRwSet("cc", writes=tuple(writes)),))
+            envs.append(build.endorser_tx("ch", "cc", "1.0", rwset,
+                                          org.admin, [org.admin]))
+        blocks.append(envs)
+    return blocks
+
+
+def _commit_all(ledger, env_blocks):
+    for envs in env_blocks:
+        prev = (ledger.blockstore.chain_info().current_hash
+                if ledger.height else b"\x00" * 32)
+        blk = build.new_block(ledger.height, prev, envs)
+        blk.metadata.items[META_TXFLAGS] = TxFlags(
+            len(envs), ValidationCode.VALID).to_bytes()
+        ledger.commit(blk)
+
+
+def test_ledger_commit_chain_identical_across_shard_widths(tmp_path, org):
+    env_blocks = _endorser_envs(org)
+    ledgers = {}
+    for n in SHARD_COUNTS:
+        cfg = LedgerConfig(root=str(tmp_path / f"n{n}"), snapshot_every=3,
+                           state_shards=n,
+                           parallel_commit=(n == 4))  # mix the commit planes
+        ledgers[n] = KVLedger("ch", cfg)
+        _commit_all(ledgers[n], env_blocks)
+    ref = ledgers[1]
+    for n in SHARD_COUNTS[1:]:
+        lg = ledgers[n]
+        assert lg.commit_hash == ref.commit_hash, f"n={n} chain diverged"
+        assert _dump(lg.statedb) == _dump(ref.statedb)
+        assert list(lg.range_query("cc", "", "")) == list(
+            ref.range_query("cc", "", ""))
+        assert lg.get_history("cc", "k000") == ref.get_history("cc", "k000")
+
+    # reopen each from disk: checkpoint + WAL/chain-tail recovery lands
+    # on the same chain state
+    for n in SHARD_COUNTS:
+        cfg = LedgerConfig(root=str(tmp_path / f"n{n}"), snapshot_every=3,
+                           state_shards=n)
+        re = KVLedger("ch", cfg)
+        assert re.commit_hash == ref.commit_hash
+        assert _dump(re.statedb) == _dump(ref.statedb)
+
+
+# ---------------------------------------------------------------------------
+# snapshot state transfer: export -> chunks -> install -> reopen
+# ---------------------------------------------------------------------------
+
+def _fetch_via_chunks(ledger, meta):
+    """Assemble every snapshot file through serve_chunk (the wire path
+    minus the wire), verifying the manifest hashes like the client."""
+    payloads = {"state": [], "history": []}
+    for ent in meta["files"]:
+        buf = bytearray()
+        while True:
+            resp = snapshot.serve_chunk(ledger, ent["db"], ent["gen"],
+                                        ent["file"], len(buf))
+            buf += resp["data"]
+            if resp["eof"]:
+                break
+        assert hashlib.sha256(bytes(buf)).hexdigest() == ent["sha256"]
+        payloads[ent["db"]].append(bytes(buf))
+    return payloads
+
+
+def test_snapshot_roundtrip_installs_and_reopens(tmp_path, org):
+    src_root = str(tmp_path / "src")
+    cfg = LedgerConfig(root=src_root, snapshot_every=100, state_shards=4)
+    src = KVLedger("ch", cfg)
+    _commit_all(src, _endorser_envs(org, n_blocks=5))
+
+    meta = snapshot.export_meta(src)
+    assert meta["height"] == src.height
+    assert meta["commit_hash"] == src.commit_hash
+    assert any(e["db"] == "state" for e in meta["files"])
+    payloads = _fetch_via_chunks(src, meta)
+
+    dst_root = str(tmp_path / "dst")
+    assert snapshot.needs_bootstrap(dst_root, "ch")
+    snapshot.install(dst_root, "ch", meta, payloads)
+    assert not snapshot.needs_bootstrap(dst_root, "ch")
+
+    dst = KVLedger("ch", LedgerConfig(root=dst_root, state_shards=4))
+    assert dst.height == src.height
+    assert dst.commit_hash == src.commit_hash
+    assert dst.blockstore.base == meta["height"]
+    assert _dump(dst.statedb) == _dump(src.statedb)
+    assert dst.get_history("cc", "k000") == src.get_history("cc", "k000")
+    assert dst.last_recovery["replayed_blocks"] == 0   # nothing to replay
+    # pre-snapshot blocks read as pruned, not silently wrong
+    from fabric_tpu.ledger.blkstorage import BlockStoreError
+    with pytest.raises(BlockStoreError, match="pruned"):
+        dst.blockstore.get_by_number(0)
+
+    # the installed peer keeps committing on the restored chain: feed it
+    # the SAME next block the source commits, chains must stay in step
+    tail = _endorser_envs(org, n_blocks=1, txs_per_block=3)
+    _commit_all(src, tail)
+    _commit_all(dst, tail)
+    assert dst.height == src.height
+    assert dst.commit_hash == src.commit_hash
+
+
+def test_snapshot_install_tail_replay_bounded(tmp_path, org):
+    """A peer that installed a snapshot then crashed mid-tail only
+    replays the post-snapshot tail, never from genesis."""
+    src_root = str(tmp_path / "src")
+    src = KVLedger("ch", LedgerConfig(root=src_root, snapshot_every=100,
+                                      state_shards=4))
+    _commit_all(src, _endorser_envs(org, n_blocks=3))
+    meta = snapshot.export_meta(src)
+    payloads = _fetch_via_chunks(src, meta)
+
+    dst_root = str(tmp_path / "dst")
+    snapshot.install(dst_root, "ch", meta, payloads)
+    dst = KVLedger("ch", LedgerConfig(root=dst_root, state_shards=4))
+    tail = _endorser_envs(org, n_blocks=2, txs_per_block=3)
+    _commit_all(src, tail)
+    _commit_all(dst, tail)
+
+    # lose the state WAL (the tail's only state-side record): recovery
+    # falls back to the installed checkpoint (savepoint = base-1) and
+    # replays ONLY the post-snapshot tail from the block store — never
+    # from genesis, whose blocks are pruned here
+    os.remove(os.path.join(dst_root, "ch", "state", "state.wal"))
+    re = KVLedger("ch", LedgerConfig(root=dst_root, state_shards=4))
+    assert re.commit_hash == src.commit_hash
+    assert _dump(re.statedb) == _dump(src.statedb)
+    assert re.last_recovery["start"] >= meta["height"]
+    assert re.last_recovery["replayed_blocks"] == 2
+
+
+def test_serve_chunk_rejects_traversal_and_unknown_db(tmp_path, org):
+    src = KVLedger("ch", LedgerConfig(root=str(tmp_path / "src"),
+                                      state_shards=2))
+    _commit_all(src, _endorser_envs(org, n_blocks=1, txs_per_block=2))
+    meta = snapshot.export_meta(src)
+    ent = meta["files"][0]
+    with pytest.raises(snapshot.SnapshotError):
+        snapshot.serve_chunk(src, "wat", ent["gen"], ent["file"], 0)
+    for bad in ("../MANIFEST", "shard_0000.bin/../../MANIFEST",
+                "MANIFEST", "shard_.evil"):
+        with pytest.raises(snapshot.SnapshotError):
+            snapshot.serve_chunk(src, "state", ent["gen"], bad, 0)
+    with pytest.raises(snapshot.SnapshotError, match="gone"):
+        snapshot.serve_chunk(src, "state", 99999, ent["file"], 0)
+
+
+def test_needs_bootstrap_only_on_virgin_dirs(tmp_path, org):
+    root = str(tmp_path / "lg")
+    assert snapshot.needs_bootstrap(root, "ch")
+    lg = KVLedger("ch", LedgerConfig(root=root, state_shards=2))
+    assert snapshot.needs_bootstrap(root, "ch")     # no blocks yet
+    _commit_all(lg, _endorser_envs(org, n_blocks=1, txs_per_block=2))
+    assert not snapshot.needs_bootstrap(root, "ch")  # has a chain: never clobber
