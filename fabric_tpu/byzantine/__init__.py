@@ -1,0 +1,51 @@
+"""Byzantine detection-and-containment plane.
+
+Rounds 3-12 hardened the stack against honest-but-dead components:
+crash-stop kills, torn WALs, dropped/duplicated/reordered frames.  This
+package is the step past crash-stop — components that LIE:
+
+  equivocation   an orderer (or any block source) emits two different,
+                 validly-signed headers at the same height.  Peers keep
+                 a compact per-channel witness log (block_num ->
+                 header-hash + who vouched) and treat a conflicting
+                 second header as provable misbehavior: a signed fraud
+                 proof is persisted, the signing identity is permanently
+                 quarantined (the round-9 verify_plane/trust.py
+                 persistent-revocation pattern), and the deliver stream
+                 re-sources from a healthy endpoint without giving up
+                 exactly-once (re-seek from height + committer replay
+                 guard).
+  gossip poison  a gossip peer injects garbage, stale, or badly-signed
+                 payloads into state transfer.  Intake verifies payload
+                 hash chains before admission, scores offenders, and
+                 quarantines repeat offenders.
+
+Attribution is by SIGNER, not by relay: an honest peer may forward both
+sides of a fork before anyone knows it is a fork, so only the identity
+whose signature covers a losing header is convicted.  Crash-stop faults
+(drop/delay/dup/reorder, kill/restart) can never produce two different
+validly-signed headers at one height, so a crash-stop-only chaos run
+yields ZERO quarantines — the no-false-positive gate tests pin this.
+
+Observability: `byzantine_quarantines_total{reason}` and
+`byzantine_offenses_total{reason}` counters, `GET /byzantine` on the
+peer ops server, and a `BYZ` column in `python -m fabric_tpu.node.top`.
+"""
+
+from fabric_tpu.byzantine.quarantine import QuarantineRegistry
+from fabric_tpu.byzantine.witness import WitnessLog
+from fabric_tpu.byzantine.monitor import (
+    ByzantineMonitor,
+    build_fraud_proof,
+    verify_fraud_proof,
+)
+from fabric_tpu.byzantine.ops import register_ops
+
+__all__ = [
+    "QuarantineRegistry",
+    "WitnessLog",
+    "ByzantineMonitor",
+    "build_fraud_proof",
+    "verify_fraud_proof",
+    "register_ops",
+]

@@ -1,0 +1,365 @@
+"""ByzantineMonitor: the per-channel intake judge.
+
+Every block that passed signature verification — from the deliver
+stream or from gossip — is presented to `check_block(block, source)`
+before it may enter the gossip buffer.  The verdicts:
+
+  admit    normal path: witnessed, safe to buffer/commit
+  stale    height already committed with the same hash (idempotent dup)
+  hold     the height is DISPUTED (two validly-signed headers) and this
+           hash has not yet won quorum confirmation — do not buffer;
+           the deliver loop re-sources and anti-entropy re-supplies the
+           winner once confirmed
+  reject   this block is evidence of a crime (its signer equivocated or
+           forked off the committed chain); the signer is quarantined
+           and a signed fraud proof is persisted
+
+Attribution policy (the no-false-positive core): only the identity
+whose SIGNATURE covers a losing header is convicted.  Transport relays
+are never convicted for the blocks they forward — an honest peer can
+relay both sides of a fork before anyone knows it is a fork.  Transport
+sources are only scored for intake offenses that honest code can never
+emit (unparseable frames, bad signatures), and quarantined on repeat.
+
+Dispute resolution: a disputed height is confirmed for hash A when
+either (a) every competing hash has zero live (non-quarantined)
+signers, or (b) A has >= `confirm_quorum` distinct live signers and
+strictly more than every competitor.  With the default quorum of 2 this
+is the f=1 containment bound: one lying consenter cannot outvote two
+honest ones, and a single-consenter dev topology still resolves via
+rule (a) once the liar is convicted of equivocation.
+
+Exactly-once survives containment by construction: re-sourcing re-seeks
+from the committed height and the committer's replay guard already
+dedups overlap, so quarantining a stream's orderer loses nothing that
+was accepted.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from fabric_tpu.byzantine.quarantine import QuarantineRegistry
+from fabric_tpu.byzantine.witness import WitnessLog
+
+logger = logging.getLogger("fabric_tpu.byzantine")
+
+VERDICT_ADMIT = "admit"
+VERDICT_STALE = "stale"
+VERDICT_HOLD = "hold"
+VERDICT_REJECT = "reject"
+
+
+def _hex(b) -> str:
+    try:
+        return bytes(b).hex()
+    except Exception:
+        return str(b)
+
+
+def _jsonable_sigs(block) -> List[dict]:
+    """Block metadata signature entries as JSON-safe evidence."""
+    try:
+        from fabric_tpu.protocol.types import META_SIGNATURES
+        sigs = block.metadata.items.get(META_SIGNATURES) or []
+    except Exception:
+        return []
+    out = []
+    for entry in sigs:
+        try:
+            out.append({
+                "creator": _hex(entry["sig_header"]["creator"]),
+                "nonce": _hex(entry["sig_header"].get("nonce", b"")),
+                "signature": _hex(entry["signature"])})
+        except Exception:
+            continue
+    return out
+
+
+def build_fraud_proof(channel_id: str, height: int, accused: str,
+                      reason: str, evidence: dict,
+                      signer=None) -> dict:
+    """A self-contained, portable accusation: the witness-log extract
+    plus the conflicting header we hold, signed by the accusing peer so
+    a third party can check WHO is making the claim.  The provable core
+    is inside `evidence`: two different header hashes at one height,
+    each covered by a valid consenter signature."""
+    body = {
+        "v": 1, "channel": channel_id, "height": int(height),
+        "accused": accused, "reason": reason, "evidence": evidence,
+        "at": time.time(),
+    }
+    if signer is not None:
+        try:
+            body["accuser"] = _hex(signer.serialize())
+            canonical = json.dumps(body, sort_keys=True).encode()
+            body["signature"] = _hex(signer.sign(canonical))
+        except Exception:
+            logger.exception("fraud proof signing failed")
+    return body
+
+
+def verify_fraud_proof(proof: dict, msps) -> bool:
+    """Check the accuser's signature over the canonical proof body."""
+    try:
+        from fabric_tpu.msp import deserialize_from_msps
+        body = {k: v for k, v in proof.items() if k != "signature"}
+        canonical = json.dumps(body, sort_keys=True).encode()
+        ident = deserialize_from_msps(
+            msps, bytes.fromhex(proof["accuser"]), validate=True)
+        if ident is None:
+            return False
+        return bool(ident.verify(canonical,
+                                 bytes.fromhex(proof["signature"])))
+    except Exception:
+        return False
+
+
+class ByzantineMonitor:
+    """One channel's detection/containment judge (thread-safe)."""
+
+    def __init__(self, channel_id: str, witness: WitnessLog,
+                 quarantine: QuarantineRegistry, ledger=None,
+                 msps=None, signer=None, proof_dir: Optional[str] = None,
+                 confirm_quorum: int = 2):
+        self.channel_id = channel_id
+        self.witness = witness
+        self.quarantine = quarantine
+        self.ledger = ledger           # needs .height + .blockstore
+        self.msps = msps
+        self.signer = signer
+        self.proof_dir = proof_dir
+        self.confirm_quorum = max(1, int(confirm_quorum))
+        self._lock = threading.Lock()
+        self.proofs: List[dict] = []
+        self._proof_seq = 0
+        if proof_dir is not None:
+            try:
+                os.makedirs(proof_dir, exist_ok=True)
+                for name in sorted(os.listdir(proof_dir)):
+                    if name.startswith("fraud_") and name.endswith(".json"):
+                        with open(os.path.join(proof_dir, name)) as f:
+                            self.proofs.append(json.load(f))
+                self._proof_seq = len(self.proofs)
+            except Exception:
+                logger.exception("fraud proof dir unreadable: %s",
+                                 proof_dir)
+
+    # -- identity helpers ----------------------------------------------------
+
+    def signer_bindings(self, block) -> List[str]:
+        """'mspid|cert-sha256' for every identity whose (already
+        verified) signature the block's metadata carries."""
+        try:
+            from fabric_tpu.protocol.types import META_SIGNATURES
+            sigs = block.metadata.items.get(META_SIGNATURES) or []
+        except Exception:
+            return []
+        out: List[str] = []
+        for entry in sigs:
+            try:
+                from fabric_tpu.msp import deserialize_from_msps
+                from fabric_tpu.orderer.cluster import cert_fingerprint
+                ident = deserialize_from_msps(
+                    self.msps, entry["sig_header"]["creator"],
+                    validate=False)
+                if ident is None:
+                    continue
+                key = f"{ident.mspid}|{cert_fingerprint(ident.cert)}"
+                if key not in out:
+                    out.append(key)
+            except Exception:
+                continue
+        return out
+
+    def blocked_source(self, source: Optional[str]) -> bool:
+        return self.quarantine.is_quarantined(source)
+
+    def offense(self, source: str, reason: str) -> None:
+        """Score a transport-level intake offense (garbage / bad sig)."""
+        self.quarantine.offense(source, reason)
+
+    # -- the intake judgment -------------------------------------------------
+
+    def check_block(self, block, source: str) -> str:
+        """Judge one signature-verified block from `source` (a transport
+        identity key).  See module docstring for the verdicts."""
+        from fabric_tpu.protocol import block_header_hash
+        try:
+            num = int(block.header.number)
+            hhex = block_header_hash(block.header).hex()
+        except Exception:
+            return VERDICT_HOLD
+        signers = self.signer_bindings(block)
+        with self._lock:
+            # 1. committed heights: the blockstore is the witness
+            committed = self._committed_hash(num)
+            if committed is not None:
+                if committed == hhex:
+                    return VERDICT_STALE
+                # validly-signed header off the committed chain: every
+                # signer provably signed outside consensus
+                self._convict(
+                    signers, num, "fork",
+                    {"committed": committed, "conflicting": hhex,
+                     "header": self._header_dict(block),
+                     "signatures": _jsonable_sigs(block),
+                     "source": source})
+                return VERDICT_REJECT
+
+            # 2. witness the vouch, then judge the height's state
+            ent = self.witness.vouch(num, hhex, source, signers)
+            if len(ent["hashes"]) > 1:
+                self._judge_dispute(num, ent, block, source)
+                ent = self.witness.get(num) or ent
+                confirmed = ent.get("confirmed")
+                if confirmed is None:
+                    return VERDICT_HOLD
+                return (VERDICT_ADMIT if confirmed == hhex
+                        else VERDICT_REJECT)
+            # single known hash: admit unless it is vouched ONLY by
+            # quarantined identities (a convicted signer's solo word is
+            # not enough — re-sourcing fetches a healthy-signed copy)
+            if signers and not any(
+                    not self.quarantine.is_quarantined(s)
+                    for s in signers):
+                return VERDICT_HOLD
+            return VERDICT_ADMIT
+
+    def check_commit(self, block) -> bool:
+        """Drain-time guard: may this buffered block be committed?
+        False when its height is disputed-unresolved or its hash lost —
+        blocks buffered BEFORE their height became disputed are caught
+        here."""
+        from fabric_tpu.protocol import block_header_hash
+        try:
+            num = int(block.header.number)
+            hhex = block_header_hash(block.header).hex()
+        except Exception:
+            return False
+        ent = self.witness.get(num)
+        if ent is None:
+            return True
+        confirmed = ent.get("confirmed")
+        if confirmed is not None:
+            return confirmed == hhex
+        return len(ent["hashes"]) <= 1
+
+    def on_committed(self, height: int) -> None:
+        self.witness.prune_below(height)
+
+    def convict_external(self, identity: str, reason: str,
+                         evidence: Optional[dict] = None) -> None:
+        """Quarantine an identity for a crime proven OUTSIDE the witness
+        log (e.g. a tampered attestation digest caught by the round-9
+        trust registry)."""
+        with self._lock:
+            self._convict([identity], -1, reason, evidence or {})
+
+    # -- internals -----------------------------------------------------------
+
+    def _committed_hash(self, num: int) -> Optional[str]:
+        from fabric_tpu.protocol import block_header_hash
+        try:
+            if self.ledger is None or num >= self.ledger.height:
+                return None
+            stored = self.ledger.blockstore.get_by_number(num)
+            return block_header_hash(stored.header).hex()
+        except Exception:
+            return None
+
+    @staticmethod
+    def _header_dict(block) -> dict:
+        try:
+            return {k: (_hex(v) if isinstance(v, (bytes, bytearray,
+                                                  memoryview)) else v)
+                    for k, v in block.header.to_dict().items()}
+        except Exception:
+            return {}
+
+    def _live_signers(self, rec: dict) -> List[str]:
+        return [s for s in rec["signers"]
+                if not self.quarantine.is_quarantined(s)]
+
+    def _judge_dispute(self, num: int, ent: dict, block,
+                       source: str) -> None:
+        """Called under the lock with >= 2 hashes witnessed at `num`.
+        Convicts same-signer equivocators, then tries to confirm a
+        winner by live-signer quorum."""
+        hashes = ent["hashes"]
+        evidence = {"witness": {h: {"sources": list(r["sources"]),
+                                    "signers": list(r["signers"])}
+                                for h, r in hashes.items()},
+                    "header": self._header_dict(block),
+                    "signatures": _jsonable_sigs(block),
+                    "source": source}
+        # (a) the perfect proof: one identity signed two different
+        # headers at one height
+        seen: Dict[str, str] = {}
+        for h, rec in hashes.items():
+            for s in rec["signers"]:
+                if s in seen and seen[s] != h:
+                    self._convict([s], num, "equivocation", evidence)
+                else:
+                    seen.setdefault(s, h)
+        if ent.get("confirmed") is not None:
+            return
+        # (b) quorum confirmation over live signers
+        live = {h: self._live_signers(rec) for h, rec in hashes.items()}
+        alive = {h: sigs for h, sigs in live.items() if sigs}
+        winner = None
+        if len(alive) == 1:
+            winner = next(iter(alive))
+        elif alive:
+            ranked = sorted(alive.items(), key=lambda kv: -len(kv[1]))
+            top_h, top_live = ranked[0]
+            if (len(top_live) >= self.confirm_quorum
+                    and len(top_live) > len(ranked[1][1])):
+                winner = top_h
+        if winner is None:
+            return
+        self.witness.confirm(num, winner)
+        losers = [s for h, rec in hashes.items() if h != winner
+                  for s in rec["signers"]]
+        self._convict(sorted(set(losers)), num, "fork",
+                      {**evidence, "confirmed": winner})
+
+    def _convict(self, identities: List[str], height: int, reason: str,
+                 evidence: dict) -> None:
+        """Quarantine + emit one signed fraud proof per NEW conviction.
+        Caller holds the lock."""
+        for ident in identities:
+            if not ident:
+                continue
+            if not self.quarantine.quarantine(ident, reason):
+                continue              # already quarantined: no new proof
+            proof = build_fraud_proof(self.channel_id, height, ident,
+                                      reason, evidence, self.signer)
+            self.proofs.append(proof)
+            self._persist_proof(proof)
+
+    def _persist_proof(self, proof: dict) -> None:
+        if self.proof_dir is None:
+            return
+        try:
+            name = f"fraud_{self._proof_seq:05d}.json"
+            self._proof_seq += 1
+            tmp = os.path.join(self.proof_dir, name + ".tmp")
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(proof, f, sort_keys=True)
+            os.replace(tmp, os.path.join(self.proof_dir, name))
+        except Exception:
+            logger.exception("fraud proof not persisted")
+
+    # -- ops view ------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        return {"channel": self.channel_id,
+                "witness": self.witness.stats(),
+                "disputed_heights": self.witness.disputed_heights(),
+                "fraud_proofs": len(self.proofs)}

@@ -1,0 +1,150 @@
+"""Persistent quarantine registry: per-identity standing, revoked for
+provable crimes.
+
+The Byzantine analogue of `verify_plane/trust.py` AttestorTrust: a
+thread-safe JSON-backed registry keyed by a string identity — an
+orderer/peer transport binding ("mspid|cert-sha256") or a gossip
+endpoint ("gossip|host:port") — where a proven crime (equivocation,
+fork) quarantines the identity immediately and permanently, while
+scored offenses (garbage frames, bad signatures) accumulate until a
+threshold quarantines repeat offenders.
+
+Quarantine withdraws TRUST, not liveness: quarantined sources are
+refused at gossip intake and skipped by the deliver client's endpoint
+rotation, but no honest path depends on them — the stream re-sources
+from a healthy endpoint and exactly-once survives on the committer's
+replay guard.
+
+State persists across restarts when a path is given (atomic tmp +
+os.replace, exactly trust.py's discipline): a quarantined orderer stays
+quarantined until an operator deletes the state file.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Dict, Optional
+
+logger = logging.getLogger("fabric_tpu.byzantine")
+
+# crime reasons quarantine immediately; offense reasons score up to the
+# threshold first (a single garbage frame is noise, a pattern is not)
+CRIME_REASONS = ("equivocation", "fork", "tampered_attestation")
+OFFENSE_REASONS = ("garbage", "bad_sig", "bad_hash", "stale")
+
+
+class QuarantineRegistry:
+    """Thread-safe per-identity standing registry (node-scoped)."""
+
+    def __init__(self, path: Optional[str] = None,
+                 score_threshold: int = 3):
+        self.path = path
+        self.score_threshold = int(score_threshold)
+        self._lock = threading.Lock()
+        # key -> {"quarantined": bool, "reason": str|None, "score": n,
+        #         "offenses": {reason: n}, "at": epoch|None}
+        self._state: Dict[str, dict] = {}
+        if path is not None and os.path.exists(path):
+            try:
+                with open(path, "r", encoding="utf-8") as f:
+                    data = json.load(f)
+                if isinstance(data, dict):
+                    self._state = {str(k): dict(v)
+                                   for k, v in data.items()
+                                   if isinstance(v, dict)}
+            except Exception:
+                logger.exception("quarantine state unreadable: %s", path)
+
+    def _entry(self, key: str) -> dict:
+        return self._state.setdefault(
+            key, {"quarantined": False, "reason": None, "score": 0,
+                  "offenses": {}, "at": None})
+
+    def is_quarantined(self, key: Optional[str]) -> bool:
+        if key is None:
+            return False
+        with self._lock:
+            ent = self._state.get(key)
+            return ent is not None and bool(ent.get("quarantined"))
+
+    def quarantine(self, key: str, reason: str) -> bool:
+        """Permanently quarantine `key`.  Returns True the FIRST time
+        (so callers emit the fraud proof / log exactly once)."""
+        with self._lock:
+            ent = self._entry(key)
+            first = not ent["quarantined"]
+            ent["quarantined"] = True
+            if first:
+                ent["reason"] = reason
+                ent["at"] = time.time()
+            self._save()
+        if first:
+            logger.warning("identity %s QUARANTINED: %s", key, reason)
+            self._bump("byzantine_quarantines_total",
+                       "identities quarantined by the byzantine plane",
+                       reason)
+        return first
+
+    def offense(self, key: str, reason: str, weight: int = 1) -> bool:
+        """Score an offense against `key`; quarantines (reason
+        "poison") once the accumulated score crosses the threshold.
+        Returns True if this offense caused the quarantine."""
+        with self._lock:
+            ent = self._entry(key)
+            ent["offenses"][reason] = ent["offenses"].get(reason, 0) \
+                + int(weight)
+            ent["score"] += int(weight)
+            crossed = (not ent["quarantined"]
+                       and ent["score"] >= self.score_threshold)
+            self._save()
+        self._bump("byzantine_offenses_total",
+                   "scored byzantine offenses at gossip/deliver intake",
+                   reason)
+        if crossed:
+            return self.quarantine(key, "poison")
+        return False
+
+    def count(self) -> int:
+        with self._lock:
+            return sum(1 for e in self._state.values()
+                       if e.get("quarantined"))
+
+    def reasons(self) -> Dict[str, int]:
+        """reason -> quarantined-identity count (the BYZ column's
+        breakdown)."""
+        out: Dict[str, int] = {}
+        with self._lock:
+            for e in self._state.values():
+                if e.get("quarantined"):
+                    r = e.get("reason") or "?"
+                    out[r] = out.get(r, 0) + 1
+        return out
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            return {k: {**dict(v), "offenses": dict(v.get("offenses", {}))}
+                    for k, v in self._state.items()}
+
+    @staticmethod
+    def _bump(name: str, help_text: str, reason: str) -> None:
+        try:
+            from fabric_tpu.ops_plane import registry
+            registry.counter(name, help_text).add(1, reason=reason)
+        except Exception:
+            pass                  # observability never breaks containment
+
+    def _save(self) -> None:
+        # caller holds the lock; atomic replace, trust.py discipline
+        if self.path is None:
+            return
+        try:
+            tmp = self.path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(self._state, f, sort_keys=True)
+            os.replace(tmp, self.path)
+        except Exception:
+            logger.exception("quarantine state not persisted")

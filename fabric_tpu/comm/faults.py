@@ -156,6 +156,8 @@ class FaultRule:
     method: str = "*"            # fnmatch pattern on the RPC method
     peer: Optional[str] = None   # fnmatch on "host:port" (None = any)
     kind: str = "*"              # "req" | "cast" | "resp" | "stream" | "*"
+    src: str = "*"               # fnmatch on the dialing identity's
+                                 # mspid ("*" = any, incl. untagged)
     drop: float = 0.0
     delay: float = 0.0           # probability of delaying
     delay_s: float = 0.01        # how long a delayed frame is held
@@ -167,7 +169,8 @@ class FaultRule:
     schedule: Optional[FaultSchedule] = None
     fires: int = field(default=0, compare=False)
 
-    def matches(self, method: str, peer: str, kind: str) -> bool:
+    def matches(self, method: str, peer: str, kind: str,
+                src: str = "") -> bool:
         if self.max_fires is not None and self.fires >= self.max_fires:
             return False
         if not fnmatch.fnmatchcase(kind, self.kind):
@@ -177,10 +180,14 @@ class FaultRule:
         if self.peer is not None and not fnmatch.fnmatchcase(
                 peer, self.peer):
             return False
+        if self.src != "*" and not fnmatch.fnmatchcase(
+                src or "", self.src):
+            return False
         return True
 
     def as_dict(self) -> dict:
         return {"method": self.method, "peer": self.peer, "kind": self.kind,
+                "src": self.src,
                 "drop": self.drop, "delay": self.delay,
                 "delay_s": self.delay_s, "dup": self.dup,
                 "reorder": self.reorder, "error": self.error,
@@ -235,6 +242,41 @@ class FaultPlan:
         self.rules.append(FaultRule(schedule=sched, **kw))
         return self
 
+    def links(self, matrix: Dict, schedule=None) -> "FaultPlan":
+        """Compile a per-link latency/loss matrix into rules.
+
+        `matrix` maps (src, dst) -> link properties, where `src` is an
+        fnmatch pattern on the dialing identity's mspid, `dst` one on
+        the remote "host:port", and the properties are:
+
+          latency_s   one-way propagation delay added to EVERY frame
+                      on the link (delay probability 1.0)
+          loss        frame loss probability in [0, 1]
+          jitter_s    reserved label, recorded but not yet modeled
+
+        Direction matters — (A, B) and (B, A) are independent links, so
+        asymmetric paths (fast A->B, slow trans-oceanic B->A) are one
+        entry each.  Entries compile in sorted order so rule order (and
+        with it the PRNG draw sequence) is independent of dict
+        insertion order; an optional `schedule` envelope is attached to
+        every link rule and — like all schedules — scales probabilities
+        BEFORE the compare without consuming extra draws.
+        """
+        sched = schedule
+        if isinstance(sched, dict):
+            sched = FaultSchedule(**sched)
+        for (src, dst) in sorted(matrix):
+            props = dict(matrix[(src, dst)])
+            latency = float(props.get("latency_s", 0.0))
+            loss = float(props.get("loss", 0.0))
+            self.rules.append(FaultRule(
+                src=str(src), peer=str(dst),
+                drop=loss,
+                delay=1.0 if latency > 0.0 else 0.0,
+                delay_s=latency,
+                schedule=sched))
+        return self
+
     # -- connection-level faults --------------------------------------------
 
     def sever(self, addr) -> "FaultPlan":
@@ -283,10 +325,12 @@ class FaultPlan:
     # -- the frame hook ------------------------------------------------------
 
     def apply(self, channel_key: int, method: str, peer, kind: str,
-              send: Callable[[], None]) -> None:
+              send: Callable[[], None], src: str = "") -> None:
         """Decide and apply faults for one outbound frame.  `send` is a
         closure performing the actual transmission; it is called 0, 1 or
-        2 times depending on the decision."""
+        2 times depending on the decision.  `src` is the dialing
+        identity's mspid when the transport tagged the channel (link-
+        matrix rules match on it; untagged frames only match src="*")."""
         peer_s = _addr_str(peer) if peer is not None else ""
         action = None
         delay_s = 0.0
@@ -295,7 +339,7 @@ class FaultPlan:
                          if self.installed_at is not None else now)
         with self._lock:
             for r in self.rules:
-                if not r.matches(method, peer_s, kind):
+                if not r.matches(method, peer_s, kind, src):
                     continue
                 # the wall-time envelope scales every probability; a
                 # candidate action with p > 0 still consumes exactly one

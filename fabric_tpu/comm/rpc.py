@@ -54,7 +54,8 @@ def _send_frame(ch: SecureChannel, frame: dict, method: str,
         ch.send(data)
     else:
         plan.apply(id(ch), method, getattr(ch, "remote_addr_str", None),
-                   kind, lambda: ch.send(data))
+                   kind, lambda: ch.send(data),
+                   src=getattr(ch, "local_src_str", ""))
 
 
 class RpcConnection:

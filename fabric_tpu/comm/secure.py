@@ -170,6 +170,11 @@ def dial(addr, signer, msps: Dict, timeout: float = 10.0) -> SecureChannel:
     ch = _handshake(sock, signer, msps, initiator=True)
     sock.settimeout(None)
     ch.remote_addr_str = _faults._addr_str(addr)
+    # source tag for per-link fault matrices: the dialing identity's
+    # mspid (the only source name available at dial time — in-process
+    # topologies share one fault plan, so link rules are scoped
+    # src=mspid -> dst="host:port")
+    ch.local_src_str = getattr(signer, "mspid", "") or ""
     _faults.register_channel(ch)
     return ch
 

@@ -11,6 +11,7 @@ buffering.
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Callable, Dict, List, Optional
 
 from fabric_tpu.protocol import Block
@@ -36,7 +37,15 @@ class GossipState:
         self.committer = committer  # needs .height and .store_block(block)
         self.mcs = mcs
         self.fanout = fanout
+        # byzantine.ByzantineMonitor, wired post-construction by the
+        # peer channel; None = classic blind intake
+        self.monitor = None
         self._buffer: Dict[int, Block] = {}
+        # deliver loop + gossip dispatch threads both drain; the lock
+        # closes the pop->store window (two threads pop adjacent heights
+        # and the later store races a concurrent re-buffer of the same
+        # height into an out-of-order commit)
+        self._drain_lock = threading.Lock()
 
     # -- intake -------------------------------------------------------------
 
@@ -49,26 +58,51 @@ class GossipState:
         self._drain()
 
     def handle(self, msg_type: str, frm: str, body: dict) -> None:
+        if (self.monitor is not None
+                and self.monitor.blocked_source(self._byz_key(frm))):
+            return                      # quarantined gossip source
         if msg_type == MSG_BLOCK:
-            self._on_block_msg(body)
+            self._on_block_msg(frm, body)
         elif msg_type == MSG_STATE_REQ:
             self._on_state_req(frm, body)
         elif msg_type == MSG_STATE_RESP:
             for raw in body.get("blocks", []):
-                self._on_block_msg({"block": raw})
+                self._on_block_msg(frm, {"block": raw})
         self._drain()
 
-    def _on_block_msg(self, body: dict) -> None:
+    @staticmethod
+    def _byz_key(frm: str) -> str:
+        """Quarantine key for a gossip transport source.  Distinct from
+        signer bindings on purpose: gossip offenses score the RELAY
+        (who injected garbage), crimes convict the SIGNER."""
+        return f"gossip|{frm}"
+
+    def _on_block_msg(self, frm: str, body: dict) -> None:
         try:
             # native span parse (BlockView) with Block.deserialize
             # fallback — reject behavior identical, per-tx decode gone
             block = wire.parse_block(body["block"])
         except (KeyError, ValueError, TypeError):
+            # unparseable payload: honest peers (and the crash-stop
+            # fault plane, which only drops/dups/reorders whole frames)
+            # never produce one — score the source
+            if self.monitor is not None and frm:
+                self.monitor.offense(self._byz_key(frm), "garbage")
             return
         if self.mcs is not None and not self.mcs.verify_block(block):
             logger.warning("rejected gossiped block %s: bad orderer sig",
                            getattr(block.header, "number", "?"))
+            if self.monitor is not None and frm:
+                self.monitor.offense(self._byz_key(frm), "bad_sig")
             return
+        if self.monitor is not None:
+            from fabric_tpu.byzantine.monitor import (
+                VERDICT_ADMIT, VERDICT_STALE)
+            verdict = self.monitor.check_block(block, self._byz_key(frm))
+            if verdict == VERDICT_STALE:
+                return                  # idempotent dup, not an offense
+            if verdict != VERDICT_ADMIT:
+                return                  # disputed/convicted: never buffer
         self._buffer_block(block)
 
     def _buffer_block(self, block: Block) -> None:
@@ -93,9 +127,28 @@ class GossipState:
     # -- ordered drain into the committer (deliverPayloads) ------------------
 
     def _drain(self) -> None:
-        while self.committer.height in self._buffer:
-            block = self._buffer.pop(self.committer.height)
-            self.committer.store_block(block)
+        with self._drain_lock:
+            while True:
+                height = self.committer.height
+                # a block popped by one drain can be re-buffered by a
+                # concurrent intake before its store lands; with stores
+                # serialized under the lock those copies surface here as
+                # already-committed entries — purge instead of re-storing
+                for num in [n for n in self._buffer if n < height]:
+                    del self._buffer[num]
+                if height not in self._buffer:
+                    break
+                if (self.monitor is not None
+                        and not self.monitor.check_commit(
+                            self._buffer[height])):
+                    # the height became disputed AFTER this block was
+                    # buffered (or this hash lost the dispute): evict it
+                    # so the confirmed winner can take the slot — intake
+                    # holds contested copies until resolution, and
+                    # anti-entropy / deliver re-seek re-supply the winner
+                    del self._buffer[height]
+                    break
+                self.committer.store_block(self._buffer.pop(height))
 
     # -- anti-entropy (state.go:591) -----------------------------------------
 

@@ -19,6 +19,7 @@ import os
 import struct
 import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
@@ -58,6 +59,7 @@ class HistoryDB:
         self._savepoint: Optional[int] = None
         self._blocks_since_ckpt = 0
         self._ckpt_gen = 0
+        self._pool: Optional[ThreadPoolExecutor] = None
         self.last_recovery = {"source": "fresh", "wal_blocks": 0,
                               "savepoint": None}
         if root is not None:
@@ -151,20 +153,44 @@ class HistoryDB:
                     return m
             return self._checkpoint_locked()
 
+    # shard-parallel checkpoint serialization: mirrors statedb's
+    # core-count gate so single-core hosts never pay pool overhead
+    _PARALLEL_CKPT_MIN = 512
+    _HOST_CORES = os.cpu_count() or 1
+
+    def _get_pool(self) -> ThreadPoolExecutor:
+        if self._pool is None:
+            workers = min(self.n_shards, max(2, os.cpu_count() or 2))
+            self._pool = ThreadPoolExecutor(
+                max_workers=workers, thread_name_prefix="historydb-ckpt")
+        return self._pool
+
     def _checkpoint_locked(self) -> dict:
         t0 = time.monotonic()
         gen = self._ckpt_gen + 1
-        payloads = []
-        for i, index in enumerate(self._shards):
+
+        def _encode_shard(i: int) -> bytes:
+            index = self._shards[i]
             recs = []
             for (ns, key) in sorted(index.keys()):
                 recs.append(
                     [ns, key,
                      [[m.block_num, m.tx_num, m.txid, m.value, m.is_delete]
                       for m in index[(ns, key)]]])
-            payloads.append(serde.encode(
+            return serde.encode(
                 {"savepoint": self._savepoint, "shard": i,
-                 "n_shards": self.n_shards, "data": recs}))
+                 "n_shards": self.n_shards, "data": recs})
+
+        # shards are read-only for the duration of the lock; pool.map
+        # preserves order so the payload list is bit-identical to the
+        # serial build
+        total = sum(len(s) for s in self._shards)
+        if (self._HOST_CORES > 1 and len(self._shards) > 1
+                and total >= self._PARALLEL_CKPT_MIN):
+            payloads = list(self._get_pool().map(
+                _encode_shard, range(len(self._shards))))
+        else:
+            payloads = [_encode_shard(i) for i in range(len(self._shards))]
         manifest = ckpt.write_checkpoint(
             self.root, gen, payloads,
             meta={"savepoint": self._savepoint, "kind": "history"})

@@ -692,16 +692,30 @@ class StateDB:
     def _checkpoint_locked(self) -> dict:
         t0 = time.monotonic()
         gen = self._ckpt_gen + 1
-        payloads = []
-        for i, sh in enumerate(self._shards):
+
+        def _encode_shard(i: int) -> bytes:
+            sh = self._shards[i]
             recs = []
             for k in sh.sorted_keys:
                 vv = sh.data[k]
                 recs.append({"ns": k[0], "key": k[1], "value": vv.value,
                              "version": vv.version.to_list()})
-            payloads.append(serde.encode(
+            return serde.encode(
                 {"savepoint": self._savepoint, "shard": i,
-                 "n_shards": self.n_shards, "data": recs}))
+                 "n_shards": self.n_shards, "data": recs})
+
+        # per-shard payloads are independent pure functions of shard
+        # content, so the rec-build + serde.encode fans out across the
+        # apply pool on multi-core hosts; pool.map preserves shard
+        # order, so the payload list — and the manifest digests — are
+        # bit-identical to the serial path
+        total = sum(len(sh.sorted_keys) for sh in self._shards)
+        if (self._HOST_CORES > 1 and len(self._shards) > 1
+                and total >= self._PARALLEL_APPLY_MIN):
+            payloads = list(self._get_pool().map(
+                _encode_shard, range(len(self._shards))))
+        else:
+            payloads = [_encode_shard(i) for i in range(len(self._shards))]
         manifest = ckpt.write_checkpoint(
             self.root, gen, payloads,
             meta={"savepoint": self._savepoint, "kind": "state"})

@@ -123,6 +123,16 @@ class RemoteDeliver:
         self.signer = signer
         self.msps = msps
         self._rr = 0
+        # optional containment hook: callable(sender_identity) -> bool;
+        # a True verdict skips the endpoint (quarantined orderer)
+        self.blocked = None
+
+    def advance(self) -> None:
+        """Rotate away from the current endpoint — called when the
+        byzantine monitor convicts the stream's orderer so the next
+        pull re-sources from a different consenter."""
+        if self.orderers:
+            self._rr = (self._rr + 1) % len(self.orderers)
 
     def deliver(self, channel_id, seek, signed=None, timeout_s: int = 10):
         """Yields (block, attests, sender) — `attests` is the orderer's
@@ -144,6 +154,11 @@ class RemoteDeliver:
                                timeout=3.0, stream_views=True)
                 try:
                     sender = getattr(conn.channel, "peer_identity", None)
+                    if self.blocked is not None and self.blocked(sender):
+                        last = RuntimeError(
+                            "orderer endpoint %s:%s is quarantined"
+                            % tuple(addr[:2]))
+                        continue
                     for item in conn.call_stream("deliver", {
                             "channel": channel_id, "start": seek.start,
                             "stop": seek.stop, "behavior": seek.behavior,
@@ -409,6 +424,24 @@ class PeerChannel:
             mcs=self.mcs, signer=node.signer,
             bootstrap=bootstrap, msps=self.msps)
 
+        # byzantine containment: per-channel witness log + monitor over
+        # the node-scoped quarantine registry.  Judges every block at
+        # deliver/gossip intake (after signature verification) and
+        # guards the gossip drain so a contested header never commits.
+        self.byz_monitor = None
+        if node.byzantine is not None:
+            from fabric_tpu.byzantine import ByzantineMonitor, WitnessLog
+            self.byz_monitor = ByzantineMonitor(
+                self.channel_id,
+                WitnessLog(f"{ch_dir}/witness_log.json"),
+                node.byzantine, ledger=self.ledger, msps=self.msps,
+                signer=node.signer,
+                proof_dir=f"{ch_dir}/fraud_proofs")
+            self.gossip.state.monitor = self.byz_monitor
+            self.deliver_client.blocked = (
+                lambda s: self.byz_monitor.blocked_source(
+                    self._byz_source(s)))
+
         self.deliver_healthy = True
         self._thread = threading.Thread(target=self._deliver_loop,
                                         daemon=True)
@@ -493,6 +526,15 @@ class PeerChannel:
 
     # -- deliver / commit loop ------------------------------------------
 
+    @staticmethod
+    def _byz_source(sender):
+        """'mspid|cert-sha256' quarantine key for a transport-verified
+        deliver sender, or None (never blocked) without a usable cert."""
+        binding = PeerNode._attestor_binding(sender)
+        if binding is None:
+            return None
+        return f"{binding[0]}|{binding[1]}"
+
     def _seed_attestations(self, block, attests, sender) -> None:
         """Seed the node's verdict cache from an orderer's deliver-time
         admission attestations (verify_plane/attest.py).  A no-op
@@ -509,10 +551,21 @@ class PeerChannel:
             # epoch the commit-time validator will judge against
             cache.set_epoch(self.bundle_source.current().sequence,
                             scope=self.channel_id)
+            binding = self.node._attestor_binding(sender)
             accept_block_attestations(
                 cache, block, attests, self.channel_id, self.msps,
                 trust=self.node.attestor_trust,
-                attestor_binding=self.node._attestor_binding(sender))
+                attestor_binding=binding)
+            # a digest mismatch just revoked the attestor (trust.py):
+            # mirror that provable tamper into the byzantine plane so
+            # /byzantine, the metric, and the BYZ column reflect it
+            if (self.byz_monitor is not None and binding is not None
+                    and self.node.attestor_trust is not None
+                    and not self.node.attestor_trust.allowed(binding)):
+                self.byz_monitor.convict_external(
+                    f"{binding[0]}|{binding[1]}", "tampered_attestation",
+                    {"block": int(block.header.number),
+                     "channel": self.channel_id})
         except Exception:
             logger.debug("attestation seeding failed", exc_info=True)
 
@@ -535,13 +588,40 @@ class PeerChannel:
                         logger.warning("block %d failed orderer-signature "
                                        "verification; dropping window",
                                        block.header.number)
+                        # a KNOWN signer with an invalid signature is an
+                        # offense (honest orderers cannot produce it —
+                        # the authenticated transport rules out frame
+                        # corruption); unknown signers may be config lag
+                        # and are never scored
+                        if self.byz_monitor is not None and items:
+                            src = self._byz_source(sender)
+                            if src is not None:
+                                self.byz_monitor.offense(src, "bad_sig")
                         break
+                    if self.byz_monitor is not None:
+                        from fabric_tpu.byzantine.monitor import (
+                            VERDICT_ADMIT, VERDICT_STALE)
+                        verdict = self.byz_monitor.check_block(
+                            block, self._byz_source(sender))
+                        if verdict == VERDICT_STALE:
+                            got += 1
+                            continue
+                        if verdict != VERDICT_ADMIT:
+                            # hold: disputed height awaiting quorum;
+                            # reject: this stream served crime evidence.
+                            # Either way re-source from the next
+                            # consenter — re-seek from committed height
+                            # keeps exactly-once (replay guard dedups)
+                            self.deliver_client.advance()
+                            break
                     if attests:
                         self._seed_attestations(block, attests, sender)
                     # through the gossip state plane: fans out to peers
                     # and drains strictly in block order
                     self.gossip.state.add_block(block)
                     got += 1
+                if got and self.byz_monitor is not None:
+                    self.byz_monitor.on_committed(self.ledger.height)
                 self.deliver_healthy = True
                 backoff = 0.2
                 if not got:
@@ -627,6 +707,19 @@ class PeerNode:
             from fabric_tpu.verify_plane import AttestorTrust
             self.attestor_trust = AttestorTrust(
                 os.path.join(data_dir, "attestor_trust.json"))
+
+        # byzantine containment plane: ONE persistent quarantine
+        # registry per peer process (identities are node-scoped — an
+        # orderer convicted on any channel is distrusted on all), with
+        # per-channel witness logs/monitors built in PeerChannel.  On by
+        # default; `byzantine: {"enabled": false}` restores blind trust.
+        byz_cfg = dict(cfg.get("byzantine", {}))
+        self.byzantine = None
+        if byz_cfg.get("enabled", True):
+            from fabric_tpu.byzantine import QuarantineRegistry
+            self.byzantine = QuarantineRegistry(
+                os.path.join(data_dir, "byzantine_quarantine.json"),
+                score_threshold=int(byz_cfg.get("score_threshold", 3)))
 
         channel_cfg = ChannelConfig.deserialize(
             bytes.fromhex(cfg["channel_config_hex"]))
@@ -784,6 +877,15 @@ class PeerNode:
             # GET /state: per-channel shard sizes, checkpoint generation/
             # savepoint, and how much the last reopen had to replay
             self.ops.register_route("GET", "/state", self._state_route)
+            # GET /byzantine: quarantine standings, per-channel witness
+            # stats, fraud proofs
+            if self.byzantine is not None:
+                from fabric_tpu.byzantine import register_ops as _byz_ops
+                _byz_ops(self.ops, self.byzantine,
+                         monitors_fn=lambda: {
+                             cid: ch.byz_monitor
+                             for cid, ch in self.channels.items()
+                             if ch.byz_monitor is not None})
             # GET /gateway: front-door queue + breaker snapshot (the
             # gateway shares the peer process and ops surface)
             if self.gateway is not None:

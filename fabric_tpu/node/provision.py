@@ -260,22 +260,32 @@ def provision_network(base_dir: str, n_orderers: int = 3,
             }, f)
         peer_paths.append(path)
 
-    # per-org clients
+    # per-org clients: one per signature scheme the MSP accepts, so
+    # mixed-identity workloads (workload/scenarios.py) can blend P-256
+    # and ed25519 creators against the same channel
+    from fabric_tpu.bccsp import SCHEME_ED25519
     clients = {}
+    clients_ed25519 = {}
     for org_name, org in p_orgs.items():
-        ccert, ckey = org.issuer.issue(f"client@{org_name}")
-        path = os.path.join(base_dir, f"client_{org_name}.json")
-        with open(path, "w") as f:
-            json.dump({
-                "mspid": org_name,
-                "cert_pem": _cert_pem(ccert).decode(),
-                "key_pem": _key_pem(ckey).decode(),
-                "channel_config_hex": cfg_hex,
-                "channel_id": channel_id,
-                "orderers": [["127.0.0.1", p] for p in ord_ports],
-                "peers": [["127.0.0.1", p, o] for (o, k, p) in peer_list],
-            }, f)
-        clients[org_name] = path
+        for scheme, book in (
+                (None, clients), (SCHEME_ED25519, clients_ed25519)):
+            ccert, ckey = org.issuer.issue(f"client@{org_name}",
+                                           scheme=scheme)
+            suffix = f"_{scheme}" if scheme else ""
+            path = os.path.join(base_dir,
+                                f"client_{org_name}{suffix}.json")
+            with open(path, "w") as f:
+                json.dump({
+                    "mspid": org_name,
+                    "cert_pem": _cert_pem(ccert).decode(),
+                    "key_pem": _key_pem(ckey).decode(),
+                    "channel_config_hex": cfg_hex,
+                    "channel_id": channel_id,
+                    "orderers": [["127.0.0.1", p] for p in ord_ports],
+                    "peers": [["127.0.0.1", p, o]
+                              for (o, k, p) in peer_list],
+                }, f)
+            book[org_name] = path
     # per-org ADMIN identities (channel-config admin certs): the admin
     # CLI's install/join verbs are Admins-gated
     admins = {}
@@ -291,4 +301,5 @@ def provision_network(base_dir: str, n_orderers: int = 3,
             }, f)
         admins[org_name] = path
     return {"orderers": orderer_paths, "peers": peer_paths,
-            "clients": clients, "admins": admins}
+            "clients": clients, "clients_ed25519": clients_ed25519,
+            "admins": admins}

@@ -141,6 +141,14 @@ def collect_node(addr: str, timeout: float = 2.0) -> dict:
     row["shed_total"] = _sum(metrics.get("gateway_shed_total"))
     adm = [v for _, v in metrics.get("gateway_admission_state", ()) or ()]
     row["admission_state"] = max(adm) if adm else None
+    # byzantine plane: quarantined identities by reason + scored offenses
+    byz_series = metrics.get("byzantine_quarantines_total")
+    row["byz_quarantines"] = (_sum(byz_series)
+                              if byz_series is not None else None)
+    row["byz_reasons"] = sorted(
+        {labels.get("reason", "?") for labels, v in byz_series or ()
+         if v})
+    row["byz_offenses"] = _sum(metrics.get("byzantine_offenses_total"))
     # verify-once plane: cache hit rate over all lookups, and the
     # rolling fraction of committed verify items whose verdicts were
     # speculatively cached before the block arrived
@@ -231,8 +239,9 @@ def _fmt_devices(devs) -> str:
 
 _COLS = ("NODE", "HT", "TX/S", "COLLECT", "DISP", "GATE", "COMMIT",
          "OCC", "DEV", "OVLP", "VCACHE", "SPEC", "STATE", "QD", "BRKR",
-         "SHED", "FAULTS", "SLO", "HEALTH")
-_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 11, 4, 5, 9, 7, 12, 8)
+         "SHED", "FAULTS", "BYZ", "SLO", "HEALTH")
+_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 11, 4, 5, 9, 7, 10, 12,
+           8)
 
 # gateway_admission_state gauge value -> short cell tag
 _ADM_SHORT = {0: "ok", 1: "EVAL", 2: "PROB", 3: "HARD"}
@@ -247,6 +256,23 @@ def _fmt_shed(row: dict) -> str:
         return "-"
     name = _ADM_SHORT.get(int(st or 0), "?")
     return f"{name}/{shed:.0f}"
+
+
+def _fmt_byz(row: dict) -> str:
+    """`<quarantined>[reason,..]/<offense score>`: `0` is the healthy
+    steady state (the byzantine plane is live and has convicted nobody);
+    `-` means the node exports no byzantine series (plane disabled)."""
+    q = row.get("byz_quarantines")
+    if q is None:
+        return "-"
+    cell = f"{q:.0f}"
+    reasons = row.get("byz_reasons") or []
+    if reasons:
+        cell += "[" + ",".join(r[:5] for r in reasons) + "]"
+    off = row.get("byz_offenses") or 0.0
+    if off:
+        cell += f"/{off:.0f}"
+    return cell
 
 
 def _fmt_state(row: dict) -> str:
@@ -268,7 +294,7 @@ _SORT_KEYS = {
     "faults": "faults_fired", "slo": "slo_alerting", "height": "height",
     "rate": "rate", "occupancy": "occupancy", "dev": "devices",
     "vcache": "vcache", "spec": "spec", "shed": "shed_total",
-    "state": "state_keys",
+    "state": "state_keys", "byz": "byz_quarantines",
 }
 
 
@@ -323,7 +349,7 @@ def render(rows: List[dict]) -> str:
             f"{r.get('queue_depth', 0):.0f}",
             f"{r.get('breakers_open', 0):.0f}",
             _fmt_shed(r),
-            faults, slo, str(r.get("health", "?")))
+            faults, _fmt_byz(r), slo, str(r.get("health", "?")))
         lines.append("  ".join(str(c).ljust(w)
                                for c, w in zip(cells, _WIDTHS)))
     return "\n".join(lines)

@@ -46,7 +46,8 @@ class ChaosNet:
                  channel_id: str = "ch", batch=None,
                  gateway_cfg: Optional[dict] = None,
                  peer_overrides: Optional[dict] = None,
-                 orderer_overrides: Optional[dict] = None):
+                 orderer_overrides: Optional[dict] = None,
+                 node_factory=None):
         from fabric_tpu.node.provision import provision_network
         self.base_dir = str(base_dir)
         self.channel_id = channel_id
@@ -59,6 +60,10 @@ class ChaosNet:
             "broadcast_deadline_s": 20.0}
         self.peer_overrides = dict(peer_overrides or {})
         self.orderer_overrides = dict(orderer_overrides or {})
+        # optional hook: callable(name, kind, cfg) -> node | None.  A
+        # non-None return replaces the stock node — how adversarial
+        # actors (testing/adversary.py) join a drill topology.
+        self.node_factory = node_factory
         # name -> (kind, cfg-path); insertion order = start order
         self._specs: Dict[str, Tuple[str, str]] = {}
         for p in self.paths["orderers"]:
@@ -79,12 +84,18 @@ class ChaosNet:
         with open(path) as f:
             cfg = json.load(f)
         if kind == "orderer":
-            from fabric_tpu.node.orderer import OrdererNode
             cfg.update(self.orderer_overrides)
+        else:
+            cfg["gateway"] = dict(self.gateway_cfg)
+            cfg.update(self.peer_overrides)
+        if self.node_factory is not None:
+            node = self.node_factory(name, kind, cfg)
+            if node is not None:
+                return node
+        if kind == "orderer":
+            from fabric_tpu.node.orderer import OrdererNode
             return OrdererNode(cfg, data_dir=cfg["data_dir"])
         from fabric_tpu.node.peer import PeerNode
-        cfg["gateway"] = dict(self.gateway_cfg)
-        cfg.update(self.peer_overrides)
         return PeerNode(cfg, data_dir=cfg["data_dir"])
 
     def start(self, leader_timeout_s: float = 60.0) -> "ChaosNet":

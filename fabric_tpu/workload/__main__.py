@@ -126,9 +126,16 @@ def main(argv=None) -> int:
         prog="python -m fabric_tpu.workload",
         description="open-loop workload scenarios against an in-process "
                     "network")
+    from fabric_tpu.workload import scenarios as _scenarios
     ap.add_argument("--scenario", default="ramp",
                     choices=["poisson", "diurnal", "burst", "ramp",
-                             "stampede", "reconnect-storm"])
+                             "stampede", "reconnect-storm"]
+                    + _scenarios.list_scenarios(),
+                    help="load-shape scenarios run a single peer under "
+                         "admission pressure; catalog scenarios "
+                         f"({', '.join(_scenarios.list_scenarios())}) "
+                         "run full adversarial topologies with in-run "
+                         "SLO assertions")
     ap.add_argument("--rate", type=float, default=30.0,
                     help="nominal offered rate (tx/s)")
     ap.add_argument("--duration", type=float, default=12.0,
@@ -160,7 +167,28 @@ def main(argv=None) -> int:
                     help="track commit status for every k-th tx only "
                          "(keeps the driver open-loop at high rates)")
     ap.add_argument("--json-out", help="write the report here too")
+    ap.add_argument("--save-trace",
+                    help="append every fired arrival offset to this "
+                         "jsonl file (replay later with a "
+                         '{"kind": "trace", "path": ...} arrival spec)')
+    ap.add_argument("--strict", action="store_true",
+                    help="catalog scenarios: exit non-zero when an "
+                         "in-run SLO assertion fails")
     args = ap.parse_args(argv)
+
+    if args.scenario in _scenarios.SCENARIOS:
+        try:
+            report = _scenarios.run_scenario(
+                args.scenario, seed=args.seed,
+                report_path=args.json_out, strict=args.strict)
+        except _scenarios.ScenarioFailure as exc:
+            print(f"SLO FAILED: {exc}", file=sys.stderr)
+            return 1
+        print(json.dumps(report, indent=2, default=str))
+        slo = report.get("slo", {})
+        print(f"slo: {'PASS' if slo.get('pass') else 'FAIL'} "
+              f"({slo.get('checks', 0)} checks)", file=sys.stderr)
+        return 0
 
     init_factories(FactoryOpts(default="SW"))
     # aggressive admission thresholds: a dozen-second run must traverse
@@ -217,7 +245,8 @@ def main(argv=None) -> int:
             clients, mix, phases, signer=signer, prepare=prepare,
             workers=args.workers, seed=args.seed,
             track_commits=not args.no_commits,
-            commit_every=args.commit_every)
+            commit_every=args.commit_every,
+            save_trace=args.save_trace)
 
         storm = None
         if args.scenario == "reconnect-storm":

@@ -159,7 +159,8 @@ class WorkloadRunner:
                  commit_timeout_s: float = 30.0,
                  track_commits: bool = True,
                  commit_every: int = 1,
-                 drain_timeout_s: float = 45.0):
+                 drain_timeout_s: float = 45.0,
+                 save_trace: Optional[str] = None):
         self.clients = clients
         self.mix = mix
         self.phases = list(phases)
@@ -176,6 +177,9 @@ class WorkloadRunner:
         # open loop at overload rates
         self.commit_every = max(1, int(commit_every))
         self.drain_timeout_s = float(drain_timeout_s)
+        # jsonl arrival trace: one {"phase", "i", "t"} line per fire
+        # offset, replayable via {"kind": "trace", "path": ...}
+        self.save_trace = save_trace
         self._jobs: "queue.Queue" = queue.Queue()
         self._outstanding = 0
         self._out_lock = threading.Lock()
@@ -200,7 +204,10 @@ class WorkloadRunner:
         fn, args = self._call_shape(op)
         sp, responses = gw.endorse(op.chaincode, fn, args,
                                    channel=op.channel)
-        return assemble_transaction(sp, responses, self.signer)
+        # the envelope signature must come from the proposal's creator:
+        # mixed-identity populations carry per-connection signers
+        return assemble_transaction(
+            sp, responses, getattr(gw, "signer", None) or self.signer)
 
     def _execute(self, job: _Job) -> None:
         st = job.stats
@@ -328,6 +335,12 @@ class WorkloadRunner:
             schedule = proc.schedule(duration)
         stats = PhaseStats(name, duration, len(schedule))
         self.phase_stats.append(stats)
+        if self.save_trace:
+            import json as _json
+            with open(self.save_trace, "a") as tf:
+                for i, t in enumerate(schedule):
+                    tf.write(_json.dumps(
+                        {"phase": name, "i": i, "t": round(t, 6)}) + "\n")
 
         # pool mode: pre-endorse one envelope per scheduled arrival so
         # the open-loop phase pays ONLY admission+ordering per fire

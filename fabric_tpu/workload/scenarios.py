@@ -1,0 +1,562 @@
+"""Declarative adversarial scenario catalog: WAN shapes x Byzantine
+actors x open-loop load, as plain dict specs.
+
+Each scenario is ONE dict (no YAML, no DSL) composing the fault planes
+this framework already owns:
+
+  topology        ChaosNet shape: orderer count, peer orgs, peers/org
+  links           per-link latency/loss matrix keyed "src->dst" (src =
+                  dialing identity's mspid pattern, dst = "host:port"
+                  pattern), compiled via FaultPlan.links — direction
+                  matters, asymmetric WAN paths are two entries
+  link_schedule   FaultSchedule kwargs enveloping every link rule
+                  (windowed partitions, bursts riding the load burst)
+  partition       {"org": ..., "window": [start_s, end_s]} — drop ALL
+                  frames dialed by that org's identities inside the
+                  window (a crash-stop org-level netsplit; heals and
+                  must catch up via anti-entropy)
+  adversaries     {"orderer1": crimes} -> testing.adversary actors that
+                  LIE (equivocating deliver streams, tampered
+                  attestation digests) behind real consenter keys
+  poison          gossip-intake injection counts for a victim peer
+                  (garbage / bad_sig / stale / one forged fork block)
+  identity_blend  client creator mix over the signature schemes the MSP
+                  accepts ({"p256": w, "ed25519": w}); idemix creators
+                  are validated end-to-end by the idemix test lane —
+                  channel-config idemix enrollment is a roadmap item
+  phases          open-loop arrival phases (workload.runner format)
+  expect          in-run SLO assertions, evaluated before the report is
+                  written: convergence, quarantine counts BY REASON,
+                  zero-quarantine guarantees for crash-stop-only runs,
+                  shed/commit bounds, exactly-once (no duplicate txid
+                  ever committed)
+
+Every run is seeded end to end (arrival schedules, fault draws, zipf
+keys) and writes a JSON report artifact next to its data dir (or at
+`report_path`), so a scenario is a reproducible experiment:
+
+    env JAX_PLATFORMS=cpu python -m fabric_tpu.workload \
+        --scenario equivocation --seed 7
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+import threading
+import time
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("fabric_tpu.workload.scenarios")
+
+__all__ = ["SCENARIOS", "list_scenarios", "run_scenario",
+           "ScenarioFailure"]
+
+
+class ScenarioFailure(AssertionError):
+    """Raised in strict mode when a scenario's `expect` block fails."""
+
+
+# ---------------------------------------------------------------------------
+# the catalog
+
+SCENARIOS: Dict[str, dict] = {
+    "geo-wan": {
+        "description": "three regions on asymmetric WAN links (slow "
+                       "trans-oceanic return paths, light loss); "
+                       "diurnal load; everything honest — latency "
+                       "reshapes tails, never safety",
+        "topology": {"n_orderers": 3, "peer_orgs": ["Org1", "Org2"],
+                     "peers_per_org": 1},
+        "links": {
+            "Org1->*": {"latency_s": 0.010, "loss": 0.005},
+            "Org2->*": {"latency_s": 0.030, "loss": 0.01},
+            "OrdererMSP->*": {"latency_s": 0.005, "loss": 0.0},
+        },
+        "phases": [
+            {"name": "diurnal", "duration_s": 8.0,
+             "arrivals": {"kind": "diurnal", "base_rate": 12.0,
+                          "amplitude": 0.7, "period_s": 4.0}},
+        ],
+        "expect": [
+            {"kind": "converged", "min_height": 2},
+            {"kind": "zero_quarantines"},
+            {"kind": "min_committed", "value": 1},
+        ],
+    },
+    "equivocation": {
+        "description": "orderer1 double-serves a forged, validly-signed "
+                       "sibling at height 3 mid-ramp; every honest peer "
+                       "must convict the signer from its witness, "
+                       "persist a fraud proof, and converge exactly-once "
+                       "on the honest chain",
+        "topology": {"n_orderers": 3, "peer_orgs": ["Org1", "Org2"],
+                     "peers_per_org": 1},
+        "adversaries": {"orderer1": {"mode": "equivocate",
+                                     "fork_height": 3, "count": 2}},
+        "phases": [
+            {"name": "ramp", "duration_s": 8.0,
+             "arrivals": {"kind": "ramp", "start_rate": 4.0,
+                          "end_rate": 20.0, "ramp_s": 6.0}},
+        ],
+        "expect": [
+            {"kind": "converged", "min_height": 4},
+            {"kind": "quarantine", "reasons": ["fork", "equivocation"],
+             "min": 1, "on": "all_peers"},
+            {"kind": "fraud_proofs", "min": 1, "on": "all_peers"},
+            {"kind": "exactly_once"},
+            {"kind": "min_committed", "value": 1},
+        ],
+    },
+    "gossip-poison": {
+        "description": "a fake gossip endpoint floods one peer's intake "
+                       "with garbage and tampered-signature payloads, "
+                       "then injects a forged fork of a committed block; "
+                       "the relay is score-quarantined, the forger "
+                       "convicted, the ledger untouched",
+        "topology": {"n_orderers": 3, "peer_orgs": ["Org1", "Org2"],
+                     "peers_per_org": 1},
+        "poison": {"victim": ["Org1", 0], "at_height": 2,
+                   "garbage": 2, "bad_sig": 2, "stale": 3, "fork": True},
+        "phases": [
+            {"name": "steady", "duration_s": 8.0,
+             "arrivals": {"kind": "constant", "rate": 10.0}},
+        ],
+        "expect": [
+            {"kind": "converged", "min_height": 3},
+            {"kind": "quarantine", "reasons": ["poison"], "min": 1,
+             "on": "any_peer"},
+            {"kind": "quarantine", "reasons": ["fork"], "min": 1,
+             "on": "any_peer"},
+            {"kind": "exactly_once"},
+            {"kind": "min_committed", "value": 1},
+        ],
+    },
+    "tampered-attestation": {
+        "description": "orderer1 serves honest blocks but flips the "
+                       "verdict-attestation digests riding its deliver "
+                       "frames; the round-9 trust registry catches the "
+                       "mismatch, the byzantine plane records the "
+                       "conviction, peers re-verify and converge",
+        "topology": {"n_orderers": 3, "peer_orgs": ["Org1", "Org2"],
+                     "peers_per_org": 1},
+        "adversaries": {"orderer1": {"mode": "tamper_attests",
+                                     "fork_height": 2}},
+        "phases": [
+            {"name": "steady", "duration_s": 8.0,
+             "arrivals": {"kind": "constant", "rate": 10.0}},
+        ],
+        "expect": [
+            {"kind": "converged", "min_height": 3},
+            {"kind": "quarantine", "reasons": ["tampered_attestation"],
+             "min": 1, "on": "any_peer"},
+            {"kind": "min_committed", "value": 1},
+        ],
+    },
+    "mixed-identity": {
+        "description": "P-256 and ed25519 creators blended through one "
+                       "gateway under bursty load — the MSP's multi-"
+                       "scheme acceptance exercised at traffic level, "
+                       "zero quarantines expected",
+        "topology": {"n_orderers": 1, "peer_orgs": ["Org1"],
+                     "peers_per_org": 1},
+        "identity_blend": {"p256": 0.5, "ed25519": 0.5},
+        "mode": "inline",
+        "phases": [
+            {"name": "bursts", "duration_s": 8.0,
+             "arrivals": {"kind": "burst", "low_rate": 3.0,
+                          "high_rate": 12.0, "period_s": 3.0,
+                          "duty": 0.4}},
+        ],
+        "expect": [
+            {"kind": "converged", "min_height": 2},
+            {"kind": "zero_quarantines"},
+            {"kind": "exactly_once"},
+            {"kind": "min_committed", "value": 1},
+        ],
+    },
+    "burst-partition": {
+        "description": "square-wave bursts while Org2's outbound links "
+                       "black-hole for a mid-run window (crash-stop "
+                       "netsplit, nobody lies): the partitioned peer "
+                       "falls behind, heals, anti-entropy catches it up "
+                       "— and the byzantine plane must stay SILENT",
+        "topology": {"n_orderers": 3, "peer_orgs": ["Org1", "Org2"],
+                     "peers_per_org": 1},
+        "partition": {"org": "Org2", "window": [2.0, 5.0]},
+        "phases": [
+            {"name": "bursts", "duration_s": 8.0,
+             "arrivals": {"kind": "burst", "low_rate": 4.0,
+                          "high_rate": 16.0, "period_s": 4.0,
+                          "duty": 0.35}},
+        ],
+        "expect": [
+            {"kind": "converged", "min_height": 2,
+             "timeout_s": 45.0},
+            {"kind": "zero_quarantines"},
+            {"kind": "min_committed", "value": 1},
+        ],
+    },
+}
+
+
+def list_scenarios() -> List[str]:
+    return sorted(SCENARIOS)
+
+
+# ---------------------------------------------------------------------------
+# spec -> fault plan
+
+def build_plan(spec: dict, seed: int):
+    """Compile a scenario's links/partition into one installed-ready
+    FaultPlan (or None when the spec declares neither)."""
+    from fabric_tpu.comm.faults import FaultPlan, FaultSchedule
+    links = spec.get("links")
+    part = spec.get("partition")
+    if not links and not part:
+        return None
+    plan = FaultPlan(seed=seed * 977 + 5)
+    if links:
+        matrix = {}
+        for key, props in links.items():
+            src, _, dst = key.partition("->")
+            matrix[(src, dst or "*")] = props
+        plan.links(matrix, schedule=spec.get("link_schedule"))
+    if part:
+        lo, hi = part.get("window", [0.0, 3.0])
+        plan.rule(src=str(part.get("org", "*")), drop=1.0,
+                  schedule=FaultSchedule(kind="window", start_s=float(lo),
+                                         end_s=float(hi)))
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# gossip poisoning injection
+
+def _poison_thread(net, spec: dict, sent: dict) -> threading.Thread:
+    """Background injector: waits for the victim to commit past
+    `at_height`, then lands the configured offenses + one forged fork
+    block (signed with a real consenter key pulled from an orderer)."""
+    pcfg = dict(spec.get("poison") or {})
+    org, idx = pcfg.get("victim", ["Org1", 0])
+
+    def _run() -> None:
+        from fabric_tpu.testing.adversary import (
+            GossipPoisoner, forge_fork_block)
+        deadline = time.time() + 30.0
+        victim = None
+        at = int(pcfg.get("at_height", 2))
+        while time.time() < deadline:
+            peers = [p for n, p in net.nodes.items()
+                     if net._specs[n][0] == "peer"
+                     and n.startswith(f"peer{org}")]
+            if peers and idx < len(peers):
+                ch = peers[idx].channels[net.channel_id]
+                if ch.ledger.height > at:
+                    victim = ch
+                    break
+            time.sleep(0.1)
+        if victim is None:
+            logger.warning("poison: victim never reached height %d", at)
+            return
+        poisoner = GossipPoisoner(victim)
+        # fork first: once the offense flood quarantines the relay,
+        # its frames are pre-dropped at intake and never reach the
+        # witness — the forger must be convicted while the relay is
+        # still being heard
+        if pcfg.get("fork"):
+            orderer = net.orderers()[0]
+            forged = forge_fork_block(
+                victim.ledger.blockstore, at, orderer.signer)
+            poisoner.inject(forged)
+        poisoner.garbage(int(pcfg.get("garbage", 0)))
+        poisoner.bad_sig(int(pcfg.get("bad_sig", 0)))
+        poisoner.stale(int(pcfg.get("stale", 0)))
+        sent.update(poisoner.sent)
+
+    t = threading.Thread(target=_run, name="scenario-poison", daemon=True)
+    t.start()
+    return t
+
+
+# ---------------------------------------------------------------------------
+# byzantine state collection + SLO evaluation
+
+def _byz_state(net) -> dict:
+    out = {}
+    for name, node in net.nodes.items():
+        byz = getattr(node, "byzantine", None)
+        if byz is None:
+            continue
+        chans = {}
+        for cid, ch in getattr(node, "channels", {}).items():
+            mon = getattr(ch, "byz_monitor", None)
+            if mon is not None:
+                chans[cid] = mon.snapshot()
+        out[name] = {"quarantined": byz.count(),
+                     "reasons": byz.reasons(),
+                     "identities": sorted(byz.snapshot()),
+                     "channels": chans}
+    return out
+
+
+def _committed_txids(peer, channel_id: str) -> List[str]:
+    """Every txid committed on one peer, in block order — the raw
+    material of the exactly-once assertion."""
+    from fabric_tpu.protocol.types import Envelope
+    store = peer.channels[channel_id].ledger.blockstore
+    txids: List[str] = []
+    for num in range(store.height):
+        for raw in store.get_by_number(num).data:
+            try:
+                hdr = Envelope.deserialize(bytes(raw)).header()
+                txid = hdr.channel_header.txid
+            except Exception:
+                continue
+            if txid:
+                txids.append(txid)
+    return txids
+
+
+def _check_expectations(spec: dict, net, report: dict) -> List[str]:
+    """Evaluate the `expect` block; returns human-readable violations
+    (empty = all SLOs held)."""
+    violations: List[str] = []
+    byz = report["byzantine"]
+    peers = {n: s for n, s in byz.items()}
+    tot = report.get("totals", {})
+    for check in spec.get("expect", []):
+        kind = check["kind"]
+        if kind == "converged":
+            ok = net.wait_converged(
+                timeout_s=float(check.get("timeout_s", 30.0)),
+                min_height=check.get("min_height"))
+            report["converged"] = ok
+            report["heights"] = net.heights()
+            if not ok:
+                violations.append(
+                    f"converged: peers diverged or stalled "
+                    f"(heights={net.heights()})")
+        elif kind == "zero_quarantines":
+            noisy = {n: s["reasons"] for n, s in peers.items()
+                     if s["quarantined"]}
+            if noisy:
+                violations.append(
+                    f"zero_quarantines: false positives {noisy}")
+        elif kind == "quarantine":
+            reasons = check.get("reasons", [])
+            need = int(check.get("min", 1))
+            hits = {n: sum(s["reasons"].get(r, 0) for r in reasons)
+                    for n, s in peers.items()}
+            quorum = (all if check.get("on", "any_peer") == "all_peers"
+                      else any)
+            if not peers or not quorum(v >= need for v in hits.values()):
+                violations.append(
+                    f"quarantine[{','.join(reasons)}]: wanted >={need} "
+                    f"on {check.get('on', 'any_peer')}, got {hits}")
+        elif kind == "fraud_proofs":
+            need = int(check.get("min", 1))
+            hits = {n: sum(c.get("fraud_proofs", 0)
+                           for c in s["channels"].values())
+                    for n, s in peers.items()}
+            quorum = (all if check.get("on", "any_peer") == "all_peers"
+                      else any)
+            if not peers or not quorum(v >= need for v in hits.values()):
+                violations.append(
+                    f"fraud_proofs: wanted >={need}, got {hits}")
+        elif kind == "min_committed":
+            if tot.get("committed", 0) < int(check["value"]):
+                violations.append(
+                    f"min_committed: {tot.get('committed', 0)} < "
+                    f"{check['value']}")
+        elif kind == "max_shed_frac":
+            if tot.get("shed_frac", 0.0) > float(check["value"]):
+                violations.append(
+                    f"max_shed_frac: {tot.get('shed_frac')} > "
+                    f"{check['value']}")
+        elif kind == "exactly_once":
+            dup_peers = {}
+            for name, node in net.nodes.items():
+                if net._specs[name][0] != "peer":
+                    continue
+                txids = _committed_txids(node, net.channel_id)
+                if len(txids) != len(set(txids)):
+                    dup_peers[name] = len(txids) - len(set(txids))
+            report["exactly_once"] = not dup_peers
+            if dup_peers:
+                violations.append(
+                    f"exactly_once: duplicate commits {dup_peers}")
+        else:
+            violations.append(f"unknown expect kind {kind!r}")
+    return violations
+
+
+# ---------------------------------------------------------------------------
+# the runner
+
+def run_scenario(name: str, seed: int = 7,
+                 base_dir: Optional[str] = None,
+                 report_path: Optional[str] = None,
+                 strict: bool = False) -> dict:
+    """Provision, attack, load, assert, report.
+
+    Returns the report dict (also written as a JSON artifact).  With
+    `strict=True` a failed `expect` block raises ScenarioFailure AFTER
+    the artifact is written — the evidence survives the assertion.
+    """
+    spec = SCENARIOS.get(name)
+    if spec is None:
+        raise KeyError(f"unknown scenario {name!r} "
+                       f"(one of {list_scenarios()})")
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    from fabric_tpu.comm import faults
+    from fabric_tpu.gateway import GatewayClient
+    from fabric_tpu.node.orderer import load_signing_identity
+    from fabric_tpu.testing.chaos import ChaosNet
+    from fabric_tpu.workload.clients import ClientPopulation
+    from fabric_tpu.workload.keyspace import TrafficMix
+    from fabric_tpu.workload.runner import WorkloadRunner
+
+    init_factories(FactoryOpts(default="SW"))
+    own_tmp = None
+    if base_dir is None:
+        own_tmp = tempfile.TemporaryDirectory(prefix=f"scenario_{name}_")
+        base_dir = own_tmp.name
+        if report_path is None:
+            # the artifact must outlive the scratch network dir
+            report_path = os.path.join(
+                tempfile.gettempdir(), f"scenario_{name}_report.json")
+    report: dict = {"scenario": name, "seed": seed,
+                    "description": spec.get("description", ""),
+                    "spec": {k: v for k, v in spec.items()
+                             if k != "description"}}
+
+    factory = None
+    adversaries = spec.get("adversaries")
+    if adversaries:
+        from fabric_tpu.testing.adversary import adversary_factory
+        factory = adversary_factory(adversaries)
+    topo = dict(spec.get("topology", {}))
+    net = ChaosNet(base_dir,
+                   n_orderers=int(topo.get("n_orderers", 3)),
+                   peer_orgs=tuple(topo.get("peer_orgs", ["Org1"])),
+                   peers_per_org=int(topo.get("peers_per_org", 1)),
+                   node_factory=factory)
+    plan = build_plan(spec, seed)
+    poison_sent: dict = {}
+    clients = None
+    try:
+        net.start()
+        if plan is not None:
+            faults.install(plan)
+        poison = (None if not spec.get("poison")
+                  else _poison_thread(net, spec, poison_sent))
+
+        # -- client population (identity blend over schemes) ----------
+        org = list(topo.get("peer_orgs", ["Org1"]))[0]
+        blend = dict(spec.get("identity_blend") or {"p256": 1.0})
+        signers = {}
+        with open(net.paths["clients"][org]) as f:
+            cc = json.load(f)
+        signers["p256"] = load_signing_identity(
+            cc["mspid"], cc["cert_pem"].encode(), cc["key_pem"].encode())
+        if blend.get("ed25519"):
+            with open(net.paths["clients_ed25519"][org]) as f:
+                ce = json.load(f)
+            signers["ed25519"] = load_signing_identity(
+                ce["mspid"], ce["cert_pem"].encode(),
+                ce["key_pem"].encode())
+        sockets = 6
+        total_w = sum(blend.values()) or 1.0
+        ed_slots = int(round(sockets * blend.get("ed25519", 0.0)
+                             / total_w))
+        peer = net.peers()[0]
+
+        def _factory(slot: int):
+            scheme = "ed25519" if slot < ed_slots else "p256"
+            return GatewayClient(peer.rpc.addr, signers[scheme],
+                                 peer.msps, channel_id=net.channel_id,
+                                 seed=seed * 1000 + slot,
+                                 call_timeout=30.0)
+
+        clients = ClientPopulation(512, sockets, factory=_factory,
+                                   seed=seed)
+        clients.warm()
+
+        traffic = dict(spec.get("traffic", {}))
+        mix = TrafficMix([{
+            "channel": net.channel_id, "chaincode": "assets",
+            "weight": 1.0, "keys": int(traffic.get("keys", 64)),
+            "zipf_s": float(traffic.get("zipf_s", 1.0)),
+            "blend": traffic.get("blend", {"read": 0.1, "write": 0.9}),
+        }], seed=seed)
+
+        prepare = None
+        prep_gw = None
+        if spec.get("mode", "pool") == "pool":
+            from fabric_tpu.endorser.proposal import assemble_transaction
+            prep_gw = GatewayClient(peer.rpc.addr, signers["p256"],
+                                    peer.msps, channel_id=net.channel_id,
+                                    shed_retry_max=0)
+
+            def prepare(op):
+                fn, args = WorkloadRunner._call_shape(op)
+                sp, responses = prep_gw.endorse(op.chaincode, fn, args,
+                                                channel=op.channel)
+                return assemble_transaction(sp, responses,
+                                            signers["p256"])
+
+        runner = WorkloadRunner(clients, mix, list(spec["phases"]),
+                                signer=signers["p256"], prepare=prepare,
+                                workers=8, seed=seed)
+        report.update(runner.run())
+        if prep_gw is not None:
+            prep_gw.close()
+        if poison is not None:
+            poison.join(timeout=30.0)
+            report["poison_sent"] = dict(poison_sent)
+        if plan is not None:
+            faults.uninstall()
+            plan = None
+
+        # -- post-run evidence + SLO evaluation ------------------------
+        report["byzantine"] = _byz_state(net)
+        crimes = {}
+        for n, node in net.nodes.items():
+            cc_list = getattr(node, "crimes_committed", None)
+            if cc_list:
+                crimes[n] = list(cc_list)
+        if crimes:
+            report["crimes"] = crimes
+        for p in net.peers():
+            if getattr(p, "slo", None) is not None:
+                report.setdefault("slo_alerts", {})[
+                    p.name if hasattr(p, "name") else "peer"] = \
+                    p.slo.alerts_snapshot()
+                break
+        violations = _check_expectations(spec, net, report)
+        report["slo"] = {"pass": not violations,
+                         "checks": len(spec.get("expect", [])),
+                         "violations": violations}
+    finally:
+        if plan is not None:
+            faults.uninstall()
+        if clients is not None:
+            clients.close()
+        net.stop_all()
+        out = report_path or os.path.join(
+            base_dir, f"scenario_{name}_report.json")
+        try:
+            with open(out, "w") as f:
+                json.dump(report, f, indent=2, default=str, sort_keys=True)
+            report["report_path"] = out
+        except OSError:
+            logger.exception("scenario report not written: %s", out)
+        if own_tmp is not None:
+            own_tmp.cleanup()
+    violations = report.get("slo", {}).get("violations")
+    if strict and violations:
+        raise ScenarioFailure(f"{name}: " + "; ".join(violations))
+    return report

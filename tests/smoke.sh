@@ -58,6 +58,9 @@ python tests/smoke_device_validate.py
 echo "== snapshot rejoin drill (wiped peer, faulted transfer, tail-bounded) =="
 python tests/smoke_snapshot.py
 
+echo "== byzantine scenario drills (equivocation containment + crash-stop control) =="
+python tests/smoke_scenarios.py
+
 echo "== ASan/UBSan fuzz corpus vs the native wire parser =="
 # Build _fastparse with the sanitizers and drive the full adversarial
 # corpus (tests/test_fastparse.py --asan-corpus) through it: any heap

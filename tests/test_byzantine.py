@@ -1,0 +1,339 @@
+"""Byzantine containment plane units: witness log, quarantine registry,
+fraud proofs, the intake monitor's verdicts, the attribution policy
+(signers are convicted, relays only scored), and the ops surfaces.
+
+The end-to-end drills (equivocating orderer under open-loop load, WAN +
+poison scenarios) live in tests/smoke_scenarios.py; these tests pin the
+judgment logic itself with hand-built evidence.
+"""
+
+import json
+import os
+
+import pytest
+
+from fabric_tpu.byzantine import (
+    ByzantineMonitor,
+    QuarantineRegistry,
+    WitnessLog,
+    build_fraud_proof,
+    verify_fraud_proof,
+)
+from fabric_tpu.byzantine.monitor import (
+    VERDICT_ADMIT,
+    VERDICT_HOLD,
+    VERDICT_REJECT,
+    VERDICT_STALE,
+)
+
+
+# ---------------------------------------------------------------------------
+# fixtures: one orderer org, signed blocks built the blockwriter way
+
+@pytest.fixture(scope="module")
+def org():
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    from fabric_tpu.msp.ca import DevOrg
+    init_factories(FactoryOpts(default="SW"))
+    return DevOrg("OrdererOrg")
+
+
+@pytest.fixture(scope="module")
+def msps(org):
+    from fabric_tpu.msp import CachedMSP
+    return {"OrdererOrg": CachedMSP(org.msp())}
+
+
+@pytest.fixture(scope="module")
+def signers(org):
+    return [org.new_identity(f"osn{i}") for i in range(3)]
+
+
+def _signed_block(num, prev, data, signer, last_config=0):
+    """A block signed exactly the way BlockWriter signs its own copy."""
+    from fabric_tpu.orderer.blockwriter import block_signed_bytes
+    from fabric_tpu.protocol.build import new_nonce
+    from fabric_tpu.protocol.types import (
+        META_LAST_CONFIG, META_SIGNATURES, Block, BlockHeader,
+        BlockMetadata, block_data_hash)
+    header = BlockHeader(num, prev, block_data_hash(data))
+    blk = Block(header, list(data),
+                BlockMetadata({META_LAST_CONFIG: last_config}))
+    sig_header = {"creator": signer.serialize(), "nonce": new_nonce()}
+    blk.metadata.items[META_SIGNATURES] = [{
+        "sig_header": sig_header,
+        "signature": signer.sign(
+            block_signed_bytes(blk, sig_header, last_config))}]
+    return blk
+
+
+def _binding(signer):
+    from fabric_tpu.orderer.cluster import cert_fingerprint
+    return f"{signer.mspid}|{cert_fingerprint(signer.cert)}"
+
+
+class _LedgerStub:
+    """What the monitor needs of a ledger: height + blockstore lookup."""
+
+    def __init__(self):
+        self.blocks = {}
+
+    @property
+    def height(self):
+        return max(self.blocks) + 1 if self.blocks else 0
+
+    @property
+    def blockstore(self):
+        return self
+
+    def get_by_number(self, num):
+        return self.blocks[num]
+
+
+def _monitor(tmp_path, msps, signer, ledger=None, threshold=3,
+             quorum=2, tag=""):
+    q = QuarantineRegistry(str(tmp_path / f"q{tag}.json"),
+                           score_threshold=threshold)
+    w = WitnessLog(str(tmp_path / f"w{tag}.json"))
+    mon = ByzantineMonitor("ch", w, q, ledger=ledger, msps=msps,
+                           signer=signer,
+                           proof_dir=str(tmp_path / f"proofs{tag}"),
+                           confirm_quorum=quorum)
+    return mon, q, w
+
+
+# ---------------------------------------------------------------------------
+# quarantine registry
+
+def test_quarantine_persists_and_counts(tmp_path):
+    path = str(tmp_path / "q.json")
+    q = QuarantineRegistry(path)
+    assert not q.is_quarantined("x") and not q.is_quarantined(None)
+    assert q.quarantine("x", "fork") is True
+    assert q.quarantine("x", "fork") is False       # already in
+    assert q.is_quarantined("x")
+    assert q.count() == 1 and q.reasons() == {"fork": 1}
+    # a fresh registry over the same file sees the same state
+    q2 = QuarantineRegistry(path)
+    assert q2.is_quarantined("x") and q2.count() == 1
+
+
+def test_offense_score_crosses_threshold_to_poison(tmp_path):
+    q = QuarantineRegistry(str(tmp_path / "q.json"), score_threshold=3)
+    q.offense("gossip|evil:0", "garbage")
+    q.offense("gossip|evil:0", "bad_sig")
+    assert not q.is_quarantined("gossip|evil:0")
+    q.offense("gossip|evil:0", "garbage")
+    assert q.is_quarantined("gossip|evil:0")
+    assert q.reasons().get("poison") == 1
+
+
+def test_quarantine_metric_reflects_reasons(tmp_path):
+    from fabric_tpu.ops_plane import registry
+    series = registry.counter("byzantine_quarantines_total")
+    before = series.total()
+    before_eq = series.value(reason="equivocation")
+    q = QuarantineRegistry(str(tmp_path / "q.json"))
+    q.quarantine("a", "equivocation")
+    q.quarantine("b", "fork")
+    q.quarantine("b", "fork")           # repeat: no second bump
+    assert series.total() == before + 2
+    assert series.value(reason="equivocation") == before_eq + 1
+
+
+# ---------------------------------------------------------------------------
+# witness log
+
+def test_witness_vouch_dispute_confirm_roundtrip(tmp_path):
+    path = str(tmp_path / "w.json")
+    w = WitnessLog(path)
+    ent = w.vouch(5, "aa", "src1", ["s1"])
+    assert list(ent["hashes"]) == ["aa"] and not w.disputed_heights()
+    ent = w.vouch(5, "bb", "src2", ["s2"])
+    assert sorted(ent["hashes"]) == ["aa", "bb"]
+    assert w.disputed_heights() == [5]
+    w.confirm(5, "aa")
+    assert w.get(5)["confirmed"] == "aa"
+    w.flush()
+    w2 = WitnessLog(path)
+    assert w2.get(5)["confirmed"] == "aa"
+    assert sorted(w2.get(5)["hashes"]) == ["aa", "bb"]
+
+
+def test_witness_prune_below_keeps_tail(tmp_path):
+    w = WitnessLog(str(tmp_path / "w.json"), keep_tail=1)
+    w.vouch(1, "aa", "s", [])
+    w.vouch(2, "bb", "s", [])
+    w.prune_below(3)            # floor = 3 - keep_tail: 1 goes, 2 stays
+    assert w.get(1) is None
+    assert w.get(2) is not None
+
+
+# ---------------------------------------------------------------------------
+# fraud proofs
+
+def test_fraud_proof_roundtrip_and_tamper(msps, signers):
+    proof = build_fraud_proof("ch", 7, "OrdererOrg|deadbeef",
+                              "equivocation",
+                              {"hashes": ["aa", "bb"]}, signers[0])
+    assert verify_fraud_proof(proof, msps)
+    forged = dict(proof, accused="OrdererOrg|innocent")
+    assert not verify_fraud_proof(forged, msps)
+    assert not verify_fraud_proof({}, msps)
+
+
+# ---------------------------------------------------------------------------
+# monitor verdicts
+
+def test_committed_height_stale_vs_fork(tmp_path, msps, signers):
+    ledger = _LedgerStub()
+    committed = _signed_block(0, b"\x00" * 32, [b"tx"], signers[0])
+    ledger.blocks[0] = committed
+    mon, q, _ = _monitor(tmp_path, msps, signers[0], ledger=ledger)
+    assert mon.check_block(committed, "gossip|p:1") == VERDICT_STALE
+    # a validly-signed sibling off the committed chain convicts its
+    # signer — NOT the relay that forwarded it
+    from fabric_tpu.testing.adversary import forge_sibling
+    forged = forge_sibling(committed, signers[1])
+    assert mon.check_block(forged, "gossip|p:1") == VERDICT_REJECT
+    assert q.is_quarantined(_binding(signers[1]))
+    assert not q.is_quarantined("gossip|p:1")
+    assert q.reasons() == {"fork": 1}
+    assert len(mon.proofs) == 1 and mon.proofs[0]["reason"] == "fork"
+    # proofs persist as JSON artifacts and verify standalone
+    pdir = str(tmp_path / "proofs")
+    names = sorted(os.listdir(pdir))
+    assert names and names[0].startswith("fraud_")
+    with open(os.path.join(pdir, names[0])) as f:
+        assert verify_fraud_proof(json.load(f), msps)
+
+
+def test_equivocation_same_signer_two_hashes(tmp_path, msps, signers):
+    from fabric_tpu.protocol import block_header_hash
+    mon, q, w = _monitor(tmp_path, msps, signers[0])
+    a = _signed_block(3, b"\x01" * 32, [b"tx"], signers[1])
+    b = _signed_block(3, b"\x01" * 32, [b"tx", b"tx"], signers[1])
+    assert mon.check_block(a, "deliver|o1") == VERDICT_ADMIT
+    # the perfect proof: signers[1] signed two headers at one height;
+    # with no other voucher the dispute stays unresolved → HOLD
+    assert mon.check_block(b, "deliver|o1") == VERDICT_HOLD
+    assert q.is_quarantined(_binding(signers[1]))
+    assert q.reasons().get("equivocation") == 1
+    assert len(mon.proofs) == 1
+    assert mon.proofs[0]["reason"] == "equivocation"
+    assert w.disputed_heights() == [3]
+    # drain guard: nothing at a disputed-unresolved height may commit
+    assert not mon.check_commit(a) and not mon.check_commit(b)
+    # a LIVE signer vouching the honest hash resolves the dispute
+    # (rule a: every competitor now has zero live signers)
+    a2 = _signed_block(3, b"\x01" * 32, [b"tx"], signers[2])
+    assert mon.check_block(a2, "deliver|o2") == VERDICT_ADMIT
+    assert w.get(3)["confirmed"] == block_header_hash(a.header).hex()
+    assert mon.check_commit(a) and not mon.check_commit(b)
+    # the repeat conviction produced no second proof
+    assert len(mon.proofs) == 1
+
+
+def test_quorum_confirms_winner_convicts_fork_minority(
+        tmp_path, msps, signers):
+    mon, q, _ = _monitor(tmp_path, msps, signers[0], tag="q")
+    a1 = _signed_block(4, b"\x02" * 32, [b"x"], signers[0])
+    a2 = _signed_block(4, b"\x02" * 32, [b"x"], signers[1])
+    lone = _signed_block(4, b"\x02" * 32, [b"x", b"y"], signers[2])
+    assert mon.check_block(a1, "s1") == VERDICT_ADMIT
+    assert mon.check_block(lone, "s3") == VERDICT_HOLD   # 1v1: unresolved
+    assert not q.count()                                  # nobody convicted yet
+    # second distinct signer on hash A reaches quorum 2 > 1
+    assert mon.check_block(a2, "s2") == VERDICT_ADMIT
+    assert q.is_quarantined(_binding(signers[2]))
+    assert q.reasons().get("fork") == 1
+
+
+def test_solo_vouch_by_quarantined_signer_holds(tmp_path, msps, signers):
+    mon, q, _ = _monitor(tmp_path, msps, signers[0], tag="h")
+    q.quarantine(_binding(signers[1]), "equivocation")
+    blk = _signed_block(9, b"\x03" * 32, [b"z"], signers[1])
+    assert mon.check_block(blk, "s") == VERDICT_HOLD
+
+
+def test_convict_external_and_blocked_source(tmp_path, msps, signers):
+    mon, q, _ = _monitor(tmp_path, msps, signers[0], tag="x")
+    mon.convict_external("OrdererOrg|feedface", "tampered_attestation",
+                         {"block": 4})
+    assert q.reasons().get("tampered_attestation") == 1
+    assert mon.blocked_source("OrdererOrg|feedface")
+    assert not mon.blocked_source("OrdererOrg|other")
+    assert not mon.blocked_source(None)
+    assert len(mon.proofs) == 1
+
+
+def test_monitor_reloads_persisted_proofs(tmp_path, msps, signers):
+    mon, _, _ = _monitor(tmp_path, msps, signers[0], tag="r")
+    mon.convict_external("OrdererOrg|cafe", "fork", {})
+    mon2 = ByzantineMonitor(
+        "ch", WitnessLog(str(tmp_path / "wr2.json")),
+        QuarantineRegistry(str(tmp_path / "qr2.json")),
+        msps=msps, signer=signers[0],
+        proof_dir=str(tmp_path / "proofsr"))
+    assert len(mon2.proofs) == 1
+    assert mon2.proofs[0]["accused"] == "OrdererOrg|cafe"
+
+
+# ---------------------------------------------------------------------------
+# adversarial artifacts
+
+def test_forged_sibling_is_validly_signed_equivocation(msps, signers):
+    from fabric_tpu.orderer import block_signature_items
+    from fabric_tpu.protocol import block_header_hash
+    from fabric_tpu.testing.adversary import break_signature, forge_sibling
+    honest = _signed_block(2, b"\x04" * 32, [b"tx"], signers[0])
+    forged = forge_sibling(honest, signers[1])
+    assert forged.header.number == honest.header.number
+    assert forged.header.previous_hash == honest.header.previous_hash
+    assert (block_header_hash(forged.header)
+            != block_header_hash(honest.header))
+    items = block_signature_items(forged, msps)
+    assert items is not None                # parses + known valid signer
+    from fabric_tpu.bccsp.factory import get_default
+    assert bool(get_default().batch_verify(items).all())
+    # break_signature: parses, but the signature no longer covers the
+    # (tampered) header
+    broken = break_signature(honest)
+    bad = block_signature_items(broken, msps)
+    assert bad is not None
+    assert not bool(get_default().batch_verify(bad).all())
+
+
+# ---------------------------------------------------------------------------
+# ops surfaces: /byzantine view + node.top BYZ column
+
+def test_byzantine_view_and_route(tmp_path, msps, signers):
+    from fabric_tpu.byzantine.ops import byzantine_view, register_ops
+    mon, q, _ = _monitor(tmp_path, msps, signers[0], tag="v")
+    mon.convict_external("OrdererOrg|0ps", "fork", {})
+    view = byzantine_view(q, {"ch": mon})
+    assert view["quarantined"] == 1
+    assert view["reasons"] == {"fork": 1}
+    assert view["identities"]["OrdererOrg|0ps"]["reason"] == "fork"
+    assert view["channels"]["ch"]["fraud_proofs"] == 1
+
+    routes = {}
+
+    class _Ops:
+        def register_route(self, method, path, fn):
+            routes[(method, path)] = fn
+
+    register_ops(_Ops(), q, monitors_fn=lambda: {"ch": mon})
+    status, body = routes[("GET", "/byzantine")]("/byzantine", None)
+    assert status == 200 and body["quarantined"] == 1
+
+
+def test_top_byz_column_formats():
+    from fabric_tpu.node.top import _COLS, _fmt_byz
+    assert "BYZ" in _COLS
+    assert _fmt_byz({"byz_quarantines": None}) == "-"
+    assert _fmt_byz({"byz_quarantines": 0, "byz_reasons": [],
+                     "byz_offenses": 0}) == "0"
+    out = _fmt_byz({"byz_quarantines": 1, "byz_reasons": ["equiv"],
+                    "byz_offenses": 3})
+    assert "1" in out and "equiv" in out

@@ -593,3 +593,25 @@ def test_orderer_breaker_recovers_after_restart(chaos_net, caplog):
     finally:
         client.close()
     assert bc.healthy() is True
+
+
+def test_crash_stop_chaos_yields_zero_quarantines(chaos_net):
+    """The no-false-positive gate: this module's drills threw every
+    crash-stop fault at the topology — dropped/delayed/duplicated/
+    reordered frames, an orderer kill/restart, an orderer blackout —
+    and NONE of that can produce two validly-signed headers at one
+    height, so the byzantine plane must have convicted nobody."""
+    net = chaos_net
+    for peer in net.peers():
+        assert peer.byzantine is not None
+        assert peer.byzantine.count() == 0, peer.byzantine.snapshot()
+        mon = peer.channels[net.channel_id].byz_monitor
+        assert mon is not None
+        assert mon.proofs == []
+        assert mon.witness.disputed_heights() == []
+        # the ops route agrees with the in-process registries
+        code, body = _ops_get(peer, "/byzantine")
+        assert code == 200
+        assert body["quarantined"] == 0
+        assert body["reasons"] == {}
+        assert body["channels"][net.channel_id]["fraud_proofs"] == 0

@@ -1,0 +1,118 @@
+"""Per-link latency/loss matrix units (FaultPlan.links).
+
+The geo-WAN scenarios stand on three properties pinned here: link
+compilation is insertion-order independent (seeded-deterministic),
+direction matters (asymmetric links), and a FaultSchedule envelope
+scales probabilities WITHOUT perturbing the PRNG draw sequence — so a
+windowed partition replays the exact same fault decisions as an
+always-on plan with the same seed.
+"""
+
+from fabric_tpu.comm.faults import FaultPlan, FaultSchedule
+
+
+def _drive(plan, frames):
+    """Apply `frames` = [(method, peer, kind, src)] to `plan`; returns
+    the per-frame delivery counts (0 = dropped, 2 = duplicated)."""
+    out = []
+    for i, (method, peer, kind, src) in enumerate(frames):
+        sent = []
+        plan.apply(i, method, peer, kind,
+                   lambda: sent.append(1), src=src)
+        out.append(len(sent))
+    return out
+
+
+_MATRIX = {
+    ("Org1", "east:*"): {"latency_s": 0.0, "loss": 0.5},
+    ("Org2", "west:*"): {"latency_s": 0.0005, "loss": 0.0},
+}
+
+_FRAMES = [("gossip.msg/gossip.block", "east:7051", "cast", "Org1"),
+           ("deliver", "west:7050", "stream", "Org2"),
+           ("broadcast", "east:7051", "req", "Org1")] * 40
+
+
+def test_link_matrix_seeded_deterministic():
+    a = _drive(FaultPlan(seed=11).links(_MATRIX), _FRAMES)
+    b = _drive(FaultPlan(seed=11).links(_MATRIX), _FRAMES)
+    c = _drive(FaultPlan(seed=12).links(_MATRIX), _FRAMES)
+    assert a == b
+    assert a != c                   # the seed is load-bearing
+    assert 0 in a                   # the lossy link actually dropped
+
+
+def test_link_matrix_compiles_sorted_not_insertion_order():
+    m1 = dict(_MATRIX)
+    m2 = dict(reversed(list(_MATRIX.items())))
+    r1 = [r.as_dict() for r in FaultPlan(seed=3).links(m1).rules]
+    r2 = [r.as_dict() for r in FaultPlan(seed=3).links(m2).rules]
+    assert r1 == r2
+    assert _drive(FaultPlan(seed=3).links(m1), _FRAMES) \
+        == _drive(FaultPlan(seed=3).links(m2), _FRAMES)
+
+
+def test_link_matrix_is_directional():
+    plan = FaultPlan(seed=5).links(
+        {("Org1", "b:*"): {"loss": 1.0}})       # A->B dead, B->A fine
+    a_to_b = _drive(plan, [("deliver", "b:1", "stream", "Org1")] * 5)
+    b_to_a = _drive(plan, [("deliver", "a:1", "stream", "Org2")] * 5)
+    assert a_to_b == [0] * 5
+    assert b_to_a == [1] * 5
+
+
+def test_link_matrix_ignores_untagged_sources():
+    # frames whose channel carries no mspid tag (src="") only match
+    # src="*" rules — a link matrix never faults them
+    plan = FaultPlan(seed=5).links({("Org1", "*"): {"loss": 1.0}})
+    assert _drive(plan, [("deliver", "b:1", "stream", "")] * 5) == [1] * 5
+
+
+def _windowed_plan(seed, start_s, end_s, t):
+    """A link plan whose schedule window is [start_s, end_s), with an
+    injected clock pinned at elapsed time `t`."""
+    plan = FaultPlan(seed=seed, clock=lambda: t)
+    plan.installed_at = 0.0
+    return plan.links(
+        {("Org1", "*"): {"loss": 0.5}},
+        schedule=FaultSchedule(kind="window", start_s=start_s,
+                               end_s=end_s))
+
+
+def test_schedule_window_gates_faults():
+    frames = [("deliver", "b:1", "stream", "Org1")] * 60
+    inside = _drive(_windowed_plan(9, 0.0, 100.0, t=1.0), frames)
+    outside = _drive(_windowed_plan(9, 50.0, 100.0, t=1.0), frames)
+    assert 0 in inside                  # active window: losses fire
+    assert outside == [1] * 60          # outside: factor 0, no faults
+
+
+def test_schedule_does_not_perturb_prng_draws():
+    # a candidate action with p > 0 consumes exactly one draw even at
+    # factor 0 — so the PRNG state after N frames is identical in and
+    # out of the window, and post-window decisions replay exactly
+    frames = [("deliver", "b:1", "stream", "Org1")] * 60
+    active = _windowed_plan(9, 0.0, 100.0, t=1.0)
+    dormant = _windowed_plan(9, 50.0, 100.0, t=1.0)
+    _drive(active, frames)
+    _drive(dormant, frames)
+    assert active._rand.getstate() == dormant._rand.getstate()
+
+
+def test_schedule_composes_with_always_on_rules():
+    # an always-on rule behind a dormant link rule still sees the same
+    # draw sequence, so its decisions match a plan without the link
+    frames = [("broadcast", "c:1", "req", "Org3")] * 60
+
+    def _mk(with_link):
+        plan = FaultPlan(seed=21, clock=lambda: 1.0)
+        plan.installed_at = 0.0
+        if with_link:
+            plan.links({("Org1", "*"): {"loss": 0.5}},
+                       schedule=FaultSchedule(kind="window",
+                                              start_s=50.0, end_s=100.0))
+        return plan.rule(method="broadcast", drop=0.3)
+
+    # Org3 frames never match the Org1 link rule, so the dormant link
+    # consumes no draws for them at all
+    assert _drive(_mk(True), frames) == _drive(_mk(False), frames)

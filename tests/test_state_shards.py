@@ -363,3 +363,52 @@ def test_needs_bootstrap_only_on_virgin_dirs(tmp_path, org):
     assert snapshot.needs_bootstrap(root, "ch")     # no blocks yet
     _commit_all(lg, _endorser_envs(org, n_blocks=1, txs_per_block=2))
     assert not snapshot.needs_bootstrap(root, "ch")  # has a chain: never clobber
+
+
+# ---------------------------------------------------------------------------
+# shard-parallel checkpoint serialization: bit-identity with the serial path
+# ---------------------------------------------------------------------------
+
+def _filled_statedb(root, n_keys=800, n_shards=8):
+    db = StateDB(root=root, n_shards=n_shards)
+    b = UpdateBatch()
+    for i in range(n_keys):
+        b.put("cc", f"k{i:05d}", b"v%d" % i, Version(1, i))
+    db.apply_updates(b, 1)
+    return db
+
+
+def test_statedb_checkpoint_parallel_serial_bit_identity(tmp_path):
+    """The thread fan-out over shards must produce byte-identical
+    checkpoint payloads (the manifest records per-shard sha256)."""
+    par = _filled_statedb(str(tmp_path / "par"))
+    ser = _filled_statedb(str(tmp_path / "ser"))
+    par._HOST_CORES = 8        # force the pool path even on 1-core CI
+    ser._HOST_CORES = 1        # force the serial path
+    mp, ms = par.checkpoint(), ser.checkpoint()
+    assert [s["sha256"] for s in mp["shards"]] \
+        == [s["sha256"] for s in ms["shards"]]
+    assert [s["bytes"] for s in mp["shards"]] \
+        == [s["bytes"] for s in ms["shards"]]
+    # both recover to the same merged key map
+    ra = StateDB(root=str(tmp_path / "par"), n_shards=8)
+    rb = StateDB(root=str(tmp_path / "ser"), n_shards=8)
+    assert ra._data == rb._data
+    assert len(ra) == 800
+
+
+def test_historydb_checkpoint_parallel_serial_bit_identity(tmp_path):
+    def _filled(root):
+        db = HistoryDB(root=root, n_shards=8)
+        db.commit(1, [(i, f"tx{i}", "cc", f"k{i:05d}", b"v", False)
+                      for i in range(800)])
+        return db
+    par, ser = _filled(str(tmp_path / "par")), _filled(str(tmp_path / "ser"))
+    par._HOST_CORES = 8
+    ser._HOST_CORES = 1
+    mp, ms = par.checkpoint(), ser.checkpoint()
+    assert [s["sha256"] for s in mp["shards"]] \
+        == [s["sha256"] for s in ms["shards"]]
+    re = HistoryDB(root=str(tmp_path / "par"), n_shards=8)
+    assert re.last_recovery["source"] != "fresh"
+    assert [m.txid for m in re.get_history("cc", "k00007")] == ["tx7"]

@@ -345,3 +345,81 @@ def test_schedule_survives_rule_round_trip():
     d = plan.rules[0].as_dict()
     assert d["schedule"]["kind"] == "burst"
     assert d["schedule"]["duty"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# trace replay (ArrivalProcess.from_trace / --save-trace)
+# ---------------------------------------------------------------------------
+
+def test_trace_arrivals_replay_save_trace_format(tmp_path):
+    from fabric_tpu.workload.arrivals import ArrivalProcess, from_spec
+    path = tmp_path / "trace.jsonl"
+    # exactly what WorkloadRunner --save-trace appends, two phases
+    import json
+    with open(path, "w") as f:
+        for i, t in enumerate([0.5, 0.1, 0.9]):
+            f.write(json.dumps({"phase": "warm", "i": i, "t": t}) + "\n")
+        for i, t in enumerate([0.2, 0.7]):
+            f.write(json.dumps({"phase": "run", "i": i, "t": t}) + "\n")
+    tr = ArrivalProcess.from_trace(str(path))
+    assert tr.schedule(1.0) == [0.1, 0.2, 0.5, 0.7, 0.9]   # sorted
+    assert tr.schedule(0.6) == [0.1, 0.2, 0.5]             # clipped
+    warm = ArrivalProcess.from_trace(str(path), phase="warm")
+    assert warm.schedule(1.0) == [0.1, 0.5, 0.9]
+    # the spec kind reaches the same replay
+    spec = from_spec({"kind": "trace", "path": str(path),
+                      "phase": "run"})
+    assert spec.schedule(1.0) == [0.2, 0.7]
+    assert spec.describe()["kind"] == "TraceArrivals"
+    assert spec.describe()["n"] == 2
+
+
+def test_trace_arrivals_bare_numbers_and_empty(tmp_path):
+    from fabric_tpu.workload.arrivals import ArrivalProcess
+    path = tmp_path / "bare.jsonl"
+    path.write_text("0.25\n0.75\n\n")
+    tr = ArrivalProcess.from_trace(str(path))
+    assert tr.schedule(1.0) == [0.25, 0.75]
+    assert tr.max_rate() > 0.0
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text("")
+    assert ArrivalProcess.from_trace(str(empty)).schedule(1.0) == []
+
+
+# ---------------------------------------------------------------------------
+# scenario catalog integrity (the cheap half; live runs are smoke-gated)
+# ---------------------------------------------------------------------------
+
+_EXPECT_KINDS = {"converged", "zero_quarantines", "quarantine",
+                 "fraud_proofs", "min_committed", "max_shed_frac",
+                 "exactly_once"}
+
+
+def test_scenario_catalog_is_wellformed():
+    from fabric_tpu.workload import scenarios
+    names = scenarios.list_scenarios()
+    assert len(names) >= 6
+    for required in ("geo-wan", "equivocation", "gossip-poison",
+                     "tampered-attestation", "mixed-identity",
+                     "burst-partition"):
+        assert required in names
+    for name in names:
+        spec = scenarios.SCENARIOS[name]
+        assert spec.get("phases"), name
+        for exp in spec.get("expect", []):
+            assert exp["kind"] in _EXPECT_KINDS, (name, exp)
+        for ph in spec["phases"]:
+            assert float(ph.get("duration_s", 0)) > 0.0, (name, ph)
+
+
+def test_scenario_plans_compile_seeded_deterministic():
+    from fabric_tpu.workload import scenarios
+    for name, spec in scenarios.SCENARIOS.items():
+        p1 = scenarios.build_plan(spec, seed=7)
+        p2 = scenarios.build_plan(spec, seed=7)
+        if not spec.get("links") and not spec.get("partition"):
+            assert p1 is None and p2 is None, name
+            continue
+        assert p1.rules, name
+        assert [r.as_dict() for r in p1.rules] \
+            == [r.as_dict() for r in p2.rules], name
