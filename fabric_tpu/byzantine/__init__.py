@@ -38,7 +38,9 @@ from fabric_tpu.byzantine.monitor import (
     ByzantineMonitor,
     build_fraud_proof,
     verify_fraud_proof,
+    verify_fraud_proof_strict,
 )
+from fabric_tpu.byzantine.proofgossip import MSG_FRAUD_PROOF, ProofGossip
 from fabric_tpu.byzantine.ops import register_ops
 
 __all__ = [
@@ -47,5 +49,8 @@ __all__ = [
     "ByzantineMonitor",
     "build_fraud_proof",
     "verify_fraud_proof",
+    "verify_fraud_proof_strict",
+    "MSG_FRAUD_PROOF",
+    "ProofGossip",
     "register_ops",
 ]

@@ -44,8 +44,10 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from fabric_tpu.byzantine.quarantine import QuarantineRegistry
+from fabric_tpu.byzantine.quarantine import (CRIME_REASONS,
+                                             QuarantineRegistry)
 from fabric_tpu.byzantine.witness import WitnessLog
+from fabric_tpu.utils import serde
 
 logger = logging.getLogger("fabric_tpu.byzantine")
 
@@ -75,6 +77,34 @@ def _jsonable_sigs(block) -> List[dict]:
             out.append({
                 "creator": _hex(entry["sig_header"]["creator"]),
                 "nonce": _hex(entry["sig_header"].get("nonce", b"")),
+                "signature": _hex(entry["signature"])})
+        except Exception:
+            continue
+    return out
+
+
+def _incriminating_sigs(block) -> List[dict]:
+    """The exact (signed-bytes, signature, creator) triples from the
+    block's metadata — the portable core of a block-level fraud proof.
+    Unlike `_jsonable_sigs` (display evidence), these carry the FULL
+    message each signature covers, so any third party can re-verify the
+    accused signed a conflicting header without trusting accuser or
+    relay."""
+    try:
+        from fabric_tpu.orderer.blockwriter import block_signed_bytes
+        from fabric_tpu.protocol.types import (META_LAST_CONFIG,
+                                               META_SIGNATURES)
+        sigs = block.metadata.items.get(META_SIGNATURES) or []
+        last_config = block.metadata.items.get(META_LAST_CONFIG, 0)
+    except Exception:
+        return []
+    out = []
+    for entry in sigs:
+        try:
+            out.append({
+                "creator": _hex(entry["sig_header"]["creator"]),
+                "signed": _hex(block_signed_bytes(
+                    block, entry["sig_header"], last_config)),
                 "signature": _hex(entry["signature"])})
         except Exception:
             continue
@@ -120,6 +150,106 @@ def verify_fraud_proof(proof: dict, msps) -> bool:
         return False
 
 
+def _verify_entry_equivocation(accused: str, ev: dict, msps):
+    """Raft-entry equivocation evidence is fully self-contained: two
+    valid consenter signatures over two DIFFERENT payloads for one
+    (term, index) slot."""
+    try:
+        from fabric_tpu.msp import deserialize_from_msps
+        from fabric_tpu.orderer import raft as raftmod
+        from fabric_tpu.orderer.cluster import cert_fingerprint
+        ident = deserialize_from_msps(
+            msps, bytes.fromhex(ev["proposer"]), validate=True)
+        if ident is None:
+            return False, "unknown_proposer"
+        if f"{ident.mspid}|{cert_fingerprint(ident.cert)}" != accused:
+            return False, "proposer_not_accused"
+        term, index = int(ev["term"]), int(ev["index"])
+        payloads = set()
+        for side in ("a", "b"):
+            s = ev[side]
+            data = bytes.fromhex(s["data"])
+            kind = s["entry_kind"]
+            if not ident.verify(
+                    raftmod.entry_signed_bytes(term, index, data, kind),
+                    bytes.fromhex(s["sig"])):
+                return False, f"bad_sig_{side}"
+            payloads.add((kind, data))
+        if len(payloads) < 2:
+            return False, "identical_payloads"
+        return True, "entry_equivocation_pair"
+    except Exception:
+        return False, "malformed_entry_evidence"
+
+
+def verify_fraud_proof_strict(proof: dict, msps, ledger=None):
+    """Independently re-verify a RECEIVED fraud proof — trust neither
+    relay nor accuser.  Beyond the accuser's signature, the evidence
+    payload itself must incriminate the accused:
+
+      * raft-entry equivocation: two valid signatures by the accused
+        over two different payloads for one log slot (self-contained);
+      * block equivocation: two valid signatures by the accused over
+        two different headers at the proof height (self-contained);
+      * fork: ONE valid signature by the accused over a header at the
+        proof height that conflicts with OUR OWN committed chain — the
+        local ledger is the second witness, so the claim is checked
+        against evidence the receiver already holds.
+
+    A proof accusing a node of anything a crash-stop fault could
+    explain — no signature by the accused over conflicting payloads —
+    is rejected, never convicted.  -> (ok, why)."""
+    if not verify_fraud_proof(proof, msps):
+        return False, "bad_accuser_sig"
+    reason = proof.get("reason")
+    if reason not in CRIME_REASONS:
+        return False, "unprovable_reason"
+    accused = proof.get("accused") or ""
+    ev = proof.get("evidence") or {}
+    if ev.get("kind") == "raft_entry_equivocation":
+        return _verify_entry_equivocation(accused, ev, msps)
+    height = int(proof.get("height", -1))
+    if height < 0:
+        return False, "no_height"
+    hashes = set()
+    for ent in ev.get("attested") or []:
+        try:
+            import hashlib
+            from fabric_tpu.msp import deserialize_from_msps
+            from fabric_tpu.orderer.cluster import cert_fingerprint
+            ident = deserialize_from_msps(
+                msps, bytes.fromhex(ent["creator"]), validate=True)
+            if ident is None:
+                continue
+            if f"{ident.mspid}|{cert_fingerprint(ident.cert)}" != accused:
+                continue
+            signed = bytes.fromhex(ent["signed"])
+            if not ident.verify(signed, bytes.fromhex(ent["signature"])):
+                continue
+            hdr = serde.decode(signed)["header"]
+            if int(hdr.get("number", -1)) != height:
+                continue
+            hashes.add(hashlib.sha256(serde.encode(hdr)).hexdigest())
+        except Exception:
+            continue
+    if not hashes:
+        return False, "no_self_incriminating_signature"
+    if len(hashes) >= 2:
+        return True, "equivocation_pair"
+    hhex = next(iter(hashes))
+    if ledger is not None:
+        try:
+            from fabric_tpu.protocol import block_header_hash
+            if height < ledger.height:
+                stored = ledger.blockstore.get_by_number(height)
+                if block_header_hash(stored.header).hex() != hhex:
+                    return True, "fork_vs_local_chain"
+                return False, "matches_local_chain"
+        except Exception:
+            pass
+    return False, "unverifiable_single_header"
+
+
 class ByzantineMonitor:
     """One channel's detection/containment judge (thread-safe)."""
 
@@ -138,6 +268,18 @@ class ByzantineMonitor:
         self._lock = threading.Lock()
         self.proofs: List[dict] = []
         self._proof_seq = 0
+        # single-header proofs that arrived BEFORE our chain reached the
+        # proof height (no local block to conflict with yet): parked and
+        # re-judged as commits land, so a fast accuser never outruns a
+        # slow receiver.  Bounded — an attacker spraying unverifiable
+        # accusations only ever occupies this much memory.
+        self._deferred: List[dict] = []
+        self.DEFERRED_MAX = 32
+        # on_proof(proof): fired once per NEW local conviction with the
+        # signed portable proof — the proof-gossip plane broadcasts it.
+        # NEVER fired for remotely-received proofs (accept_remote_proof),
+        # so re-broadcast loops terminate at the quarantine dedup.
+        self.on_proof = None
         if proof_dir is not None:
             try:
                 os.makedirs(proof_dir, exist_ok=True)
@@ -209,6 +351,7 @@ class ByzantineMonitor:
                     {"committed": committed, "conflicting": hhex,
                      "header": self._header_dict(block),
                      "signatures": _jsonable_sigs(block),
+                     "attested": _incriminating_sigs(block),
                      "source": source})
                 return VERDICT_REJECT
 
@@ -252,6 +395,7 @@ class ByzantineMonitor:
 
     def on_committed(self, height: int) -> None:
         self.witness.prune_below(height)
+        self._retry_deferred()
 
     def convict_external(self, identity: str, reason: str,
                          evidence: Optional[dict] = None) -> None:
@@ -260,6 +404,75 @@ class ByzantineMonitor:
         trust registry)."""
         with self._lock:
             self._convict([identity], -1, reason, evidence or {})
+
+    def accept_remote_proof(self, proof: dict,
+                            relay: Optional[str] = None) -> str:
+        """Judge a fraud proof received over the wire and convict WITHOUT
+        local witness evidence when — and only when — it independently
+        re-verifies (verify_fraud_proof_strict: accuser signature AND a
+        self-incriminating payload by the accused).  The relay is never
+        trusted and never blamed.  -> 'convicted' | 'duplicate' |
+        'rejected'."""
+        ok, why = verify_fraud_proof_strict(proof, self.msps,
+                                            ledger=self.ledger)
+        if not ok:
+            if why == "unverifiable_single_header":
+                # accuser sig and the self-incriminating signature both
+                # held — we just have not committed the proof height
+                # yet.  Park it; _retry_deferred re-judges on commit.
+                with self._lock:
+                    if (not self.quarantine.is_quarantined(
+                            proof.get("accused"))
+                            and len(self._deferred) < self.DEFERRED_MAX):
+                        self._deferred.append(proof)
+                        logger.info(
+                            "[%s] remote fraud proof deferred (height "
+                            "%s not committed yet) relay=%s",
+                            self.channel_id, proof.get("height"), relay)
+                        return "deferred"
+            logger.warning("[%s] remote fraud proof rejected (%s) "
+                           "relay=%s", self.channel_id, why, relay)
+            return "rejected"
+        accused, reason = proof["accused"], proof["reason"]
+        with self._lock:
+            if not self.quarantine.quarantine(accused, reason):
+                return "duplicate"
+            logger.warning("[%s] convicted %s via remote fraud proof "
+                           "(%s, %s) relay=%s", self.channel_id, accused,
+                           reason, why, relay)
+            self.proofs.append(proof)
+            self._persist_proof(proof)
+        return "convicted"
+
+    def _retry_deferred(self) -> None:
+        """Re-judge parked single-header proofs against the chain we
+        hold NOW.  A proof that verifies convicts like any local one —
+        on_proof fires, so the epidemic resumes from here."""
+        with self._lock:
+            if not self._deferred:
+                return
+            still: List[dict] = []
+            for proof in self._deferred:
+                ok, why = verify_fraud_proof_strict(proof, self.msps,
+                                                    ledger=self.ledger)
+                if not ok:
+                    if why == "unverifiable_single_header":
+                        still.append(proof)   # height still ahead of us
+                    continue                  # e.g. matches_local_chain
+                accused, reason = proof["accused"], proof["reason"]
+                if not self.quarantine.quarantine(accused, reason):
+                    continue
+                logger.warning("[%s] convicted %s via deferred fraud "
+                               "proof (%s, %s)", self.channel_id,
+                               accused, reason, why)
+                self.proofs.append(proof)
+                self._persist_proof(proof)
+                if self.on_proof is not None:
+                    try:
+                        self.on_proof(proof)
+                    except Exception:
+                        logger.exception("fraud proof broadcast failed")
+            self._deferred = still
 
     # -- internals -----------------------------------------------------------
 
@@ -297,6 +510,7 @@ class ByzantineMonitor:
                                 for h, r in hashes.items()},
                     "header": self._header_dict(block),
                     "signatures": _jsonable_sigs(block),
+                    "attested": _incriminating_sigs(block),
                     "source": source}
         # (a) the perfect proof: one identity signed two different
         # headers at one height
@@ -342,6 +556,11 @@ class ByzantineMonitor:
                                       reason, evidence, self.signer)
             self.proofs.append(proof)
             self._persist_proof(proof)
+            if self.on_proof is not None:
+                try:
+                    self.on_proof(proof)
+                except Exception:
+                    logger.exception("fraud proof broadcast failed")
 
     def _persist_proof(self, proof: dict) -> None:
         if self.proof_dir is None:
@@ -362,4 +581,5 @@ class ByzantineMonitor:
         return {"channel": self.channel_id,
                 "witness": self.witness.stats(),
                 "disputed_heights": self.witness.disputed_heights(),
-                "fraud_proofs": len(self.proofs)}
+                "fraud_proofs": len(self.proofs),
+                "deferred_proofs": len(self._deferred)}

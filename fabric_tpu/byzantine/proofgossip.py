@@ -1,0 +1,148 @@
+"""First-class fraud-proof gossip: convictions become a NETWORK-WIDE
+property, not a per-peer one.
+
+Round 13 left a gap the two-faced adversary exploits: each peer convicts
+only from its own witness log, so an orderer that equivocates per-peer
+on deliver is quarantined by the one peer that saw both headers and
+keeps serving everyone else.  This plane closes it:
+
+  * every NEW local conviction broadcasts its signed portable fraud
+    proof over the channel's gossip endpoint (`gossip.fraud_proof`);
+  * a RECEIVED proof is judged by `ByzantineMonitor.accept_remote_proof`
+    — the accuser signature AND the self-incriminating payload are
+    independently re-verified, the relay is never trusted and never
+    blamed — and convicts without any local witness evidence;
+  * a freshly-convicting receiver re-broadcasts the proof (epidemic
+    propagation past the sender's fanout); a duplicate or rejected proof
+    is NOT re-broadcast, so the flood terminates at the quarantine
+    registry's first-conviction gate.
+
+Proofs travel as JSON bytes inside the gossip frame: the proof body is
+a JSON document (it carries floats and is signed over its
+`json.dumps(sort_keys=True)` canonical form), so re-encoding it through
+the wire serde would break the accuser's signature.
+
+The broadcast counter doubles as the crash-stop gate: a chaos run with
+no Byzantine adversary must end with `broadcasts == 0` (no conviction,
+no proof, no gossip) — asserted by the scenario catalog's control runs.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from typing import Optional
+
+logger = logging.getLogger("fabric_tpu.byzantine")
+
+MSG_FRAUD_PROOF = "gossip.fraud_proof"
+
+
+class ProofGossip:
+    """One channel's fraud-proof dissemination plane."""
+
+    OUTBOX_MAX = 16
+
+    def __init__(self, endpoint, discovery, monitor, fanout: int = 3):
+        self.endpoint = endpoint
+        self.discovery = discovery
+        self.monitor = monitor
+        self.fanout = fanout
+        self.broadcasts = 0           # local-conviction broadcasts only
+        self.relayed = 0              # epidemic re-broadcasts
+        self.received = {"convicted": 0, "duplicate": 0, "rejected": 0}
+        # anti-entropy: every proof this node ever served (bounded) is
+        # periodically re-offered to one known peer, so peers that were
+        # down, partitioned, or not yet discovered at broadcast time
+        # still converge; duplicates die at the receiver's quarantine
+        # first-conviction gate
+        self._outbox = []
+        self._rr = 0
+
+    # -- outbound ------------------------------------------------------------
+
+    def broadcast(self, proof: dict) -> None:
+        """ByzantineMonitor.on_proof hook: fan a NEW local conviction's
+        proof out to alive peers."""
+        self.broadcasts += 1
+        self._count("byzantine_proofs_broadcast_total",
+                    "fraud proofs broadcast for local convictions")
+        self._fan_out(proof)
+
+    def _targets(self) -> list:
+        # known_ids reaches configured peers even before membership
+        # converges — a conviction can happen within the first ticks
+        if hasattr(self.discovery, "known_ids"):
+            return self.discovery.known_ids()
+        return self.discovery.alive_ids()
+
+    def _fan_out(self, proof: dict) -> None:
+        try:
+            raw = json.dumps(proof, sort_keys=True).encode()
+        except Exception:
+            logger.exception("fraud proof not JSON-serializable")
+            return
+        if raw not in self._outbox:
+            self._outbox.append(raw)
+            del self._outbox[:-self.OUTBOX_MAX]
+        for to in self._targets()[:self.fanout]:
+            try:
+                self.endpoint.send(to, MSG_FRAUD_PROOF, {"proof": raw})
+            except Exception:
+                logger.exception("fraud proof send to %s failed", to)
+
+    def tick(self) -> None:
+        """Anti-entropy: re-offer every served proof to ONE known peer,
+        rotating through the membership — called from the gossip tick
+        cadence.  No proofs, no traffic (the crash-stop silence gate
+        stays meaningful)."""
+        if not self._outbox:
+            return
+        targets = self._targets()
+        if not targets:
+            return
+        to = targets[self._rr % len(targets)]
+        self._rr += 1
+        for raw in list(self._outbox):
+            try:
+                self.endpoint.send(to, MSG_FRAUD_PROOF, {"proof": raw})
+            except Exception:
+                logger.exception("fraud proof re-offer to %s failed", to)
+
+    # -- inbound -------------------------------------------------------------
+
+    def handle(self, frm: str, body: dict) -> None:
+        """Judge one received proof frame; re-broadcast only on a fresh
+        conviction (the termination rule)."""
+        try:
+            proof = json.loads(bytes(body["proof"]).decode())
+            if not isinstance(proof, dict):
+                raise ValueError("proof frame is not an object")
+        except Exception:
+            logger.warning("unparseable fraud proof frame from %s", frm)
+            self.received["rejected"] += 1
+            self._count("byzantine_proofs_received_total",
+                        "fraud proofs received via gossip",
+                        verdict="rejected")
+            return
+        verdict = self.monitor.accept_remote_proof(proof, relay=frm)
+        self.received[verdict] = self.received.get(verdict, 0) + 1
+        self._count("byzantine_proofs_received_total",
+                    "fraud proofs received via gossip", verdict=verdict)
+        if verdict == "convicted":
+            self.relayed += 1
+            self._fan_out(proof)
+
+    # -- plumbing ------------------------------------------------------------
+
+    @staticmethod
+    def _count(name: str, help_text: str, **labels) -> None:
+        try:
+            from fabric_tpu.ops_plane import registry
+            registry.counter(name, help_text).add(1, **labels)
+        except Exception:
+            pass
+
+    def snapshot(self) -> dict:
+        return {"broadcasts": self.broadcasts, "relayed": self.relayed,
+                "received": dict(self.received)}

@@ -35,7 +35,7 @@ class BlocksProvider:
     def __init__(self, channel_id: str, deliver_handler, gossip_state,
                  mcs=None, window: int = 32,
                  backoff_base_s: float = 0.05, backoff_max_s: float = 2.0,
-                 signed=None):
+                 signed=None, standing=None):
         self.channel_id = channel_id
         self.deliver = deliver_handler   # orderer DeliverHandler (or client)
         self.state = gossip_state
@@ -44,6 +44,14 @@ class BlocksProvider:
         self.backoff_base_s = backoff_base_s
         self.backoff_max_s = backoff_max_s
         self.signed = signed
+        # optional callable(sender identity) -> bool: True means the
+        # stream's source is quarantined.  A standing-aware deliver
+        # client (node/peer.RemoteDeliver) only serves from such a
+        # source as a last resort, so a flagged window is counted and
+        # logged here — visibility that the channel is running degraded,
+        # not a refusal (the byzantine monitor still judges every block)
+        self.standing = standing
+        self.last_resort_windows = 0
         self._failures = 0
         self._stopped = False
 
@@ -66,12 +74,19 @@ class BlocksProvider:
                 attributes={"channel": self.channel_id, "height": height,
                             "window": self.window}) as span:
             blocks: List = []
+            sender = None
             try:
-                for block in self.deliver.deliver(
+                for item in self.deliver.deliver(
                         self.channel_id,
                         SeekInfo(start=height, stop=height + self.window - 1,
                                  behavior=BEHAVIOR_FAIL_IF_NOT_READY),
                         signed=self.signed):
+                    # deliver handlers yield bare blocks; standing-aware
+                    # clients yield (block, attests, sender)
+                    if isinstance(item, tuple):
+                        block, sender = item[0], item[2]
+                    else:
+                        block = item
                     blocks.append(block)
             except NotReadyError:
                 pass  # reached the orderer tip mid-window: fine
@@ -95,6 +110,22 @@ class BlocksProvider:
                 if self._failures:
                     self._mark_healed(0)   # reachable again, already at tip
                 return 0
+            if (self.standing is not None and sender is not None
+                    and self.standing(sender)):
+                self.last_resort_windows += 1
+                logger.warning(
+                    "[%s] window served by a QUARANTINED source (last "
+                    "resort; every healthy endpoint failed)",
+                    self.channel_id)
+                span.set_attribute("last_resort", True)
+                try:
+                    from fabric_tpu.ops_plane import registry
+                    registry.counter(
+                        "gossip_deliver_last_resort_total",
+                        "deliver windows pulled from a quarantined "
+                        "source").add(1, channel=self.channel_id)
+                except Exception:
+                    pass
             if self.mcs is not None:
                 with tracing.tracer.start_span(
                         "gossip.verify_window",

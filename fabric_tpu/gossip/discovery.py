@@ -147,6 +147,15 @@ class Discovery:
     def alive_ids(self) -> List[str]:
         return sorted(self._members)
 
+    def known_ids(self) -> List[str]:
+        """Alive members PLUS configured-but-not-yet-heard bootstrap
+        peers — the widest reachable-target set.  Planes that must reach
+        peers before membership converges (fraud-proof gossip: a
+        conviction can land within the first few ticks) send here;
+        unreachable entries just drop (gossip tolerates loss)."""
+        return sorted((set(self._members) | set(self._bootstrap))
+                      - {self.id})
+
     def members(self) -> List[Peer]:
         return [self._members[k] for k in sorted(self._members)]
 

@@ -26,6 +26,7 @@ from fabric_tpu.gossip.state import (
     MSG_STATE_REQ,
     MSG_STATE_RESP,
 )
+from fabric_tpu.byzantine.proofgossip import MSG_FRAUD_PROOF
 
 _DISCOVERY_MSGS = {MSG_ALIVE, MSG_MEMBERSHIP_REQ, MSG_MEMBERSHIP_RESP}
 _STATE_MSGS = {MSG_BLOCK, MSG_STATE_REQ, MSG_STATE_RESP}
@@ -70,6 +71,8 @@ class GossipNode:
             self.election.handle(msg_type, frm, body)
         elif msg_type in PULL_MSGS and self.cert_pull is not None:
             self.cert_pull.handle(msg_type, frm, body)
+        elif msg_type == MSG_FRAUD_PROOF and self.state.proofs is not None:
+            self.state.proofs.handle(frm, body)
 
     def tick(self) -> None:
         """One gossip period: heartbeat, elect, (leader) pull, anti-entropy."""
@@ -80,6 +83,8 @@ class GossipNode:
         self.state.anti_entropy_tick()
         if self.cert_pull is not None:
             self.cert_pull.tick()
+        if self.state.proofs is not None:
+            self.state.proofs.tick()
 
     @property
     def height(self) -> int:

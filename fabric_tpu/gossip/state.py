@@ -40,6 +40,9 @@ class GossipState:
         # byzantine.ByzantineMonitor, wired post-construction by the
         # peer channel; None = classic blind intake
         self.monitor = None
+        # byzantine.ProofGossip, wired post-construction alongside the
+        # monitor; None = fraud proofs stay node-local (pre-r14 behavior)
+        self.proofs = None
         self._buffer: Dict[int, Block] = {}
         # deliver loop + gossip dispatch threads both drain; the lock
         # closes the pop->store window (two threads pop adjacent heights

@@ -199,6 +199,12 @@ class _Fetcher:
                                  timeout=self.chunk_timeout_s)
         return self._conn
 
+    def peer_identity(self):
+        """Handshake-verified identity of the serving peer (dials if
+        needed) — the standing check keys on WHO signed the handshake,
+        not the address we dialed."""
+        return getattr(self._connection().channel, "peer_identity", None)
+
     def call(self, method: str, body: dict) -> dict:
         from fabric_tpu.comm.rpc import RpcError
         last: Optional[Exception] = None
@@ -236,40 +242,73 @@ class _Fetcher:
 
 def bootstrap_from_peers(ledger_root: str, channel_id: str, peers,
                          signer, msps, chunk_timeout_s: float = 2.0,
-                         attempts: int = 12) -> dict:
+                         attempts: int = 12,
+                         source_blocked=None) -> dict:
     """Fetch + verify + install a snapshot from the first peer that can
-    serve one.  -> {"height", "from", "files", "bytes", "seconds"}."""
+    serve one.  -> {"height", "from", "files", "bytes", "seconds"}.
+
+    `source_blocked`: optional callable(handshake identity) -> bool.
+    Sources it flags (quarantined signers) are DEFERRED, not refused:
+    they are retried only after every honest source has failed, so a
+    convicted peer degrades the rejoin before it can strand it — and a
+    wiped peer (whose quarantine registry outlives its ledger) never
+    bootstraps from its convicted adversary while an honest source is
+    alive."""
     t0 = time.monotonic()
     last: Optional[Exception] = None
-    for addr in peers:
-        fetcher = _Fetcher(addr, signer, msps, chunk_timeout_s, attempts)
-        try:
-            meta = fetcher.call(META_VERB, {"channel": channel_id})
-            payloads: Dict[str, List[bytes]] = {"state": [], "history": []}
-            total = 0
-            for ent in meta["files"]:
-                data = fetcher.fetch_file(channel_id, ent)
-                import hashlib
-                if hashlib.sha256(data).hexdigest() != ent["sha256"]:
-                    raise SnapshotError(
-                        f"hash mismatch for {ent['db']}/{ent['file']} "
-                        f"from {addr}")
-                payloads[ent["db"]].append(data)
-                total += len(data)
-            install(ledger_root, channel_id, meta, payloads)
-            seconds = time.monotonic() - t0
-            logger.info(
-                "[%s] snapshot installed from %s: height=%d files=%d "
-                "bytes=%d in %.2fs", channel_id, addr, int(meta["height"]),
-                len(meta["files"]), total, seconds)
-            return {"height": int(meta["height"]), "from": list(addr),
-                    "files": len(meta["files"]), "bytes": total,
-                    "seconds": seconds}
-        except Exception as exc:
-            last = exc
-            logger.warning("[%s] snapshot fetch from %s failed: %s",
-                           channel_id, addr, exc)
-        finally:
-            fetcher.close()
+    quarantined = []
+    for source_pass, addrs in (("honest", list(peers)), ("last-resort",
+                                                         quarantined)):
+        for addr in addrs:
+            fetcher = _Fetcher(addr, signer, msps, chunk_timeout_s,
+                               attempts)
+            try:
+                if (source_blocked is not None and source_pass == "honest"
+                        and source_blocked(fetcher.peer_identity())):
+                    quarantined.append(addr)
+                    last = SnapshotError(
+                        f"snapshot source {addr} is quarantined")
+                    logger.warning(
+                        "[%s] snapshot source %s is quarantined; "
+                        "deferring to last resort", channel_id, addr)
+                    continue
+                if source_pass == "last-resort":
+                    logger.warning(
+                        "[%s] no honest snapshot source left; last-"
+                        "resort fetch from quarantined %s", channel_id,
+                        addr)
+                return _fetch_and_install(fetcher, ledger_root,
+                                          channel_id, addr, t0)
+            except Exception as exc:
+                last = exc
+                logger.warning("[%s] snapshot fetch from %s failed: %s",
+                               channel_id, addr, exc)
+            finally:
+                fetcher.close()
     raise SnapshotError(
         f"no peer could serve a snapshot for {channel_id!r}: {last}")
+
+
+def _fetch_and_install(fetcher: "_Fetcher", ledger_root: str,
+                       channel_id: str, addr, t0: float) -> dict:
+    meta = fetcher.call(META_VERB, {"channel": channel_id})
+    payloads: Dict[str, List[bytes]] = {"state": [], "history": []}
+    total = 0
+    for ent in meta["files"]:
+        data = fetcher.fetch_file(channel_id, ent)
+        import hashlib
+        if hashlib.sha256(data).hexdigest() != ent["sha256"]:
+            raise SnapshotError(
+                f"hash mismatch for {ent['db']}/{ent['file']} "
+                f"from {addr}")
+        payloads[ent["db"]].append(data)
+        total += len(data)
+    install(ledger_root, channel_id, meta, payloads)
+    seconds = time.monotonic() - t0
+    logger.info(
+        "[%s] snapshot installed from %s: height=%d files=%d "
+        "bytes=%d in %.2fs", channel_id, addr, int(meta["height"]),
+        len(meta["files"]), total, seconds)
+    return {"height": int(meta["height"]), "from": list(addr),
+            "files": len(meta["files"]), "bytes": total,
+            "seconds": seconds}

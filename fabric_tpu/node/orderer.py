@@ -63,6 +63,18 @@ def attestation_trust(vcfg: dict):
             list(vcfg.get("attestors", [])))
 
 
+class _BlockStoreLedger:
+    """Adapter giving an orderer-side BlockStore the `.height` +
+    `.blockstore` shape the ByzantineMonitor judges against."""
+
+    def __init__(self, store: BlockStore):
+        self.blockstore = store
+
+    @property
+    def height(self) -> int:
+        return self.blockstore.height
+
+
 class OrdererNode:
     """One orderer process (library form; `main` wraps it)."""
 
@@ -133,6 +145,26 @@ class OrdererNode:
             consenters[int(p["raft_id"])] = (p["mspid"], p["cert_fp"])
         self.cluster = ClusterService(self.rpc, self.signer, msps, peers,
                                       consenters=consenters)
+
+        # byzantine containment plane, orderer side: ONE persistent
+        # quarantine registry per process (same file layout as the peer,
+        # so standings read identically across node kinds), per-channel
+        # witness monitors built in _create_channel.  The cluster
+        # transport's entry verifier reports into it: a mis-signed or
+        # unsigned append scores the sending node, a raft-entry
+        # equivocation convicts the proposing consenter and mints a
+        # portable fraud proof AT THE ORDERER.
+        import os as _byz_os
+        byz_cfg = dict(cfg.get("byzantine", {}))
+        self.byzantine = None
+        self.byz_monitors: Dict[str, object] = {}
+        if byz_cfg.get("enabled", True):
+            from fabric_tpu.byzantine import QuarantineRegistry
+            self.byzantine = QuarantineRegistry(
+                _byz_os.path.join(data_dir, "byzantine_quarantine.json"),
+                score_threshold=int(byz_cfg.get("score_threshold", 3)))
+            self.cluster.on_entry_offense = self._on_entry_offense
+            self.cluster.on_entry_crime = self._on_entry_crime
 
         # refuse to silently strand pre-multichannel node state (storage
         # moved from data_dir/wal.bin to data_dir/<channel>/wal.bin)
@@ -211,6 +243,13 @@ class OrdererNode:
                         "attestor_standing": (
                             self.attestor_trust.snapshot()
                             if self.attestor_trust is not None else {})})
+            # GET /byzantine: quarantine standings + per-channel witness
+            # stats — the SAME route shape as the peer's, so one ops
+            # client reads standings across node kinds
+            if self.byzantine is not None:
+                from fabric_tpu.byzantine import register_ops as _byz_ops
+                _byz_ops(self.ops, self.byzantine,
+                         monitors_fn=lambda: dict(self.byz_monitors))
             self.ops.register_route("GET", "/participation/v1/channels",
                                     self._rest_channels)
             # the ops server is PLAIN HTTP with no client auth, so the
@@ -234,6 +273,30 @@ class OrdererNode:
             self.slo = _slo.SloEvaluator(slo_cfg)
             _slo.register_routes(self.ops, self.slo)
             self.slo.start()
+
+    # -- byzantine hooks (cluster entry verifier -> containment plane) -------
+
+    def _on_entry_offense(self, channel_id: str, frm_node: int,
+                          reason: str) -> None:
+        """A dropped append (unsigned / bad proposer / bad signature)
+        scores the SENDING node's consenter identity — repeat offenders
+        cross the registry threshold into quarantine."""
+        mon = self.byz_monitors.get(channel_id)
+        key = self.cluster.consenter_binding(channel_id, frm_node)
+        if mon is None or key is None:
+            return
+        mon.offense(key, "bad_sig" if reason != "unsigned_entry"
+                    else "garbage")
+
+    def _on_entry_crime(self, channel_id: str, binding: str,
+                        evidence: dict) -> None:
+        """Two different payloads validly signed for one (term, index)
+        slot: provable equivocation by the PROPOSER — convict and mint
+        the portable fraud proof here at the orderer."""
+        mon = self.byz_monitors.get(channel_id)
+        if mon is None:
+            return
+        mon.convict_external(binding, "equivocation", evidence)
 
     # -- channelparticipation REST (restapi.go) ------------------------------
 
@@ -268,6 +331,7 @@ class OrdererNode:
         if support is None:
             return 404, {"error": f"no such channel {cid!r}"}
         self.cluster.remove_chain(cid)
+        self.byz_monitors.pop(cid, None)
         support.chain.halt()
         self.registrar.remove(cid)
         return 200, {"name": cid, "status": "removed"}
@@ -311,9 +375,14 @@ class OrdererNode:
             os.replace(tmp, cfg_path)
         peer_ids, ch_consenters, ch_peers = self._channel_cluster_maps(
             channel_cfg)
+        # every proposed entry is signed with this consenter's identity;
+        # followers verify the chain before applying (cluster.py
+        # EntryVerifier) — enforcement keys on entry_signer being set
+        from fabric_tpu.orderer.consensus import make_entry_signer
         node = RaftNode(self.raft_id, peer_ids,
                         wal_path=os.path.join(ch_dir, "wal.bin"),
-                        snap_path=os.path.join(ch_dir, "snap.bin"))
+                        snap_path=os.path.join(ch_dir, "snap.bin"),
+                        entry_signer=make_entry_signer(self.signer))
         batch = channel_cfg.batch
         support = self.registrar.create_channel(
             cid, bundle_source.current().msps, self.provider,
@@ -336,6 +405,15 @@ class OrdererNode:
             support.processor.attestor_trust = self.attestor_trust
         self.cluster.add_chain(cid, support.chain,
                                consenters=ch_consenters, peers=ch_peers)
+        if self.byzantine is not None:
+            from fabric_tpu.byzantine import ByzantineMonitor, WitnessLog
+            self.byz_monitors[cid] = ByzantineMonitor(
+                cid,
+                WitnessLog(os.path.join(ch_dir, "witness_log.json")),
+                self.byzantine,
+                ledger=_BlockStoreLedger(support.ledger),
+                msps=bundle_source.current().msps, signer=self.signer,
+                proof_dir=os.path.join(ch_dir, "fraud_proofs"))
         return support
 
     def join_channel(self, channel_cfg: ChannelConfig):
@@ -385,6 +463,7 @@ class OrdererNode:
         if support is None:
             raise ValueError(f"no such channel {cid!r}")
         self.cluster.remove_chain(cid)
+        self.byz_monitors.pop(cid, None)
         support.chain.halt()
         self.registrar.remove(cid)
         return {"channel": cid, "status": "removed"}
@@ -485,8 +564,19 @@ class OrdererNode:
             sd = {"data": payload, "identity": self.signer.serialize(),
                   "signature": self.signer.sign(payload)}
             # pull from THIS channel's consenters (a runtime-joined
-            # channel may have a different orderer set than bootstrap)
-            for nid, addr in self.cluster.peers_for(cid).items():
+            # channel may have a different orderer set than bootstrap),
+            # standing-aware: quarantined consenters sort last, so an
+            # onboarding orderer prefers honest sources but can still
+            # catch up from a convicted one as a last resort
+            monitor = self.byz_monitors.get(cid)
+            peer_map = self.cluster.peers_for(cid)
+            def _standing(nid):
+                key = self.cluster.consenter_binding(cid, nid)
+                return 1 if (monitor is not None
+                             and monitor.blocked_source(key)) else 0
+            for nid in sorted(peer_map, key=lambda n: (_standing(n), n)):
+                addr = peer_map[nid]
+                src_key = self.cluster.consenter_binding(cid, nid)
                 blocks = []
                 try:
                     conn = connect(tuple(addr), self.signer, msps,
@@ -504,6 +594,17 @@ class OrdererNode:
                                 raise ValueError(
                                     f"bad orderer signature on block "
                                     f"{block.header.number}")
+                            if monitor is not None:
+                                from fabric_tpu.byzantine.monitor import (
+                                    VERDICT_ADMIT, VERDICT_STALE)
+                                verdict = monitor.check_block(block, src_key)
+                                if verdict == VERDICT_STALE:
+                                    continue
+                                if verdict != VERDICT_ADMIT:
+                                    raise ValueError(
+                                        f"block {block.header.number} "
+                                        f"held/rejected by byzantine "
+                                        f"monitor ({verdict})")
                             blocks.append(block)
                     finally:
                         conn.close()

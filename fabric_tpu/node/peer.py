@@ -139,13 +139,22 @@ class RemoteDeliver:
         optional per-envelope verdict-attestation list (verify_plane/
         attest.py) and `sender` the handshake-verified identity of the
         orderer connection it rode in on; both None when the orderer
-        sends plain blocks."""
+        sends plain blocks.
+
+        Standing-aware source selection is two-pass: quarantined
+        endpoints are SKIPPED while any healthy endpoint remains
+        (deferred, not refused), and retried as a last resort only once
+        every healthy endpoint has failed — a convicted orderer degrades
+        availability before it partitions the peer, and every block it
+        serves is still re-judged by the byzantine monitor."""
         last = None
         payload = b"seek:%s" % channel_id.encode()
         sd = {"data": payload, "identity": self.signer.serialize(),
               "signature": self.signer.sign(payload)}
+        deferred: List[int] = []
         for k in range(len(self.orderers)):
-            addr = self.orderers[(self._rr + k) % len(self.orderers)]
+            idx = (self._rr + k) % len(self.orderers)
+            addr = self.orderers[idx]
             try:
                 # stream_views: block bytes arrive as memoryviews into
                 # the received frame and go straight to the native span
@@ -155,6 +164,7 @@ class RemoteDeliver:
                 try:
                     sender = getattr(conn.channel, "peer_identity", None)
                     if self.blocked is not None and self.blocked(sender):
+                        deferred.append(idx)
                         last = RuntimeError(
                             "orderer endpoint %s:%s is quarantined"
                             % tuple(addr[:2]))
@@ -166,7 +176,32 @@ class RemoteDeliver:
                             "signed_data": sd}):
                         yield (wire.parse_block(item["block"]),
                                item.get("attests"), sender)
-                    self._rr = (self._rr + k) % len(self.orderers)
+                    self._rr = idx
+                    return
+                finally:
+                    conn.close()
+            except Exception as exc:
+                last = exc
+        for idx in deferred:
+            addr = self.orderers[idx]
+            try:
+                conn = connect(tuple(addr), self.signer, self.msps,
+                               timeout=3.0, stream_views=True)
+                try:
+                    sender = getattr(conn.channel, "peer_identity", None)
+                    logger.warning(
+                        "deliver: every healthy orderer failed; last-"
+                        "resort pull from quarantined %s:%s",
+                        *tuple(addr[:2]))
+                    for item in conn.call_stream("deliver", {
+                            "channel": channel_id, "start": seek.start,
+                            "stop": seek.stop, "behavior": seek.behavior,
+                            "timeout_s": int(timeout_s),
+                            "signed_data": sd}):
+                        yield (wire.parse_block(item["block"]),
+                               item.get("attests"), sender)
+                    # _rr stays put: the next pull tries healthy
+                    # endpoints first again
                     return
                 finally:
                     conn.close()
@@ -295,6 +330,7 @@ class PeerChannel:
         # port],...]}` — only attempted when this channel has no chain
         # yet; failure falls back to genesis replay via deliver
         snap_cfg = dict(node.cfg.get("bootstrap_snapshot", {}))
+        self.snapshot_bootstrap = None   # install info (or None)
         if snap_cfg.get("enabled"):
             self._bootstrap_from_snapshot(ledger_root, snap_cfg)
         self.ledger = KVLedger(
@@ -429,8 +465,10 @@ class PeerChannel:
         # deliver/gossip intake (after signature verification) and
         # guards the gossip drain so a contested header never commits.
         self.byz_monitor = None
+        self.proof_gossip = None
         if node.byzantine is not None:
-            from fabric_tpu.byzantine import ByzantineMonitor, WitnessLog
+            from fabric_tpu.byzantine import (ByzantineMonitor, ProofGossip,
+                                              WitnessLog)
             self.byz_monitor = ByzantineMonitor(
                 self.channel_id,
                 WitnessLog(f"{ch_dir}/witness_log.json"),
@@ -441,6 +479,14 @@ class PeerChannel:
             self.deliver_client.blocked = (
                 lambda s: self.byz_monitor.blocked_source(
                     self._byz_source(s)))
+            # fraud-proof gossip: local convictions broadcast their
+            # portable proof; received proofs are independently
+            # re-verified (byzantine/proofgossip.py)
+            self.proof_gossip = ProofGossip(
+                self.gossip.endpoint, self.gossip.discovery,
+                self.byz_monitor)
+            self.gossip.state.proofs = self.proof_gossip
+            self.byz_monitor.on_proof = self.proof_gossip.broadcast
 
         self.deliver_healthy = True
         self._thread = threading.Thread(target=self._deliver_loop,
@@ -470,7 +516,9 @@ class PeerChannel:
                 ledger_root, self.channel_id, sources, self.node.signer,
                 self.msps,
                 chunk_timeout_s=float(snap_cfg.get("chunk_timeout_s", 2.0)),
-                attempts=int(snap_cfg.get("attempts", 12)))
+                attempts=int(snap_cfg.get("attempts", 12)),
+                source_blocked=self._source_blocked)
+            self.snapshot_bootstrap = info
             logger.info("[%s] joined by snapshot: %s", self.channel_id,
                         info)
         except Exception:
@@ -534,6 +582,17 @@ class PeerChannel:
         if binding is None:
             return None
         return f"{binding[0]}|{binding[1]}"
+
+    def _source_blocked(self, sender) -> bool:
+        """Standing check against the node-scoped quarantine registry
+        for transfer sources resolved BEFORE the channel monitor exists
+        (snapshot bootstrap runs first in __init__) — the registry
+        survives a ledger wipe, so a wiped-and-rejoining peer still
+        refuses a convicted snapshot source."""
+        if self.node.byzantine is None:
+            return False
+        key = self._byz_source(sender)
+        return key is not None and self.node.byzantine.is_quarantined(key)
 
     def _seed_attestations(self, block, attests, sender) -> None:
         """Seed the node's verdict cache from an orderer's deliver-time

@@ -14,7 +14,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Dict, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 from fabric_tpu.comm.rpc import RpcServer, connect
 from fabric_tpu.orderer import raft as raftmod
@@ -102,11 +102,17 @@ class _PeerSender:
 # -- raft message serde ------------------------------------------------------
 
 def msg_to_dict(m: raftmod.Message) -> dict:
+    ents = []
+    for e in m.entries:
+        ent = {"term": e.term, "index": e.index, "data": e.data,
+               "kind": e.kind}
+        if e.sig:
+            ent["proposer"], ent["sig"] = e.proposer, e.sig
+        ents.append(ent)
     d = {"type": m.type, "frm": m.frm, "to": m.to, "term": m.term,
          "index": m.index, "log_term": m.log_term, "commit": m.commit,
          "reject": 1 if m.reject else 0, "hint": m.hint,
-         "entries": [{"term": e.term, "index": e.index, "data": e.data,
-                      "kind": e.kind} for e in m.entries]}
+         "entries": ents}
     if m.snapshot is not None:
         d["snapshot"] = {"index": m.snapshot.index, "term": m.snapshot.term,
                          "data": m.snapshot.data,
@@ -124,9 +130,112 @@ def msg_from_dict(d: dict) -> raftmod.Message:
         type=d["type"], frm=d["frm"], to=d["to"], term=d["term"],
         index=d["index"], log_term=d["log_term"],
         entries=tuple(raftmod.Entry(e["term"], e["index"], e["data"],
-                                    e["kind"]) for e in d["entries"]),
+                                    e["kind"], e.get("proposer", b""),
+                                    e.get("sig", b""))
+                      for e in d["entries"]),
         commit=d["commit"], reject=bool(d["reject"]), hint=d["hint"],
         snapshot=snap)
+
+
+class EntryVerifier:
+    """Per-channel signed-raft-entry guard.
+
+    Every appended entry must carry a proposer identity that (a)
+    deserializes and validates against the channel MSPs, (b) binds — by
+    full cert hash, never a CN string — to SOME consenter of THIS
+    channel (the proposer may legitimately differ from the transport
+    sender: a new leader relays its predecessor's entries), and (c)
+    actually signed the (term, index, kind, data) slot.  A second
+    payload under the same (term, index, proposer) slot is an
+    equivocation crime attributable to the proposer from the entries
+    alone: both signatures are self-incriminating, so the evidence is a
+    portable fraud proof mintable AT THE ORDERER, no peer witness
+    needed.
+
+    Legitimate raft behaviours never trip this: conflict truncation
+    replaces a slot under a HIGHER term (different cache key), and
+    retransmits carry byte-identical payloads (digest match).
+    """
+
+    CACHE_MAX = 1024
+
+    def __init__(self, channel_id: str, msps, consenters):
+        self.channel_id = channel_id
+        self.msps = msps
+        self.bindings = {f"{m}|{f}" for m, f in consenters.values()}
+        # (term, index, binding) -> first-seen payload record
+        self._seen: Dict[tuple, dict] = {}
+        self._order: List[tuple] = []
+        # proposer bytes -> (binding, identity): one deserialize per
+        # consenter, not per retransmitted entry
+        self._idents: Dict[bytes, tuple] = {}
+
+    def _proposer(self, raw: bytes):
+        cached = self._idents.get(raw)
+        if cached is not None:
+            return cached
+        from fabric_tpu.msp import deserialize_from_msps
+        ident = deserialize_from_msps(self.msps, raw, validate=True)
+        binding = f"{ident.mspid}|{cert_fingerprint(ident.cert)}"
+        if binding not in self.bindings:
+            raise ValueError(f"proposer {binding} is not a consenter "
+                             f"of {self.channel_id!r}")
+        if len(self._idents) > self.CACHE_MAX:
+            self._idents.clear()
+        self._idents[raw] = (binding, ident)
+        return binding, ident
+
+    def check(self, entries) -> Tuple[bool, Optional[str], List[dict]]:
+        """-> (ok, reject_reason, crimes).  `ok` False rejects the whole
+        message (raft retransmits; an honest leader never mixes good and
+        bad entries).  `crimes` are equivocation evidence dicts, each
+        carrying BOTH signed payloads for independent re-verification."""
+        import hashlib
+        crimes: List[dict] = []
+        for e in entries:
+            if not e.sig or not e.proposer:
+                return False, "unsigned_entry", crimes
+            try:
+                binding, ident = self._proposer(e.proposer)
+            except Exception as exc:
+                logger.warning("[%s] entry %d/%d proposer rejected: %s",
+                               self.channel_id, e.term, e.index, exc)
+                return False, "bad_proposer", crimes
+            digest = hashlib.sha256(
+                e.kind.encode() + b"\x00" + e.data).hexdigest()
+            key = (e.term, e.index, binding)
+            prior = self._seen.get(key)
+            if prior is not None and prior["digest"] == digest:
+                continue             # retransmit: already verified
+            try:
+                ok = ident.verify(
+                    raftmod.entry_signed_bytes(e.term, e.index, e.data,
+                                               e.kind), e.sig)
+            except Exception:
+                ok = False
+            if not ok:
+                return False, "bad_entry_sig", crimes
+            rec = {"digest": digest, "kind": e.kind, "data": e.data,
+                   "sig": e.sig}
+            if prior is not None:
+                # same slot, same signer, two valid signatures over two
+                # different payloads: equivocation, proven by the pair
+                crimes.append({
+                    "kind": "raft_entry_equivocation",
+                    "channel": self.channel_id,
+                    "term": e.term, "index": e.index,
+                    "binding": binding, "proposer": e.proposer.hex(),
+                    "a": {"entry_kind": prior["kind"],
+                          "data": prior["data"].hex(),
+                          "sig": prior["sig"].hex()},
+                    "b": {"entry_kind": e.kind, "data": e.data.hex(),
+                          "sig": e.sig.hex()}})
+                return False, "entry_equivocation", crimes
+            self._seen[key] = rec
+            self._order.append(key)
+            while len(self._order) > self.CACHE_MAX:
+                self._seen.pop(self._order.pop(0), None)
+        return True, None, crimes
 
 
 class ClusterService:
@@ -176,6 +285,15 @@ class ClusterService:
         # per-channel overrides: channel -> (consenters map, peer addrs)
         self._chan_consenters: Dict[str, Dict[int, Tuple[str, str]]] = {}
         self._chan_peers: Dict[str, Dict[int, Tuple[str, int]]] = {}
+        # signed-entry enforcement, per channel: installed by add_chain
+        # only when the channel's own chain signs its entries (legacy /
+        # test chains without an entry signer stay unenforced)
+        self._verifiers: Dict[str, EntryVerifier] = {}
+        # byzantine hooks (wired by the owning node, both optional):
+        #   on_entry_offense(channel_id, frm_node, reason)
+        #   on_entry_crime(channel_id, binding, evidence)
+        self.on_entry_offense = None
+        self.on_entry_crime = None
         # per-ADDRESS sender threads (shared across channels): dial/retry
         # must never block the raft clock (a blackholed peer would
         # otherwise starve heartbeats)
@@ -205,6 +323,18 @@ class ClusterService:
         with self._lock:
             return dict(self._chan_peers.get(channel_id, self.peers))
 
+    def consenter_binding(self, channel_id: str,
+                          raft_id: int) -> Optional[str]:
+        """'mspid|cert-sha256' quarantine key for a channel consenter,
+        or None for an unknown raft id."""
+        with self._lock:
+            consenters = self._chan_consenters.get(channel_id,
+                                                   self.consenters)
+        ent = consenters.get(raft_id)
+        if ent is None:
+            return None
+        return f"{ent[0]}|{ent[1]}"
+
     # -- chain registry (multichannel/registrar.go dynamic chains) -----------
 
     def add_chain(self, channel_id: str, chain,
@@ -222,6 +352,12 @@ class ClusterService:
                     nid: tuple(a) for nid, a in peers.items()}
             for addr in (peers or self.peers).values():
                 self._sender_for(tuple(addr))
+            node = getattr(chain, "node", None)
+            if getattr(node, "entry_signer", None) is not None:
+                self._verifiers[channel_id] = EntryVerifier(
+                    channel_id, self.msps,
+                    consenters if consenters is not None
+                    else self.consenters)
         self._wake.set()
 
     def remove_chain(self, channel_id: str) -> None:
@@ -229,6 +365,7 @@ class ClusterService:
             self.chains.pop(channel_id, None)
             self._chan_consenters.pop(channel_id, None)
             self._chan_peers.pop(channel_id, None)
+            self._verifiers.pop(channel_id, None)
 
     @property
     def chain(self):
@@ -269,6 +406,30 @@ class ClusterService:
                 "dropped (consenter authorization)", msg.frm, got_msp,
                 got_fp[:16])
             return
+        with self._lock:
+            verifier = self._verifiers.get(channel_id)
+        if verifier is not None and msg.entries:
+            ok, reason, crimes = verifier.check(msg.entries)
+            for ev in crimes:
+                logger.warning(
+                    "[%s] raft entry equivocation by %s at term=%d "
+                    "index=%d — fraud proof minted at the orderer",
+                    channel_id, ev["binding"], ev["term"], ev["index"])
+                if self.on_entry_crime is not None:
+                    try:
+                        self.on_entry_crime(channel_id, ev["binding"], ev)
+                    except Exception:
+                        logger.exception("entry-crime hook failed")
+            if not ok:
+                logger.warning(
+                    "[%s] raft append from node %s rejected: %s",
+                    channel_id, msg.frm, reason)
+                if self.on_entry_offense is not None:
+                    try:
+                        self.on_entry_offense(channel_id, msg.frm, reason)
+                    except Exception:
+                        logger.exception("entry-offense hook failed")
+                return
         chain.step(msg)
         self._wake.set()
 

@@ -149,6 +149,20 @@ class SoloChain(Chain):
 META_RAFT_INDEX = "raft_index"
 
 
+def make_entry_signer(signer):
+    """Build a RaftNode entry_signer from a consenter signing identity:
+    returns (serialized identity, signature over the canonical entry
+    bytes) — what EntryVerifier checks on the receiving side."""
+    from fabric_tpu.orderer import raft as raftmod
+    raw = signer.serialize()
+
+    def sign(term: int, index: int, data: bytes, kind: str):
+        return raw, signer.sign(
+            raftmod.entry_signed_bytes(term, index, data, kind))
+
+    return sign
+
+
 class RaftChain(Chain):
     """Crash-fault-tolerant ordering over fabric_tpu.orderer.raft.
 
@@ -166,12 +180,18 @@ class RaftChain(Chain):
     """
 
     def __init__(self, node, cutter: BlockCutter, writer: BlockWriter,
-                 on_block: Optional[Callable] = None):
+                 on_block: Optional[Callable] = None, entry_signer=None):
         from fabric_tpu.utils import serde as _serde
         self._serde = _serde
         self.node = node
         self.cutter = cutter
         self.writer = writer
+        # consenter entry signing (round 14): install the signer on the
+        # raft node so every local append — proposals, conf changes, the
+        # new-leader no-op — carries (proposer, sig); the cluster service
+        # enforces the chain on channels whose own chain signs
+        if entry_signer is not None:
+            node.entry_signer = entry_signer
         self.on_block = on_block or (lambda block: None)
         self._lock = threading.RLock()
         self._halted = False

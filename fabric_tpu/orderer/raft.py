@@ -48,6 +48,24 @@ class Entry:
     index: int
     data: bytes = b""
     kind: str = ENTRY_NORMAL
+    # Consenter attribution: the proposing consenter's serialized
+    # identity plus its signature over entry_signed_bytes().  Both empty
+    # on legacy/unsigned entries — whether that is acceptable is the
+    # cluster service's call (it only enforces on channels whose local
+    # chain signs its own entries).
+    proposer: bytes = b""
+    sig: bytes = b""
+
+
+def entry_signed_bytes(term: int, index: int, data: bytes,
+                       kind: str) -> bytes:
+    """Canonical byte string a consenter signs for one entry.  Covers
+    (term, index, kind, data) — the full identity of a log slot — so the
+    same signer producing two different payloads for one slot yields two
+    valid signatures over DIFFERENT canonical bytes: a self-incriminating
+    equivocation pair, attributable from the entries alone."""
+    return (b"raft-ent\x00" + struct.pack("<QQ", term, index)
+            + kind.encode("utf-8") + b"\x00" + data)
 
 
 @dataclass(frozen=True)
@@ -199,8 +217,15 @@ class RaftNode:
                  snap_path: Optional[str] = None,
                  election_tick: int = 10, heartbeat_tick: int = 1,
                  snapshot_interval: int = 0,
-                 snapshot_data: Callable[[int], bytes] = lambda idx: b""):
+                 snapshot_data: Callable[[int], bytes] = lambda idx: b"",
+                 entry_signer: Optional[
+                     Callable[[int, int, bytes, str],
+                              Tuple[bytes, bytes]]] = None):
         self.id = node_id
+        # entry_signer(term, index, data, kind) -> (proposer, sig): signs
+        # every locally-appended entry (client proposals, conf changes,
+        # AND the new-leader no-op) with the consenter's identity
+        self.entry_signer = entry_signer
         self.nodes: Tuple[int, ...] = tuple(sorted(set(peers) | {node_id}))
         self.election_tick = election_tick
         self.heartbeat_tick = heartbeat_tick
@@ -250,7 +275,8 @@ class RaftNode:
                 elif upto - self.snap_index - 1 < len(self.log):
                     self.log = self.log[:upto - self.snap_index - 1]
             elif rec["k"] == "ent":
-                e = Entry(rec["t"], rec["i"], rec["d"], rec["kd"])
+                e = Entry(rec["t"], rec["i"], rec["d"], rec["kd"],
+                          rec.get("pr", b""), rec.get("sg", b""))
                 if e.index > self.snap_index:
                     # replayed entries are contiguous post-trunc
                     pos = e.index - self.snap_index - 1
@@ -266,8 +292,11 @@ class RaftNode:
 
     def _persist_entries(self, entries: Sequence[Entry]) -> None:
         for e in entries:
-            self._wal.append({"k": "ent", "t": e.term, "i": e.index,
-                              "d": e.data, "kd": e.kind})
+            rec = {"k": "ent", "t": e.term, "i": e.index,
+                   "d": e.data, "kd": e.kind}
+            if e.sig:
+                rec["pr"], rec["sg"] = e.proposer, e.sig
+            self._wal.append(rec)
 
     def _persist_commit(self) -> None:
         self._wal.append({"k": "commit", "i": self.commit_index})
@@ -310,11 +339,20 @@ class RaftNode:
                 and self.applied_index - self.snap_index >= self.snapshot_interval):
             self.compact(self.applied_index)
 
+    def _new_entry(self, data: bytes, kind: str = ENTRY_NORMAL) -> Entry:
+        """Next local entry, signed by the consenter when a signer is
+        configured (the only path that mints proposer/sig pairs)."""
+        term, index = self.term, self.last_index() + 1
+        if self.entry_signer is None:
+            return Entry(term, index, data, kind)
+        proposer, sig = self.entry_signer(term, index, data, kind)
+        return Entry(term, index, data, kind, proposer, sig)
+
     def propose(self, data: bytes) -> int:
         """Leader-only: append + replicate. Returns the entry index."""
         if self.role != LEADER:
             raise NotLeaderError(self.leader_id)
-        e = Entry(self.term, self.last_index() + 1, data)
+        e = self._new_entry(data)
         self.log.append(e)
         self._persist_entries([e])
         self.match_index[self.id] = e.index
@@ -326,7 +364,7 @@ class RaftNode:
         if self.role != LEADER:
             raise NotLeaderError(self.leader_id)
         data = serde.encode({"op": op, "node": node})
-        e = Entry(self.term, self.last_index() + 1, data, ENTRY_CONF)
+        e = self._new_entry(data, ENTRY_CONF)
         self.log.append(e)
         self._persist_entries([e])
         self.match_index[self.id] = e.index
@@ -377,8 +415,12 @@ class RaftNode:
 
     def _wal_records(self) -> List[dict]:
         recs = [{"k": "hs", "t": self.term, "v": self.voted_for}]
-        recs += [{"k": "ent", "t": e.term, "i": e.index, "d": e.data,
-                  "kd": e.kind} for e in self.log]
+        for e in self.log:
+            rec = {"k": "ent", "t": e.term, "i": e.index, "d": e.data,
+                   "kd": e.kind}
+            if e.sig:
+                rec["pr"], rec["sg"] = e.proposer, e.sig
+            recs.append(rec)
         recs.append({"k": "commit", "i": self.commit_index})
         return recs
 
@@ -433,7 +475,7 @@ class RaftNode:
         # without it, the §5.4.2 current-term commit guard in _maybe_commit
         # would leave a deposed leader's replicated entries uncommitted
         # until new client traffic arrives — stalling idle channels.
-        e = Entry(self.term, self.last_index() + 1, b"", ENTRY_NORMAL)
+        e = self._new_entry(b"")
         self.log.append(e)
         self._persist_entries([e])
         self.match_index[self.id] = e.index

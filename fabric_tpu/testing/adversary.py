@@ -97,10 +97,17 @@ class EquivocatingOrderer(OrdererNode):
     `crimes` keys:
       mode         "equivocate" (default): serve honest block then a
                    forged sibling at each crime height.
+                   "two_faced": equivocate ONLY toward the peers named
+                   in `victims` — every other caller gets a spotless
+                   honest stream.  Without fraud-proof gossip, only the
+                   victims ever hold conviction evidence; with it, one
+                   victim's conviction must spread network-wide.
                    "tamper_attests": flip the attestation digests on
                    every deliver frame from `fork_height` on (requires
                    attest_deliver on this orderer + trust_attestations
                    on the peer).
+      victims      ("two_faced" only) list of peer mspids and/or full
+                   "mspid|cert-sha256" bindings the crimes target
       fork_height  first height the crime fires at (default 2 — past
                    genesis/config so the honest chain has traction)
       count        how many consecutive heights to hit (default 1)
@@ -123,12 +130,32 @@ class EquivocatingOrderer(OrdererNode):
         start = int(self.crimes.get("fork_height", 2))
         return range(start, start + int(self.crimes.get("count", 1)))
 
+    def _is_victim(self, peer_identity) -> bool:
+        """two_faced target check: match the caller's mspid or its full
+        mspid|cert-sha256 binding against crimes["victims"]."""
+        victims = set(self.crimes.get("victims") or [])
+        if peer_identity is None or not victims:
+            return False
+        labels = {getattr(peer_identity, "mspid", None)}
+        try:
+            from fabric_tpu.orderer.cluster import cert_fingerprint
+            labels.add(f"{peer_identity.mspid}|"
+                       f"{cert_fingerprint(peer_identity.cert)}")
+        except Exception:
+            pass
+        return bool(victims & labels)
+
     def _rpc_deliver(self, body: dict, peer_identity):
         from fabric_tpu.protocol.types import Block
         mode = self.crimes.get("mode", "equivocate")
         only = self.crimes.get("channel")
         cid = body.get("channel")
         armed = only is None or cid == only
+        if mode == "two_faced":
+            # honest face for everyone but the configured victims; the
+            # crime itself is the plain double-serve below
+            armed = armed and self._is_victim(peer_identity)
+            mode = "equivocate"
         heights = self._crime_heights()
         for out in super()._rpc_deliver(body, peer_identity):
             if not armed:

@@ -84,6 +84,8 @@ SCENARIOS: Dict[str, dict] = {
             {"kind": "converged", "min_height": 2},
             {"kind": "zero_quarantines"},
             {"kind": "min_committed", "value": 1},
+            {"kind": "p99_ms", "objective": "commit_p99_s",
+             "max_ms": 30000},
         ],
     },
     "equivocation": {
@@ -100,6 +102,32 @@ SCENARIOS: Dict[str, dict] = {
             {"name": "ramp", "duration_s": 8.0,
              "arrivals": {"kind": "ramp", "start_rate": 4.0,
                           "end_rate": 20.0, "ramp_s": 6.0}},
+        ],
+        "expect": [
+            {"kind": "converged", "min_height": 4},
+            {"kind": "quarantine", "reasons": ["fork", "equivocation"],
+             "min": 1, "on": "all_peers"},
+            {"kind": "fraud_proofs", "min": 1, "on": "all_peers"},
+            {"kind": "exactly_once"},
+            {"kind": "min_committed", "value": 1},
+        ],
+    },
+    "two-faced": {
+        "description": "orderer1 keeps an honest raft face but "
+                       "equivocates on deliver ONLY toward Org1's peer; "
+                       "Org2's peer sees a spotless stream and must "
+                       "still convict — via the victim's gossiped fraud "
+                       "proof, independently re-verified — and every "
+                       "peer demotes the convicted endpoints to last "
+                       "resort while committing exactly-once",
+        "topology": {"n_orderers": 3, "peer_orgs": ["Org1", "Org2"],
+                     "peers_per_org": 1},
+        "adversaries": {"orderer1": {"mode": "two_faced",
+                                     "victims": ["Org1"],
+                                     "fork_height": 3, "count": 2}},
+        "phases": [
+            {"name": "steady", "duration_s": 8.0,
+             "arrivals": {"kind": "constant", "rate": 10.0}},
         ],
         "expect": [
             {"kind": "converged", "min_height": 4},
@@ -153,6 +181,35 @@ SCENARIOS: Dict[str, dict] = {
             {"kind": "quarantine", "reasons": ["tampered_attestation"],
              "min": 1, "on": "any_peer"},
             {"kind": "min_committed", "value": 1},
+        ],
+    },
+    "snapshot-under-adversary": {
+        "description": "the r12 wiped-peer snapshot rejoin with an "
+                       "ACTIVE adversary: orderer1 equivocates mid-run, "
+                       "then peerOrg2_0 is killed, its ledger wiped, and "
+                       "it rejoins by snapshot with a quarantined source "
+                       "listed first — the wipe-surviving registry must "
+                       "steer the bootstrap to the honest source",
+        "topology": {"n_orderers": 3, "peer_orgs": ["Org1", "Org2"],
+                     "peers_per_org": 2},
+        "adversaries": {"orderer1": {"mode": "equivocate",
+                                     "fork_height": 3, "count": 2}},
+        "snapshot_rejoin": {"victim": "peerOrg2_0",
+                            "quarantined_source": "peerOrg1_0",
+                            "honest_source": "peerOrg1_1"},
+        "phases": [
+            {"name": "steady", "duration_s": 8.0,
+             "arrivals": {"kind": "constant", "rate": 10.0}},
+        ],
+        "expect": [
+            {"kind": "snapshot_rejoin"},
+            {"kind": "converged", "min_height": 4, "timeout_s": 45.0},
+            {"kind": "quarantine", "reasons": ["fork", "equivocation"],
+             "min": 1, "on": "any_peer"},
+            {"kind": "exactly_once"},
+            {"kind": "min_committed", "value": 1},
+            {"kind": "p99_ms", "objective": "commit_p99_s",
+             "max_ms": 30000},
         ],
     },
     "mixed-identity": {
@@ -282,6 +339,67 @@ def _poison_thread(net, spec: dict, sent: dict) -> threading.Thread:
 
 
 # ---------------------------------------------------------------------------
+# wiped-peer snapshot rejoin under an active adversary
+
+def _snapshot_rejoin(net, spec: dict) -> dict:
+    """The r12 wiped-peer rejoin drill with standing: kill + wipe the
+    victim peer's ledger, seed its quarantine registry (which SURVIVES
+    the wipe — it is node-scoped, not ledger-scoped) with the first
+    snapshot source's identity — the conviction a gossiped fraud proof
+    left in the victim's previous life — then restart it with the
+    convicted source listed FIRST.  The rejoining peer must refuse that
+    source and bootstrap from the honest one behind it."""
+    import shutil
+    from fabric_tpu.byzantine import QuarantineRegistry
+    from fabric_tpu.orderer.cluster import cert_fingerprint
+
+    cfg = dict(spec["snapshot_rejoin"])
+    victim = str(cfg.get("victim", "peerOrg2_0"))
+    evil = str(cfg.get("quarantined_source", "peerOrg1_0"))
+    honest = str(cfg.get("honest_source", "peerOrg1_1"))
+    out: dict = {"victim": victim, "quarantined_source": evil,
+                 "honest_source": honest}
+
+    def _load_cfg(name):
+        with open(net._specs[name][1]) as f:
+            c = json.load(f)
+        return c
+
+    evil_cfg, honest_cfg, vcfg = (_load_cfg(evil), _load_cfg(honest),
+                                  _load_cfg(victim))
+    evil_addr = [evil_cfg.get("host", "127.0.0.1"), int(evil_cfg["port"])]
+    honest_addr = [honest_cfg.get("host", "127.0.0.1"),
+                   int(honest_cfg["port"])]
+    evil_node = net.nodes[evil]
+    evil_key = (f"{evil_node.signer.mspid}|"
+                f"{cert_fingerprint(evil_node.signer.cert)}")
+
+    net.kill(victim)
+    ledger_root = os.path.join(vcfg["data_dir"], "channels",
+                               net.channel_id, "ledger")
+    if not os.path.isdir(ledger_root):
+        ledger_root = os.path.join(vcfg["data_dir"], "ledger")
+    shutil.rmtree(ledger_root, ignore_errors=True)
+    QuarantineRegistry(
+        os.path.join(vcfg["data_dir"], "byzantine_quarantine.json")
+    ).quarantine(evil_key, "equivocation")
+    vcfg["bootstrap_snapshot"] = {
+        "enabled": True, "from": [evil_addr, honest_addr],
+        "chunk_timeout_s": 2.0, "attempts": 4}
+    with open(net._specs[victim][1], "w") as f:
+        json.dump(vcfg, f)
+    node = net.restart(victim)
+    ch = node.channels[net.channel_id]
+    info = getattr(ch, "snapshot_bootstrap", None)
+    out["bootstrap"] = info
+    out["base"] = int(ch.ledger.blockstore.base)
+    src = list(info.get("from", [])) if info else None
+    out["from_honest"] = src == list(honest_addr)
+    out["refused_quarantined"] = src != list(evil_addr)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # byzantine state collection + SLO evaluation
 
 def _byz_state(net) -> dict:
@@ -290,12 +408,25 @@ def _byz_state(net) -> dict:
         byz = getattr(node, "byzantine", None)
         if byz is None:
             continue
+        kind = net._specs[name][0]
         chans = {}
-        for cid, ch in getattr(node, "channels", {}).items():
-            mon = getattr(ch, "byz_monitor", None)
-            if mon is not None:
+        if kind == "peer":
+            for cid, ch in getattr(node, "channels", {}).items():
+                mon = getattr(ch, "byz_monitor", None)
+                if mon is not None:
+                    chans[cid] = mon.snapshot()
+                pg = getattr(ch, "proof_gossip", None)
+                if pg is not None:
+                    chans.setdefault(cid, {})["proof_gossip"] = \
+                        pg.snapshot()
+        else:
+            # orderers carry per-channel monitors too (r14); their
+            # registry reads identically but quarantine expectations
+            # are judged against PEERS, so kind rides along
+            for cid, mon in getattr(node, "byz_monitors", {}).items():
                 chans[cid] = mon.snapshot()
-        out[name] = {"quarantined": byz.count(),
+        out[name] = {"kind": kind,
+                     "quarantined": byz.count(),
                      "reasons": byz.reasons(),
                      "identities": sorted(byz.snapshot()),
                      "channels": chans}
@@ -308,7 +439,10 @@ def _committed_txids(peer, channel_id: str) -> List[str]:
     from fabric_tpu.protocol.types import Envelope
     store = peer.channels[channel_id].ledger.blockstore
     txids: List[str] = []
-    for num in range(store.height):
+    # a snapshot-rejoined peer has no blocks below its snapshot base;
+    # exactly-once is judged over what the store actually holds
+    base = int(getattr(store, "base", 0) or 0)
+    for num in range(base, store.height):
         for raw in store.get_by_number(num).data:
             try:
                 hdr = Envelope.deserialize(bytes(raw)).header()
@@ -320,12 +454,17 @@ def _committed_txids(peer, channel_id: str) -> List[str]:
     return txids
 
 
-def _check_expectations(spec: dict, net, report: dict) -> List[str]:
+def _check_expectations(spec: dict, net, report: dict,
+                        slo_eval=None) -> List[str]:
     """Evaluate the `expect` block; returns human-readable violations
     (empty = all SLOs held)."""
     violations: List[str] = []
     byz = report["byzantine"]
-    peers = {n: s for n, s in byz.items()}
+    # quarantine/fraud-proof expectations are judged against PEERS:
+    # orderer nodes carry registries of their own (r14) and "all_peers"
+    # must not demand a conviction from the adversary's own process
+    peers = {n: s for n, s in byz.items()
+             if s.get("kind", "peer") == "peer"}
     tot = report.get("totals", {})
     for check in spec.get("expect", []):
         kind = check["kind"]
@@ -340,11 +479,21 @@ def _check_expectations(spec: dict, net, report: dict) -> List[str]:
                     f"converged: peers diverged or stalled "
                     f"(heights={net.heights()})")
         elif kind == "zero_quarantines":
-            noisy = {n: s["reasons"] for n, s in peers.items()
+            # every node kind: crash-stop faults must be silent on
+            # orderer registries too
+            noisy = {n: s["reasons"] for n, s in byz.items()
                      if s["quarantined"]}
             if noisy:
                 violations.append(
                     f"zero_quarantines: false positives {noisy}")
+            loud = {n: ch["proof_gossip"]["broadcasts"]
+                    for n, s in byz.items()
+                    for ch in s["channels"].values()
+                    if ch.get("proof_gossip", {}).get("broadcasts")}
+            if loud:
+                violations.append(
+                    f"zero_quarantines: fraud proofs broadcast with "
+                    f"nothing to prove {loud}")
         elif kind == "quarantine":
             reasons = check.get("reasons", [])
             need = int(check.get("min", 1))
@@ -376,6 +525,45 @@ def _check_expectations(spec: dict, net, report: dict) -> List[str]:
                 violations.append(
                     f"max_shed_frac: {tot.get('shed_frac')} > "
                     f"{check['value']}")
+        elif kind == "p99_ms":
+            # latency-percentile assertion fed from the SLO evaluator's
+            # WINDOWED quantiles (ops_plane/slo.py) — the same numbers
+            # /slo serves in production, not a whole-run average
+            obj_name = check.get("objective", "commit_p99_s")
+            limit = float(check["max_ms"])
+            value_ms = None
+            if slo_eval is not None:
+                try:
+                    slo_eval.step()      # force one final sample+eval
+                except Exception:
+                    logger.exception("slo evaluator step failed")
+                for obj in slo_eval.status().get("objectives", []):
+                    if obj.get("name") != obj_name:
+                        continue
+                    v = obj.get("value_short")
+                    if v is None:
+                        v = obj.get("value_long")
+                    if v is not None:
+                        value_ms = round(float(v) * 1000.0, 3)
+            report.setdefault("latency_p99_ms", {})[obj_name] = value_ms
+            if value_ms is None:
+                violations.append(
+                    f"p99_ms[{obj_name}]: no windowed quantile observed")
+            elif value_ms > limit:
+                violations.append(
+                    f"p99_ms[{obj_name}]: {value_ms}ms > {limit}ms")
+        elif kind == "snapshot_rejoin":
+            sr = report.get("snapshot_rejoin") or {}
+            if sr.get("base", 0) <= 0:
+                violations.append(
+                    f"snapshot_rejoin: no snapshot installed ({sr})")
+            elif not sr.get("refused_quarantined"):
+                violations.append(
+                    f"snapshot_rejoin: bootstrapped from the "
+                    f"quarantined source ({sr})")
+            elif not sr.get("from_honest"):
+                violations.append(
+                    f"snapshot_rejoin: honest source not used ({sr})")
         elif kind == "exactly_once":
             dup_peers = {}
             for name, node in net.nodes.items():
@@ -447,6 +635,16 @@ def run_scenario(name: str, seed: int = 7,
     plan = build_plan(spec, seed)
     poison_sent: dict = {}
     clients = None
+    # scenario-owned SLO evaluator over the process-global metrics
+    # registry: ChaosNet nodes run without ops servers, so p99_ms
+    # expectations sample here — tight windows sized to drill length
+    slo_eval = None
+    if any(c.get("kind") == "p99_ms" for c in spec.get("expect", [])):
+        from fabric_tpu.ops_plane import slo as _slo
+        slo_eval = _slo.SloEvaluator({"sample_interval_s": 0.5,
+                                      "short_window_s": 10.0,
+                                      "long_window_s": 60.0})
+        slo_eval.start()
     try:
         net.start()
         if plan is not None:
@@ -521,6 +719,10 @@ def run_scenario(name: str, seed: int = 7,
             faults.uninstall()
             plan = None
 
+        # -- post-run drills ------------------------------------------
+        if spec.get("snapshot_rejoin"):
+            report["snapshot_rejoin"] = _snapshot_rejoin(net, spec)
+
         # -- post-run evidence + SLO evaluation ------------------------
         report["byzantine"] = _byz_state(net)
         crimes = {}
@@ -536,11 +738,14 @@ def run_scenario(name: str, seed: int = 7,
                     p.name if hasattr(p, "name") else "peer"] = \
                     p.slo.alerts_snapshot()
                 break
-        violations = _check_expectations(spec, net, report)
+        violations = _check_expectations(spec, net, report,
+                                         slo_eval=slo_eval)
         report["slo"] = {"pass": not violations,
                          "checks": len(spec.get("expect", [])),
                          "violations": violations}
     finally:
+        if slo_eval is not None:
+            slo_eval.stop()
         if plan is not None:
             faults.uninstall()
         if clients is not None:

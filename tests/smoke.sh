@@ -61,6 +61,9 @@ python tests/smoke_snapshot.py
 echo "== byzantine scenario drills (equivocation containment + crash-stop control) =="
 python tests/smoke_scenarios.py
 
+echo "== two-faced orderer drill (fraud-proof gossip, network-wide conviction) =="
+python tests/smoke_proof_gossip.py
+
 echo "== ASan/UBSan fuzz corpus vs the native wire parser =="
 # Build _fastparse with the sanitizers and drive the full adversarial
 # corpus (tests/test_fastparse.py --asan-corpus) through it: any heap

@@ -1,0 +1,84 @@
+"""Smoke: the two-faced-orderer drill, end-to-end.
+
+The r14 threat model: an orderer keeps an honest raft face but
+equivocates on DELIVER only toward selected victims.  Pre-r14, the one
+victim that saw both headers convicted and everyone else kept trusting
+the criminal.  This probe runs the "two-faced" catalog scenario and
+asserts the network-wide containment story off the report evidence:
+
+  * the victim org's peer convicts from its own witness and broadcasts
+    a signed portable fraud proof;
+  * the non-victim peer — which saw a spotless stream — convicts via
+    the gossiped proof, independently re-verified against its own
+    chain, and re-broadcasts it (epidemic propagation);
+  * duplicates terminate at the quarantine first-conviction gate;
+  * deliver re-sources away from the convicted endpoints and the chain
+    still converges exactly-once past the crime heights.
+
+Run: python tests/smoke_proof_gossip.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+
+from fabric_tpu.workload import scenarios
+
+VICTIM, BYSTANDER = "peerOrg1_0", "peerOrg2_0"
+
+
+def main():
+    path = os.path.join(tempfile.gettempdir(),
+                        "smoke_scenario_two-faced_7.json")
+    report = scenarios.run_scenario("two-faced", seed=7,
+                                    report_path=path, strict=True)
+    with open(path) as f:
+        disk = json.load(f)
+    assert disk["scenario"] == "two-faced"
+    assert report["slo"]["pass"], report["slo"]
+
+    # the adversary really committed deliver-plane crimes
+    crimes = report.get("crimes", {}).get("orderer1", [])
+    assert crimes, "adversary committed no crimes"
+    assert all(c["kind"] == "equivocate" for c in crimes), crimes
+
+    byz = report["byzantine"]
+    vic = byz[VICTIM]["channels"]["ch"]
+    byst = byz[BYSTANDER]["channels"]["ch"]
+
+    # network-wide conviction: BOTH peers hold the quarantine + proof
+    for name in (VICTIM, BYSTANDER):
+        assert byz[name]["quarantined"] >= 1, (name, byz[name])
+        assert sum(byz[name]["reasons"].get(r, 0)
+                   for r in ("fork", "equivocation")) >= 1, byz[name]
+
+    # the victim witnessed the crime and originated the broadcast
+    assert vic["proof_gossip"]["broadcasts"] >= 1, vic
+    # the bystander convicted via a RECEIVED proof (it saw an honest
+    # stream: zero local broadcasts) and relayed the epidemic onward
+    assert byst["proof_gossip"]["broadcasts"] == 0, byst
+    assert byst["proof_gossip"]["received"]["convicted"] >= 1, byst
+    assert byst["proof_gossip"]["relayed"] >= 1, byst
+    assert byst["fraud_proofs"] >= 1, byst
+
+    # epidemic termination: every later copy died as a duplicate, none
+    # was rejected (all proofs re-verified independently)
+    total_dup = (vic["proof_gossip"]["received"]["duplicate"]
+                 + byst["proof_gossip"]["received"]["duplicate"])
+    assert total_dup >= 1, (vic, byst)
+    assert vic["proof_gossip"]["received"]["rejected"] == 0, vic
+    assert byst["proof_gossip"]["received"]["rejected"] == 0, byst
+
+    # containment never partitioned anyone: the chain converged past
+    # the crime heights and committed exactly-once under re-sourcing
+    assert report["converged"] is True, report.get("heights")
+    assert report["exactly_once"] is True
+
+    print(f"OK: two-faced proof-gossip drill passed "
+          f"({report['slo']['checks']} checks; report: {path})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,339 @@
+"""Fraud-proof gossip units: the negative paths that keep the epidemic
+honest.  A received proof convicts ONLY when it independently
+re-verifies — accuser signature AND a self-incriminating payload by the
+accused — so these tests pin every way a proof must fail:
+
+  * tampered evidence / tampered accusation  -> rejected
+  * accuser unknown to the channel MSPs      -> rejected
+  * replay of an already-served conviction   -> duplicate, no re-gossip
+  * accusing a node of crash-stop behavior   -> rejected (no crime
+    a dead node could not also have "committed" may convict anyone)
+
+plus the positive path: a genuine equivocation pair convicts on a
+monitor with NO local witness state, and the conviction re-broadcasts.
+"""
+
+import json
+
+import pytest
+
+from fabric_tpu.byzantine import (
+    ByzantineMonitor,
+    ProofGossip,
+    QuarantineRegistry,
+    WitnessLog,
+    build_fraud_proof,
+    verify_fraud_proof_strict,
+)
+
+
+# ---------------------------------------------------------------------------
+# fixtures
+
+@pytest.fixture(scope="module")
+def org():
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    from fabric_tpu.msp.ca import DevOrg
+    init_factories(FactoryOpts(default="SW"))
+    return DevOrg("OrdererOrg")
+
+
+@pytest.fixture(scope="module")
+def msps(org):
+    from fabric_tpu.msp import CachedMSP
+    return {"OrdererOrg": CachedMSP(org.msp())}
+
+
+@pytest.fixture(scope="module")
+def signers(org):
+    return [org.new_identity(f"osn{i}") for i in range(3)]
+
+
+def _binding(signer):
+    from fabric_tpu.orderer.cluster import cert_fingerprint
+    return f"{signer.mspid}|{cert_fingerprint(signer.cert)}"
+
+
+def _signed_block(num, prev, data, signer, last_config=0):
+    from fabric_tpu.orderer.blockwriter import block_signed_bytes
+    from fabric_tpu.protocol.build import new_nonce
+    from fabric_tpu.protocol.types import (
+        META_LAST_CONFIG, META_SIGNATURES, Block, BlockHeader,
+        BlockMetadata, block_data_hash)
+    header = BlockHeader(num, prev, block_data_hash(data))
+    blk = Block(header, list(data),
+                BlockMetadata({META_LAST_CONFIG: last_config}))
+    sig_header = {"creator": signer.serialize(), "nonce": new_nonce()}
+    blk.metadata.items[META_SIGNATURES] = [{
+        "sig_header": sig_header,
+        "signature": signer.sign(
+            block_signed_bytes(blk, sig_header, last_config))}]
+    return blk
+
+
+class _LedgerStub:
+    def __init__(self):
+        self.blocks = {}
+
+    @property
+    def height(self):
+        return max(self.blocks) + 1 if self.blocks else 0
+
+    @property
+    def blockstore(self):
+        return self
+
+    def get_by_number(self, num):
+        return self.blocks[num]
+
+
+def _monitor(tmp_path, msps, signer, ledger=None, tag=""):
+    q = QuarantineRegistry(str(tmp_path / f"q{tag}.json"))
+    mon = ByzantineMonitor(
+        "ch", WitnessLog(str(tmp_path / f"w{tag}.json")), q,
+        ledger=ledger, msps=msps, signer=signer,
+        proof_dir=str(tmp_path / f"proofs{tag}"))
+    return mon, q
+
+
+def _equivocation_proof(signers, height=5, accuser=None):
+    """A genuine, fully self-contained equivocation-pair proof: the
+    accused validly signed two DIFFERENT headers at one height, both
+    incriminating signatures ride inside the evidence."""
+    from fabric_tpu.byzantine.monitor import _incriminating_sigs
+    evil = signers[1]
+    a = _signed_block(height, b"\x01" * 32, [b"tx-a"], evil)
+    b = _signed_block(height, b"\x01" * 32, [b"tx-a", b"tx-a"], evil)
+    return build_fraud_proof(
+        "ch", height, _binding(evil), "equivocation",
+        {"attested": _incriminating_sigs(a) + _incriminating_sigs(b)},
+        accuser if accuser is not None else signers[0])
+
+
+# ---------------------------------------------------------------------------
+# positive path: remote conviction with zero local witness evidence
+
+def test_equivocation_pair_convicts_without_local_witness(
+        tmp_path, msps, signers):
+    proof = _equivocation_proof(signers)
+    ok, why = verify_fraud_proof_strict(proof, msps)
+    assert ok and why == "equivocation_pair"
+    mon, q = _monitor(tmp_path, msps, signers[0])
+    assert mon.accept_remote_proof(proof, relay="peer1") == "convicted"
+    assert q.is_quarantined(_binding(signers[1]))
+    # the conviction is persisted as a proof of its own
+    assert len(mon.proofs) == 1
+
+
+def test_proof_survives_json_wire_roundtrip(tmp_path, msps, signers):
+    """Gossip ships proofs as canonical JSON; the signature must hold
+    after a decode on the receiving side."""
+    proof = _equivocation_proof(signers)
+    wire = json.dumps(proof, sort_keys=True).encode()
+    ok, why = verify_fraud_proof_strict(json.loads(wire.decode()), msps)
+    assert ok and why == "equivocation_pair"
+
+
+# ---------------------------------------------------------------------------
+# negative paths
+
+def test_tampered_proof_rejected(tmp_path, msps, signers):
+    proof = _equivocation_proof(signers)
+    # 1. re-point the accusation at an innocent identity
+    framed = dict(proof, accused=_binding(signers[2]))
+    assert verify_fraud_proof_strict(framed, msps)[0] is False
+    # 2. tamper the evidence under the accuser's intact signature
+    tampered = dict(proof)
+    tampered["evidence"] = {"attested": []}
+    assert verify_fraud_proof_strict(tampered, msps) \
+        == (False, "bad_accuser_sig")
+    # 3. flip a byte inside an attested signature (evidence re-signed
+    #    by nobody: the accused's own signature no longer verifies)
+    cooked = json.loads(json.dumps(proof))
+    ent = cooked["evidence"]["attested"][0]
+    ent["signature"] = ("00" if ent["signature"][:2] != "00" else "ff") \
+        + ent["signature"][2:]
+    cooked2 = build_fraud_proof(
+        "ch", cooked["height"], cooked["accused"], cooked["reason"],
+        cooked["evidence"], signers[0])
+    ok, _ = verify_fraud_proof_strict(cooked2, msps)
+    assert ok is False
+    mon, q = _monitor(tmp_path, msps, signers[0])
+    assert mon.accept_remote_proof(framed) == "rejected"
+    assert q.count() == 0
+
+
+def test_unknown_accuser_rejected(tmp_path, msps, signers):
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    from fabric_tpu.msp.ca import DevOrg
+    init_factories(FactoryOpts(default="SW"))
+    outsider = DevOrg("Outsiders").new_identity("notary0")
+    proof = _equivocation_proof(signers, accuser=outsider)
+    assert verify_fraud_proof_strict(proof, msps) \
+        == (False, "bad_accuser_sig")
+    mon, q = _monitor(tmp_path, msps, signers[0])
+    assert mon.accept_remote_proof(proof) == "rejected"
+    assert q.count() == 0
+
+
+def test_replayed_proof_is_duplicate(tmp_path, msps, signers):
+    proof = _equivocation_proof(signers)
+    mon, q = _monitor(tmp_path, msps, signers[0])
+    assert mon.accept_remote_proof(proof) == "convicted"
+    # byte-identical replay AND a fresh proof for the same signer both
+    # stop at the registry's first-conviction gate
+    assert mon.accept_remote_proof(proof) == "duplicate"
+    assert mon.accept_remote_proof(
+        _equivocation_proof(signers, height=6)) == "duplicate"
+    assert q.count() == 1
+    assert len(mon.proofs) == 1        # no second persisted proof
+
+
+def test_crash_stop_accusation_never_convicts(tmp_path, msps, signers):
+    """A proof whose evidence contains NO signature by the accused over
+    conflicting payloads describes behavior a crashed node could also
+    show — it must never convict, whoever signs the accusation."""
+    dead = _binding(signers[2])
+    mon, q = _monitor(tmp_path, msps, signers[0])
+    # timeouts / unreachability dressed up as an accusation
+    p1 = build_fraud_proof("ch", 4, dead, "equivocation",
+                           {"attested": [], "note": "stopped answering"},
+                           signers[0])
+    assert verify_fraud_proof_strict(p1, msps) \
+        == (False, "no_self_incriminating_signature")
+    assert mon.accept_remote_proof(p1) == "rejected"
+    # a non-crime reason is unprovable by construction
+    p2 = build_fraud_proof("ch", 4, dead, "stale", {"attested": []},
+                           signers[0])
+    assert verify_fraud_proof_strict(p2, msps) \
+        == (False, "unprovable_reason")
+    assert mon.accept_remote_proof(p2) == "rejected"
+    assert q.count() == 0 and not mon.proofs
+
+
+def test_single_header_needs_local_conflict(tmp_path, msps, signers):
+    """One incriminating signature convicts only against the receiver's
+    OWN committed chain (fork), and never when it matches it."""
+    from fabric_tpu.byzantine.monitor import _incriminating_sigs
+    evil = signers[1]
+    honest = _signed_block(3, b"\x02" * 32, [b"tx-h"], signers[0])
+    forged = _signed_block(3, b"\x02" * 32, [b"tx-h", b"tx-h"], evil)
+    proof = build_fraud_proof("ch", 3, _binding(evil), "fork",
+                              {"attested": _incriminating_sigs(forged)},
+                              signers[0])
+    # no ledger: a single header proves nothing
+    assert verify_fraud_proof_strict(proof, msps) \
+        == (False, "unverifiable_single_header")
+    # our chain holds a DIFFERENT block at 3: the ledger is the witness
+    led = _LedgerStub()
+    led.blocks = {0: honest, 1: honest, 2: honest, 3: honest}
+    assert verify_fraud_proof_strict(proof, msps, ledger=led) \
+        == (True, "fork_vs_local_chain")
+    # the "forged" header IS our committed block: nothing to convict
+    self_proof = build_fraud_proof(
+        "ch", 3, _binding(signers[0]),
+        "fork", {"attested": _incriminating_sigs(honest)}, signers[1])
+    assert verify_fraud_proof_strict(self_proof, msps, ledger=led) \
+        == (False, "matches_local_chain")
+
+
+def test_early_single_header_proof_deferred_until_commit(
+        tmp_path, msps, signers):
+    """A fork proof can outrun the receiver's own commit of the height
+    it conflicts with: it is parked — not dropped — and convicts (and
+    resumes the epidemic) once the local chain catches up."""
+    from fabric_tpu.byzantine.monitor import _incriminating_sigs
+    evil = signers[1]
+    honest = _signed_block(3, b"\x04" * 32, [b"tx-h"], signers[0])
+    forged = _signed_block(3, b"\x04" * 32, [b"tx-h", b"tx-h"], evil)
+    proof = build_fraud_proof("ch", 3, _binding(evil), "fork",
+                              {"attested": _incriminating_sigs(forged)},
+                              signers[0])
+    led = _LedgerStub()
+    mon, q = _monitor(tmp_path, msps, signers[0], ledger=led)
+    fired = []
+    mon.on_proof = fired.append
+    assert mon.accept_remote_proof(proof, relay="p1") == "deferred"
+    assert not q.is_quarantined(_binding(evil))
+    # chain advances past the proof height with a CONFLICTING block
+    led.blocks = {n: honest for n in range(4)}
+    mon.on_committed(4)
+    assert q.is_quarantined(_binding(evil))
+    assert fired and fired[0]["accused"] == _binding(evil)
+    assert mon.snapshot()["deferred_proofs"] == 0
+    # replay of the now-served proof: straight duplicate
+    assert mon.accept_remote_proof(proof) == "duplicate"
+
+
+# ---------------------------------------------------------------------------
+# the gossip layer: fan-out, re-broadcast, epidemic termination
+
+class _Endpoint:
+    def __init__(self):
+        self.sent = []
+
+    def send(self, to, msg_type, body):
+        self.sent.append((to, msg_type, dict(body)))
+
+
+class _Discovery:
+    def __init__(self, ids):
+        self.ids = list(ids)
+
+    def alive_ids(self):
+        return list(self.ids)
+
+
+def _gossip(tmp_path, msps, signer, tag=""):
+    mon, q = _monitor(tmp_path, msps, signer, tag=tag)
+    ep = _Endpoint()
+    pg = ProofGossip(ep, _Discovery(["p1", "p2"]), mon)
+    mon.on_proof = pg.broadcast
+    return pg, mon, q, ep
+
+
+def test_broadcast_fans_out_canonical_json(tmp_path, msps, signers):
+    pg, mon, q, ep = _gossip(tmp_path, msps, signers[0])
+    proof = _equivocation_proof(signers)
+    pg.broadcast(proof)
+    assert pg.broadcasts == 1 and len(ep.sent) == 2
+    for to, msg_type, body in ep.sent:
+        assert msg_type == "gossip.fraud_proof"
+        shipped = json.loads(bytes(body["proof"]).decode())
+        assert verify_fraud_proof_strict(shipped, msps)[0]
+
+
+def test_received_conviction_rebroadcasts_once(tmp_path, msps, signers):
+    pg, mon, q, ep = _gossip(tmp_path, msps, signers[0], tag="rx")
+    raw = json.dumps(_equivocation_proof(signers),
+                     sort_keys=True).encode()
+    pg.handle("peerX", {"proof": raw})
+    assert pg.received["convicted"] == 1 and pg.relayed == 1
+    assert q.is_quarantined(_binding(signers[1]))
+    first_wave = len(ep.sent)
+    assert first_wave == 2
+    # the SAME proof again: duplicate — the epidemic dies here
+    pg.handle("peerY", {"proof": raw})
+    assert pg.received["duplicate"] == 1 and pg.relayed == 1
+    assert len(ep.sent) == first_wave
+    # garbage from the wire: rejected, no relay, no conviction
+    pg.handle("peerZ", {"proof": b"\xde\xad"})
+    assert pg.received["rejected"] == 1 and pg.relayed == 1
+    assert q.count() == 1
+
+
+def test_local_conviction_triggers_broadcast(tmp_path, msps, signers):
+    """The on_proof hook: a conviction minted from LOCAL witness
+    evidence leaves the node as a portable proof."""
+    from fabric_tpu.protocol import block_header_hash
+    pg, mon, q, ep = _gossip(tmp_path, msps, signers[0], tag="lc")
+    evil = signers[1]
+    a = _signed_block(2, b"\x03" * 32, [b"x"], evil)
+    b = _signed_block(2, b"\x03" * 32, [b"x", b"x"], evil)
+    mon.check_block(a, "orderer:a")
+    mon.check_block(b, "orderer:b")
+    assert q.is_quarantined(_binding(evil))
+    assert pg.broadcasts >= 1
+    shipped = json.loads(bytes(ep.sent[0][2]["proof"]).decode())
+    assert shipped["accused"] == _binding(evil)
+    assert block_header_hash(a.header) != block_header_hash(b.header)

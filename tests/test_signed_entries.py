@@ -1,0 +1,171 @@
+"""Signed-raft-entry units: the consenter signature chain on the
+replication path (orderer/raft.Entry.proposer/sig + EntryVerifier).
+
+Pins the guard's whole decision table:
+
+  accept   a consenter-signed entry; byte-identical retransmits
+  reject   unsigned entries, non-consenter proposers, spliced payloads
+           (valid-looking entry whose signature covers different bytes)
+  crime    a SECOND payload under one (term, index, proposer) slot with
+           a second valid signature — equivocation proven by the pair,
+           and the minted evidence independently re-verifies as a
+           portable fraud proof
+
+and the legitimate raft behaviours that must NOT trip it: conflict
+truncation replaces slots under a HIGHER term, retransmits repeat the
+same bytes.
+"""
+
+import pytest
+
+from fabric_tpu.orderer.cluster import EntryVerifier, cert_fingerprint
+from fabric_tpu.orderer.consensus import make_entry_signer
+from fabric_tpu.orderer.raft import Entry, entry_signed_bytes
+
+
+@pytest.fixture(scope="module")
+def org():
+    from fabric_tpu.bccsp.factory import FactoryOpts, init_factories
+    from fabric_tpu.msp.ca import DevOrg
+    init_factories(FactoryOpts(default="SW"))
+    return DevOrg("OrdererOrg")
+
+
+@pytest.fixture(scope="module")
+def msps(org):
+    from fabric_tpu.msp import CachedMSP
+    return {"OrdererOrg": CachedMSP(org.msp())}
+
+
+@pytest.fixture(scope="module")
+def signers(org):
+    return [org.new_identity(f"osn{i}") for i in range(3)]
+
+
+def _binding(signer):
+    return f"{signer.mspid}|{cert_fingerprint(signer.cert)}"
+
+
+def _verifier(msps, signers):
+    consenters = {i + 1: (s.mspid, cert_fingerprint(s.cert))
+                  for i, s in enumerate(signers)}
+    return EntryVerifier("ch", msps, consenters)
+
+
+def _entry(signer, term, index, data, kind="normal"):
+    proposer, sig = make_entry_signer(signer)(term, index, data, kind)
+    return Entry(term, index, data, kind, proposer, sig)
+
+
+def test_signed_entries_accepted_and_retransmit_cached(msps, signers):
+    v = _verifier(msps, signers)
+    entries = [_entry(signers[0], 1, i, b"tx%d" % i) for i in range(1, 4)]
+    ok, why, crimes = v.check(entries)
+    assert ok and why is None and not crimes
+    # byte-identical retransmit: accepted off the digest cache
+    ok, why, crimes = v.check(entries)
+    assert ok and why is None and not crimes
+
+
+def test_unsigned_entry_rejected(msps, signers):
+    v = _verifier(msps, signers)
+    ok, why, _ = v.check([Entry(1, 1, b"tx")])
+    assert not ok and why == "unsigned_entry"
+
+
+def test_non_consenter_proposer_rejected(msps, signers, org):
+    v = _verifier(msps, signers[:2])       # osn2 NOT a consenter
+    outsider = signers[2]
+    ok, why, _ = v.check([_entry(outsider, 1, 1, b"tx")])
+    assert not ok and why == "bad_proposer"
+
+
+def test_spliced_payload_rejected(msps, signers):
+    """Splice: take a validly-signed entry, swap the payload (or slot)
+    and keep the signature — the signature covers different canonical
+    bytes and must fail."""
+    v = _verifier(msps, signers)
+    good = _entry(signers[0], 1, 1, b"tx-original")
+    spliced = Entry(good.term, good.index, b"tx-EVIL", good.kind,
+                    good.proposer, good.sig)
+    ok, why, _ = v.check([spliced])
+    assert not ok and why == "bad_entry_sig"
+    # replay into a different slot: same bytes, wrong (term, index)
+    replayed = Entry(good.term, good.index + 7, good.data, good.kind,
+                     good.proposer, good.sig)
+    ok, why, _ = v.check([replayed])
+    assert not ok and why == "bad_entry_sig"
+
+
+def test_equivocation_minted_as_portable_crime(msps, signers):
+    v = _verifier(msps, signers)
+    evil = signers[1]
+    a = _entry(evil, 2, 5, b"payload-a")
+    assert v.check([a])[0]
+    b = _entry(evil, 2, 5, b"payload-b")   # same slot, different bytes
+    ok, why, crimes = v.check([b])
+    assert not ok and why == "entry_equivocation"
+    assert len(crimes) == 1
+    crime = crimes[0]
+    assert crime["kind"] == "raft_entry_equivocation"
+    assert crime["binding"] == _binding(evil)
+    # the evidence pair is self-contained: a third party re-verifies it
+    # with nothing but the channel MSPs
+    from fabric_tpu.byzantine import build_fraud_proof
+    from fabric_tpu.byzantine.monitor import verify_fraud_proof_strict
+    proof = build_fraud_proof("ch", -1, crime["binding"], "equivocation",
+                              crime, signers[0])
+    assert verify_fraud_proof_strict(proof, msps) \
+        == (True, "entry_equivocation_pair")
+    # tampering either side kills it
+    import json
+    cooked = json.loads(json.dumps(crime))
+    cooked["a"]["data"] = cooked["b"]["data"]
+    bad = build_fraud_proof("ch", -1, crime["binding"], "equivocation",
+                            cooked, signers[0])
+    ok, reason = verify_fraud_proof_strict(bad, msps)
+    assert not ok
+
+
+def test_conflict_truncation_is_not_equivocation(msps, signers):
+    """A HIGHER-term replacement of a slot is legitimate raft conflict
+    resolution, keyed separately — no crime, no rejection."""
+    v = _verifier(msps, signers)
+    assert v.check([_entry(signers[0], 1, 4, b"old-leader")])[0]
+    ok, why, crimes = v.check([_entry(signers[0], 3, 4, b"new-leader")])
+    assert ok and why is None and not crimes
+
+
+def test_relayed_predecessor_entries_accepted(msps, signers):
+    """A new leader relays entries its predecessor signed: proposer
+    differs from the transport sender and from the current leader —
+    still valid, attribution follows the SIGNER."""
+    v = _verifier(msps, signers)
+    mixed = [_entry(signers[0], 1, 1, b"from-osn0"),
+             _entry(signers[1], 1, 2, b"from-osn1")]
+    ok, why, crimes = v.check(mixed)
+    assert ok and why is None and not crimes
+
+
+def test_raftnode_signs_every_local_append(msps, signers):
+    """RaftNode + make_entry_signer end-to-end: proposals AND the
+    leader no-op carry verifiable consenter signatures."""
+    from fabric_tpu.orderer.raft import LEADER, RaftNode
+    node = RaftNode(1, peers=[],
+                    entry_signer=make_entry_signer(signers[0]))
+    for _ in range(200):                # single-node self-election
+        node.tick()
+        if node.role == LEADER:
+            break
+    assert node.role == LEADER
+    node.propose(b"tx-1")
+    node.propose(b"tx-2")
+    v = _verifier(msps, signers)
+    assert node.log, "no entries appended"
+    ok, why, crimes = v.check(node.log)
+    assert ok and why is None and not crimes
+    for e in node.log:
+        assert e.proposer and e.sig
+        ident_ok = signers[0].verify(
+            entry_signed_bytes(e.term, e.index, e.data, e.kind), e.sig)
+        assert ident_ok

@@ -392,16 +392,17 @@ def test_trace_arrivals_bare_numbers_and_empty(tmp_path):
 
 _EXPECT_KINDS = {"converged", "zero_quarantines", "quarantine",
                  "fraud_proofs", "min_committed", "max_shed_frac",
-                 "exactly_once"}
+                 "exactly_once", "p99_ms", "snapshot_rejoin"}
 
 
 def test_scenario_catalog_is_wellformed():
     from fabric_tpu.workload import scenarios
     names = scenarios.list_scenarios()
-    assert len(names) >= 6
-    for required in ("geo-wan", "equivocation", "gossip-poison",
-                     "tampered-attestation", "mixed-identity",
-                     "burst-partition"):
+    assert len(names) >= 8
+    for required in ("geo-wan", "equivocation", "two-faced",
+                     "gossip-poison", "tampered-attestation",
+                     "mixed-identity", "burst-partition",
+                     "snapshot-under-adversary"):
         assert required in names
     for name in names:
         spec = scenarios.SCENARIOS[name]
