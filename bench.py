@@ -1235,11 +1235,16 @@ def main():
     except Exception as exc:
         detail["occupancy_error"] = str(exc)[:200]
 
+    # provenance stamp: {platform, device_kind, n_devices, hostname} —
+    # the ROADMAP's "cpu-virtual caveat" made machine-readable, so a
+    # BENCH json can never be mistaken for a TPU measurement
+    from fabric_tpu.ops_plane.resources import provenance
     result = {
         "metric": "ecdsa_p256_sig_verifies_per_sec",
         "value": round(rate, 1),
         "unit": "sigs/s",
         "vs_baseline": round(rate / cpu_rate_1, 2),
+        "provenance": provenance(),
         "detail": detail,
     }
     print(json.dumps(result))

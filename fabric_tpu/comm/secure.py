@@ -106,6 +106,14 @@ class SecureChannel:
         return self._recv.decrypt(nonce, ct, b"")
 
     def close(self) -> None:
+        # shutdown BEFORE close: a reader thread blocked in recv()
+        # keeps the kernel file alive through close(), so bare close()
+        # never sends FIN — the reader (and the peer's) blocks forever
+        # and the socket + thread pair leaks.  shutdown() wakes it.
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
         try:
             self._sock.close()
         except OSError:

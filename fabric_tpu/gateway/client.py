@@ -91,6 +91,16 @@ class GatewayClient:
 
     # plumbing ----------------------------------------------------------
 
+    def warm(self) -> None:
+        """Dial the connection NOW instead of on the first call — pool
+        warm-up must actually establish the socket, or "warmed" clients
+        still ramp connections (and handshake latency) into the first
+        measured requests."""
+        with self._lock:
+            if self._conn is None:
+                self._conn = connect(self.peer_addr, self.signer,
+                                     self.msps, timeout=self._timeout)
+
     def _call(self, verb: str, body: dict,
               timeout: Optional[float] = None) -> dict:
         if timeout is None:

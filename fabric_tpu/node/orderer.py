@@ -223,8 +223,31 @@ class OrdererNode:
             # profiling surface (orderer/common/server/main.go:408 slot)
             from fabric_tpu.ops_plane.profiling import register_routes
             register_routes(self.ops, enabled=bool(cfg.get("profiling")))
-            # /traces, /traces/<id> (Chrome trace JSON), /spans/stats
-            _tracing.register_routes(self.ops)
+            # /traces, /traces/<id> (Chrome trace JSON), /spans/stats;
+            # ?cluster=1 merges the trace across the `cluster_trace`
+            # sub-dict's ops endpoints — same route shape as the peer's
+            # so one client assembles from any node kind
+            ct_cfg = dict(cfg.get("cluster_trace", {}))
+            self.trace_peers = list(ct_cfg.get("peers", []))
+
+            def _cluster_trace(tid, _cfg=ct_cfg):
+                from fabric_tpu.node import tracecollect
+                # the config's peer list may include this node's own
+                # endpoint (one shared list for the whole cluster) —
+                # serve self in-process, or the same spans would count
+                # under two node identities
+                own = "%s:%d" % self.ops.addr
+                peers = [p for p in self.trace_peers if str(p) != own]
+                out = tracecollect.collect_cluster_trace(
+                    tid, peers, local_tracer=_tracing.tracer,
+                    local_name=f"orderer:{self.raft_id}",
+                    timeout_s=float(_cfg.get("timeout_s", 2.0)),
+                    max_traces=int(_cfg.get("max_traces", 16)))
+                if out is None:
+                    return 404, {"error": "unknown trace", "trace_id": tid}
+                return 200, out
+
+            _tracing.register_routes(self.ops, cluster_fn=_cluster_trace)
             # GET /faults: active fault plan ({"active": false} outside
             # chaos drills)
             from fabric_tpu.comm import faults as _faults
@@ -273,6 +296,29 @@ class OrdererNode:
             self.slo = _slo.SloEvaluator(slo_cfg)
             _slo.register_routes(self.ops, self.slo)
             self.slo.start()
+
+        # metric history + resource telemetry (same knobs as the peer:
+        # `timeseries` / `resources` sub-dicts, OFF by default so the
+        # disabled /metrics surface and runtime are byte-identical)
+        self.timeseries = None
+        ts_cfg = cfg.get("timeseries", {})
+        if self.ops is not None and ts_cfg.get("enabled", False):
+            from fabric_tpu.ops_plane import timeseries as _ts
+            self.timeseries = _ts.TimeSeriesStore(ts_cfg)
+            _ts.register_routes(self.ops, self.timeseries)
+            self.timeseries.start()
+        self.resources = None
+        res_cfg = cfg.get("resources", {})
+        if self.ops is not None and res_cfg.get("enabled", False):
+            from fabric_tpu.ops_plane import resources as _res
+            self.resources = _res.ResourceCollector(res_cfg)
+            if self.verify_cache is not None:
+                cache = self.verify_cache
+                self.resources.add_source(
+                    "verdict_cache_occupancy",
+                    lambda: cache.snapshot()["size"])
+            _res.register_routes(self.ops, self.resources)
+            self.resources.start()
 
     # -- byzantine hooks (cluster entry verifier -> containment plane) -------
 
@@ -651,6 +697,10 @@ class OrdererNode:
         self.rpc.stop()
         if getattr(self, "slo", None) is not None:
             self.slo.stop()
+        if getattr(self, "timeseries", None) is not None:
+            self.timeseries.stop()
+        if getattr(self, "resources", None) is not None:
+            self.resources.stop()
         if self.ops is not None:
             self.ops.stop()
 

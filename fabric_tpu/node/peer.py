@@ -927,8 +927,32 @@ class PeerNode:
             # peer.profile.enabled slot (internal/peer/node/start.go:813)
             from fabric_tpu.ops_plane.profiling import register_routes
             register_routes(self.ops, enabled=bool(cfg.get("profiling")))
-            # /traces, /traces/<id> (Chrome trace JSON), /spans/stats
-            _tracing.register_routes(self.ops)
+            # /traces, /traces/<id> (Chrome trace JSON), /spans/stats;
+            # ?cluster=1 assembles the trace across every ops endpoint
+            # in the `cluster_trace` sub-dict's peer list (orderers
+            # included) — one Perfetto export spanning gateway →
+            # orderer → committer
+            ct_cfg = dict(cfg.get("cluster_trace", {}))
+            self.trace_peers = list(ct_cfg.get("peers", []))
+
+            def _cluster_trace(tid, _cfg=ct_cfg):
+                from fabric_tpu.node import tracecollect
+                # the config's peer list may include this node's own
+                # endpoint (one shared list for the whole cluster) —
+                # serve self in-process, or the same spans would count
+                # under two node identities
+                own = "%s:%d" % self.ops.addr
+                peers = [p for p in self.trace_peers if str(p) != own]
+                out = tracecollect.collect_cluster_trace(
+                    tid, peers, local_tracer=_tracing.tracer,
+                    local_name=f"peer:{self.mspid}",
+                    timeout_s=float(_cfg.get("timeout_s", 2.0)),
+                    max_traces=int(_cfg.get("max_traces", 16)))
+                if out is None:
+                    return 404, {"error": "unknown trace", "trace_id": tid}
+                return 200, out
+
+            _tracing.register_routes(self.ops, cluster_fn=_cluster_trace)
             # GET /faults: the active fault plan ({"active": false} in
             # production — the plan only exists during chaos drills)
             from fabric_tpu.comm import faults as _faults
@@ -972,6 +996,32 @@ class PeerNode:
             self.slo = _slo.SloEvaluator(slo_cfg)
             _slo.register_routes(self.ops, self.slo)
             self.slo.start()
+
+        # metric history + resource telemetry: GET /metrics/history
+        # (ring store with raw→1m→10m downsampling) and a /proc-based
+        # collector feeding RSS/fd/thread/GC/arena/verdict-cache gauges
+        # into /metrics and the store.  Both OFF by default: disabled,
+        # no thread runs, no gauge registers, /metrics is unchanged.
+        # Config/env: FABRIC_TPU_PEER_TIMESERIES__ENABLED=true etc.
+        self.timeseries = None
+        ts_cfg = cfg.get("timeseries", {})
+        if self.ops is not None and ts_cfg.get("enabled", False):
+            from fabric_tpu.ops_plane import timeseries as _ts
+            self.timeseries = _ts.TimeSeriesStore(ts_cfg)
+            _ts.register_routes(self.ops, self.timeseries)
+            self.timeseries.start()
+        self.resources = None
+        res_cfg = cfg.get("resources", {})
+        if self.ops is not None and res_cfg.get("enabled", False):
+            from fabric_tpu.ops_plane import resources as _res
+            self.resources = _res.ResourceCollector(res_cfg)
+            if self.verify_cache is not None:
+                cache = self.verify_cache
+                self.resources.add_source(
+                    "verdict_cache_occupancy",
+                    lambda: cache.snapshot()["size"])
+            _res.register_routes(self.ops, self.resources)
+            self.resources.start()
 
     def _check_orderers(self):
         """healthz: at least one orderer breaker not OPEN (or no
@@ -1399,6 +1449,10 @@ class PeerNode:
             self.cc_support.stop()      # kills external chaincode processes
         if getattr(self, "slo", None) is not None:
             self.slo.stop()
+        if getattr(self, "timeseries", None) is not None:
+            self.timeseries.stop()
+        if getattr(self, "resources", None) is not None:
+            self.resources.stop()
         if self.ops is not None:
             self.ops.stop()
 

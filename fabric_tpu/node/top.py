@@ -166,6 +166,13 @@ def collect_node(addr: str, timeout: float = 2.0) -> dict:
                          if shard_series else None)
     ck = [v for _, v in metrics.get("state_checkpoint_height", ()) or ()]
     row["ckpt_height"] = max(ck) if ck else None
+    # resource telemetry (ops_plane/resources.py): present only on
+    # nodes with the `resources` sub-dict enabled; blank cell otherwise
+    rss = [v for _, v in metrics.get("process_resident_memory_bytes",
+                                     ()) or ()]
+    row["rss"] = max(rss) if rss else None
+    fds = [v for _, v in metrics.get("process_open_fds", ()) or ()]
+    row["fds"] = max(fds) if fds else None
 
     try:
         doc = _get_json(addr, "/spans/stats", timeout)
@@ -238,10 +245,10 @@ def _fmt_devices(devs) -> str:
 
 
 _COLS = ("NODE", "HT", "TX/S", "COLLECT", "DISP", "GATE", "COMMIT",
-         "OCC", "DEV", "OVLP", "VCACHE", "SPEC", "STATE", "QD", "BRKR",
-         "SHED", "FAULTS", "BYZ", "SLO", "HEALTH")
-_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 11, 4, 5, 9, 7, 10, 12,
-           8)
+         "OCC", "DEV", "OVLP", "VCACHE", "SPEC", "STATE", "RES", "QD",
+         "BRKR", "SHED", "FAULTS", "BYZ", "SLO", "HEALTH")
+_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 11, 9, 4, 5, 9, 7, 10,
+           12, 8)
 
 # gateway_admission_state gauge value -> short cell tag
 _ADM_SHORT = {0: "ok", 1: "EVAL", 2: "PROB", 3: "HARD"}
@@ -286,6 +293,46 @@ def _fmt_state(row: dict) -> str:
     ck = row.get("ckpt_height")
     return f"{n}sh/{k}" + ("" if ck is None else f"@{ck:.0f}")
 
+
+def _fmt_res(row: dict) -> str:
+    """`<RSS MB>M/<fd count>`: the resource collector's footprint cell;
+    `-` on nodes that run with `resources` disabled."""
+    rss, fds = row.get("rss"), row.get("fds")
+    if rss is None and fds is None:
+        return "-"
+    cell = "?" if rss is None else f"{rss / 1048576.0:.0f}M"
+    return cell + ("" if fds is None else f"/{fds:.0f}")
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width: int = 16) -> str:
+    """Unicode sparkline over the last `width` points, scaled to the
+    window's own min/max (shape, not absolute level)."""
+    vals = [float(v) for v in values if v is not None][-width:]
+    if not vals:
+        return "-"
+    lo, hi = min(vals), max(vals)
+    if hi - lo < 1e-12:
+        return _SPARK_BLOCKS[0] * len(vals)
+    top = len(_SPARK_BLOCKS) - 1
+    return "".join(_SPARK_BLOCKS[round((v - lo) / (hi - lo) * top)]
+                   for v in vals)
+
+
+def collect_spark(addr: str, name: str, window_s: float = 120.0,
+                  timeout: float = 2.0) -> Optional[List[float]]:
+    """One node's history points for a series (`/metrics/history`);
+    None when the node has no store or series (cell renders `-`)."""
+    try:
+        doc = _get_json(
+            addr, f"/metrics/history?name={name}&window={window_s}",
+            timeout)
+    except Exception:
+        return None
+    return [p[1] for p in doc.get("points", ())]
+
 # --sort column -> row key; None values sort last, numeric descending
 # (the interesting rows — hottest, furthest ahead, most alerting — rise)
 _SORT_KEYS = {
@@ -294,7 +341,7 @@ _SORT_KEYS = {
     "faults": "faults_fired", "slo": "slo_alerting", "height": "height",
     "rate": "rate", "occupancy": "occupancy", "dev": "devices",
     "vcache": "vcache", "spec": "spec", "shed": "shed_total",
-    "state": "state_keys", "byz": "byz_quarantines",
+    "state": "state_keys", "byz": "byz_quarantines", "res": "rss",
 }
 
 
@@ -319,9 +366,15 @@ def sort_rows(rows: List[dict], column: str) -> List[dict]:
     return sorted(rows, key=rank)
 
 
-def render(rows: List[dict]) -> str:
-    """Fixed-width table; stage cells are `p50/p99` in ms."""
-    lines = ["  ".join(c.ljust(w) for c, w in zip(_COLS, _WIDTHS))]
+def render(rows: List[dict], spark_name: Optional[str] = None) -> str:
+    """Fixed-width table; stage cells are `p50/p99` in ms.  With
+    `spark_name` an extra trailing column renders each node's history
+    sparkline for that series (rows carry it as r["spark"])."""
+    cols, widths = _COLS, _WIDTHS
+    if spark_name:
+        cols = cols + (spark_name[:18].upper(),)
+        widths = widths + (18,)
+    lines = ["  ".join(c.ljust(w) for c, w in zip(cols, widths))]
     for r in rows:
         if not r.get("up"):
             lines.append(f"{r['addr']:<21}  DOWN  {r.get('error', '')}")
@@ -345,13 +398,15 @@ def render(rows: List[dict]) -> str:
             _fmt_pct(r.get("occupancy")), _fmt_devices(r.get("devices")),
             _fmt_pct(r.get("overlap")),
             _fmt_pct(r.get("vcache")), _fmt_pct(r.get("spec")),
-            _fmt_state(r),
+            _fmt_state(r), _fmt_res(r),
             f"{r.get('queue_depth', 0):.0f}",
             f"{r.get('breakers_open', 0):.0f}",
             _fmt_shed(r),
             faults, _fmt_byz(r), slo, str(r.get("health", "?")))
+        if spark_name:
+            cells = cells + (r.get("spark") or "-",)
         lines.append("  ".join(str(c).ljust(w)
-                               for c, w in zip(cells, _WIDTHS)))
+                               for c, w in zip(cells, widths)))
     return "\n".join(lines)
 
 
@@ -415,6 +470,12 @@ def main(argv=None) -> int:
     ap.add_argument("--watch-alerts", action="store_true",
                     help="stream SLO fired/cleared transition lines "
                          "instead of the table")
+    ap.add_argument("--spark", metavar="NAME",
+                    help="extra column: unicode sparkline of this "
+                         "series from each node's /metrics/history "
+                         "(e.g. process_resident_memory_bytes)")
+    ap.add_argument("--spark-window", type=float, default=120.0,
+                    help="history window (s) behind --spark")
     ap.add_argument("--timeout", type=float, default=2.0)
     args = ap.parse_args(argv)
     targets = [t.strip() for t in args.targets.split(",") if t.strip()]
@@ -431,13 +492,17 @@ def main(argv=None) -> int:
                 row = collect_node(t, args.timeout)
                 row["_t"] = time.monotonic()
                 row["rate"] = _rate(row, prev.get(t, {}))
+                if args.spark and row.get("up"):
+                    row["spark"] = _sparkline(
+                        collect_spark(t, args.spark, args.spark_window,
+                                      args.timeout) or ())
                 prev[t] = row
                 rows.append(row)
             if args.sort:
                 rows = sort_rows(rows, args.sort)
             frame = (time.strftime("%H:%M:%S")
                      + f"  fabric-tpu top — {len(targets)} node(s)\n"
-                     + render(rows))
+                     + render(rows, spark_name=args.spark))
             if args.once:
                 print(frame)
                 return 0

@@ -18,8 +18,16 @@ from .tracing import (FlightRecorder, Span, SpanContext, Tracer, tracer,
 from .logging import jlog
 from .slo import (SloEvaluator,
                   register_routes as register_slo_routes)
+from .timeseries import (TimeSeriesStore, theil_sen, assess_leak,
+                         evaluate_leak_gate,
+                         register_routes as register_history_routes)
+from .resources import (ResourceCollector, provenance,
+                        register_routes as register_resource_routes)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
            "OperationsServer", "FlightRecorder", "Span", "SpanContext",
            "Tracer", "tracer", "configure_tracing", "register_trace_routes",
-           "jlog", "SloEvaluator", "register_slo_routes"]
+           "jlog", "SloEvaluator", "register_slo_routes",
+           "TimeSeriesStore", "theil_sen", "assess_leak",
+           "evaluate_leak_gate", "register_history_routes",
+           "ResourceCollector", "provenance", "register_resource_routes"]

@@ -239,6 +239,12 @@ class MetricsRegistry:
         with self._lock:
             return self._metrics.get(name)
 
+    def metrics(self) -> Dict[str, object]:
+        """Snapshot of the registered set, name -> metric (the
+        timeseries sampler's sweep source — read-only)."""
+        with self._lock:
+            return dict(self._metrics)
+
     def _get(self, name, factory, cls):
         with self._lock:
             m = self._metrics.get(name)

@@ -526,24 +526,32 @@ class Tracer:
         if rec is None:
             return None
         records = [rec]
+        truncated = False
         if follow_links:
             seen = {trace_id}
             frontier = [rec]
-            while frontier and len(records) < max_traces:
+            while frontier:
                 nxt = []
                 for r in frontier:
                     for span in r["spans"]:
                         for linked in span["attributes"].get("links", ()):
                             if linked in seen:
                                 continue
+                            if len(records) >= max_traces:
+                                # bounded on purpose, but never silently:
+                                # the export says so and telemetry counts
+                                truncated = True
+                                continue
                             seen.add(linked)
                             lrec = self.recorder.get(linked)
                             if lrec is not None:
                                 records.append(lrec)
                                 nxt.append(lrec)
-                            if len(records) >= max_traces:
-                                break
                 frontier = nxt
+        if truncated:
+            default_registry.counter(
+                "tracing_export_links_truncated_total",
+                "export_chrome link closures cut at max_traces").add()
         events = []
         tids: Dict[str, int] = {}
         for r in records:
@@ -568,7 +576,8 @@ class Tracer:
                 "displayTimeUnit": "ms",
                 "otherData": {"trace_id": trace_id,
                               "root": rec.get("root_name"),
-                              "n_traces_merged": len(records)}}
+                              "n_traces_merged": len(records),
+                              "truncated": truncated}}
 
     def reset(self) -> None:
         """Drop all state (tests)."""
@@ -591,16 +600,32 @@ def event(name: str, **attributes) -> None:
     tracer.event(name, **attributes)
 
 
-def register_routes(ops, t: Optional[Tracer] = None) -> None:
+def register_routes(ops, t: Optional[Tracer] = None,
+                    cluster_fn=None) -> None:
     """Mount GET /traces, /traces/<id>, /spans/stats on an
-    OperationsServer."""
+    OperationsServer.
+
+    Query params on /traces/<id>: `follow=0` exports the one trace
+    without its link closure (node/tracecollect.py follows links
+    cluster-wide itself), and `cluster=1` delegates to `cluster_fn`
+    (trace_id -> (code, payload)) — the node-wired cross-node assembly
+    — when one was registered.
+    """
+    from urllib.parse import parse_qs, urlparse
+
     t = t or tracer
 
     def _traces(path: str, body: bytes):
-        tail = path[len("/traces"):].strip("/")
+        u = urlparse(path)
+        q = parse_qs(u.query)
+        tail = u.path[len("/traces"):].strip("/")
         if not tail:
             return 200, t.recorder.list()
-        out = t.export_chrome(tail)
+        if cluster_fn is not None and \
+                (q.get("cluster") or ["0"])[0] not in ("", "0", "false"):
+            return cluster_fn(tail)
+        follow = (q.get("follow") or ["1"])[0] not in ("0", "false")
+        out = t.export_chrome(tail, follow_links=follow)
         if out is None:
             return 404, {"error": "unknown trace", "trace_id": tail}
         return 200, out

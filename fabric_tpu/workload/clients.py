@@ -104,6 +104,11 @@ class ClientPopulation:
                     continue
                 self._conns[slot] = self.factory(slot)
                 self.dials += 1
+            # actually establish the socket (a GatewayClient dials
+            # lazily on first call — "warm" must mean connected)
+            warm = getattr(self._conns[slot], "warm", None)
+            if warm is not None:
+                warm()
         return self.sockets
 
     # -- per-client bookkeeping -------------------------------------------

@@ -234,6 +234,48 @@ SCENARIOS: Dict[str, dict] = {
             {"kind": "min_committed", "value": 1},
         ],
     },
+    "soak-compressed": {
+        "description": "2-org compressed soak under steady open-loop "
+                       "load with the resource collector sampling "
+                       "RSS/fd/thread/GC into the timeseries ring; the "
+                       "leak gate runs Theil-Sen over the soak window "
+                       "and must find every gated series FLAT (slope "
+                       "CI spanning zero or immaterial growth) — the "
+                       "ROADMAP #4 leak/regression gate at smoke "
+                       "length",
+        "topology": {"n_orderers": 1, "peer_orgs": ["Org1", "Org2"],
+                     "peers_per_org": 1},
+        # observe: scenario-owned TimeSeriesStore + ResourceCollector
+        # over the process-global registry (ChaosNet nodes share the
+        # process, so one collector sees the whole cluster's resources).
+        # warmup_s must outlast link establishment: the client pool is
+        # warm-dialed up front, but gossip/state-transfer links dial
+        # lazily on their first round ~3-6 s into the load — a one-time
+        # step the gate should never even see
+        "observe": {"interval_s": 0.25, "warmup_s": 6.0},
+        "phases": [
+            {"name": "soak", "duration_s": 15.0,
+             "arrivals": {"kind": "constant", "rate": 10.0}},
+        ],
+        "expect": [
+            {"kind": "converged", "min_height": 2},
+            {"kind": "min_committed", "value": 1},
+            {"kind": "zero_quarantines"},
+            # fd/thread counts must be dead flat at steady state; RSS
+            # and allocator blocks grow legitimately with committed
+            # ledger state under a 90%-write mix, so their thresholds
+            # gate the RATE of growth, not its existence — an injected
+            # leak (a steady retain of fds/objects) still blows
+            # through, a one-time step never fires (slope CI hits 0)
+            {"kind": "leak_free", "series": {
+                "process_open_fds": {"max_growth_frac": 0.10},
+                "process_threads": {"max_growth_frac": 0.10},
+                "process_resident_memory_bytes":
+                    {"max_growth_frac": 0.30},
+                "process_allocated_blocks": {"max_growth_frac": 0.40},
+            }},
+        ],
+    },
     "burst-partition": {
         "description": "square-wave bursts while Org2's outbound links "
                        "black-hole for a mid-run window (crash-stop "
@@ -455,7 +497,7 @@ def _committed_txids(peer, channel_id: str) -> List[str]:
 
 
 def _check_expectations(spec: dict, net, report: dict,
-                        slo_eval=None) -> List[str]:
+                        slo_eval=None, ts_store=None) -> List[str]:
     """Evaluate the `expect` block; returns human-readable violations
     (empty = all SLOs held)."""
     violations: List[str] = []
@@ -564,6 +606,38 @@ def _check_expectations(spec: dict, net, report: dict,
             elif not sr.get("from_honest"):
                 violations.append(
                     f"snapshot_rejoin: honest source not used ({sr})")
+        elif kind == "leak_free":
+            # Theil-Sen slope gate over the scenario's timeseries ring
+            # (ops_plane/timeseries.py): each gated series must stay
+            # flat over the soak — slope CI spanning zero, or growth an
+            # immaterial fraction of the level.  The verdicts (slope +
+            # CI per series) land in the report either way, so an
+            # honest run documents its flatness evidence.
+            if ts_store is None:
+                violations.append(
+                    "leak_free: no timeseries store (spec needs an "
+                    "`observe` block)")
+                continue
+            from fabric_tpu.ops_plane import timeseries as _ts
+            obs = dict(spec.get("observe", {}))
+            gate = _ts.evaluate_leak_gate(
+                ts_store, dict(check.get("series", {})),
+                window_s=float(check.get("window_s", 1e9)),
+                warmup_s=float(obs.get("warmup_s", 0.0)))
+            report["leak_gate"] = gate
+            for name in gate["leaking"]:
+                v = gate["series"][name]
+                violations.append(
+                    f"leak_free[{name}]: slope "
+                    f"{v['slope_per_s']:.4g}/s (95% CI "
+                    f"[{v['ci_lo']:.4g}, {v['ci_hi']:.4g}]), "
+                    f"+{v['growth_frac']:.1%} over {v['span_s']:.1f}s "
+                    f"soak (limit {v['max_growth_frac']:.0%})")
+            missing = [n for n, v in gate["series"].items()
+                       if v.get("verdict") == "insufficient_data"]
+            if missing:
+                violations.append(
+                    f"leak_free: insufficient samples for {missing}")
         elif kind == "exactly_once":
             dup_peers = {}
             for name, node in net.nodes.items():
@@ -645,6 +719,20 @@ def run_scenario(name: str, seed: int = 7,
                                       "short_window_s": 10.0,
                                       "long_window_s": 60.0})
         slo_eval.start()
+    # scenario-owned timeseries ring + resource collector (the leak
+    # gate's evidence): ChaosNet nodes share this process, so one
+    # collector watching the process-global registry sees the whole
+    # cluster's RSS/fd/thread/GC/cache series
+    ts_store = None
+    ts_collector = None
+    if spec.get("observe") or any(c.get("kind") == "leak_free"
+                                  for c in spec.get("expect", [])):
+        from fabric_tpu.ops_plane import resources as _res
+        from fabric_tpu.ops_plane import timeseries as _tsm
+        obs = dict(spec.get("observe", {}))
+        interval = float(obs.get("interval_s", 0.25))
+        ts_store = _tsm.TimeSeriesStore({"interval_s": interval})
+        ts_collector = _res.ResourceCollector({"interval_s": interval})
     try:
         net.start()
         if plan is not None:
@@ -709,6 +797,13 @@ def run_scenario(name: str, seed: int = 7,
         runner = WorkloadRunner(clients, mix, list(spec["phases"]),
                                 signer=signers["p256"], prepare=prepare,
                                 workers=8, seed=seed)
+        if ts_store is not None:
+            # sampling starts at load start, not at provisioning: the
+            # startup ramp (node boot, client warm) is not soak
+            # evidence; the observe block's warmup_s still trims the
+            # worker spin-up at the window head
+            ts_collector.start()
+            ts_store.start()
         report.update(runner.run())
         if prep_gw is not None:
             prep_gw.close()
@@ -738,14 +833,26 @@ def run_scenario(name: str, seed: int = 7,
                     p.name if hasattr(p, "name") else "peer"] = \
                     p.slo.alerts_snapshot()
                 break
+        if ts_store is not None:
+            # one final sweep so the gate's window reaches run end
+            if ts_collector is not None:
+                ts_collector.collect()
+            ts_store.step()
+            ts_store.stop()
+            ts_collector.stop()
         violations = _check_expectations(spec, net, report,
-                                         slo_eval=slo_eval)
+                                         slo_eval=slo_eval,
+                                         ts_store=ts_store)
         report["slo"] = {"pass": not violations,
                          "checks": len(spec.get("expect", [])),
                          "violations": violations}
     finally:
         if slo_eval is not None:
             slo_eval.stop()
+        if ts_collector is not None:
+            ts_collector.stop()
+        if ts_store is not None:
+            ts_store.stop()
         if plan is not None:
             faults.uninstall()
         if clients is not None:

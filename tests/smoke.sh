@@ -31,6 +31,9 @@ fi
 echo "== live trace endpoints (/traces, /spans/stats) =="
 python tests/smoke_traces.py
 
+echo "== cluster trace assembly (3 OS processes, ?cluster=1 merge) =="
+python tests/smoke_cluster_trace.py
+
 echo "== seeded chaos probe (fault plane + convergence) =="
 python tests/smoke_chaos.py
 
@@ -63,6 +66,9 @@ python tests/smoke_scenarios.py
 
 echo "== two-faced orderer drill (fraud-proof gossip, network-wide conviction) =="
 python tests/smoke_proof_gossip.py
+
+echo "== compressed-soak leak gate (Theil-Sen over resource series, honest + injected fd leak) =="
+python tests/smoke_soak.py
 
 echo "== ASan/UBSan fuzz corpus vs the native wire parser =="
 # Build _fastparse with the sanitizers and drive the full adversarial

@@ -1,0 +1,124 @@
+"""Smoke: the compressed-soak leak gate, honest AND sabotaged.
+
+1. Honest run of the "soak-compressed" catalog scenario: a 2-org
+   cluster under steady load while the resource collector samples
+   RSS/fd/thread/GC/allocator series into the timeseries ring.  The
+   Theil–Sen leak gate must find every gated series FLAT, and the
+   report must carry slope confidence intervals as evidence.
+2. Sabotaged run: a background thread steadily retains os.pipe() fds
+   for the whole soak — a real, deterministic descriptor leak.  The
+   SAME gate must now FAIL, and the failure must name the leaking
+   series (process_open_fds) with its slope.
+
+A gate that passes honest runs but misses a genuine linear leak is
+decoration; this probe checks both directions.
+
+Run: python tests/smoke_soak.py
+"""
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+from fabric_tpu.workload import scenarios
+
+_GATED = ("process_open_fds", "process_threads",
+          "process_resident_memory_bytes", "process_allocated_blocks")
+
+
+class FdLeaker:
+    """Steadily retains pipe fds (~2 per tick) until stopped — the
+    injected-leak fixture.  Closes everything on stop()."""
+
+    def __init__(self, interval_s: float = 0.15):
+        self.interval_s = interval_s
+        self._held = []
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="fd-leaker", daemon=True)
+
+    def _loop(self):
+        while not self._stop.wait(self.interval_s):
+            try:
+                self._held.extend(os.pipe())
+            except OSError:
+                return          # fd table exhausted; leak proven anyway
+
+    def start(self):
+        self._thread.start()
+        return self
+
+    def stop(self):
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        for fd in self._held:
+            try:
+                os.close(fd)
+            except OSError:
+                pass
+        n = len(self._held)
+        self._held = []
+        return n
+
+
+def run_honest() -> None:
+    path = os.path.join(tempfile.gettempdir(),
+                        "smoke_soak_honest_report.json")
+    report = scenarios.run_scenario("soak-compressed", seed=7,
+                                    report_path=path, strict=True)
+    assert report["slo"]["pass"], report["slo"]
+    gate = report["leak_gate"]
+    assert gate["pass"] is True and gate["leaking"] == [], gate
+    for name in _GATED:
+        v = gate["series"][name]
+        assert v["verdict"] == "flat", (name, v)
+        # the evidence: slope + CI, per series, in the artifact
+        assert v["ci_lo"] <= v["slope_per_s"] <= v["ci_hi"], (name, v)
+        assert v["n_points"] >= 8, (name, v)
+    with open(path) as f:
+        disk = json.load(f)
+    assert disk["leak_gate"]["pass"] is True
+    spans = {n: round(gate["series"][n]["span_s"], 1) for n in _GATED}
+    print(f"  honest soak: leak_free holds over {spans} "
+          f"(report: {path})")
+
+
+def run_injected_leak() -> None:
+    path = os.path.join(tempfile.gettempdir(),
+                        "smoke_soak_leaky_report.json")
+    leaker = FdLeaker().start()
+    try:
+        try:
+            scenarios.run_scenario("soak-compressed", seed=7,
+                                   report_path=path, strict=True)
+        except scenarios.ScenarioFailure as exc:
+            msg = str(exc)
+        else:
+            raise AssertionError(
+                "leak gate missed an injected fd leak")
+    finally:
+        n = leaker.stop()
+    assert "leak_free[process_open_fds]" in msg, msg
+    assert "slope" in msg, msg
+    with open(path) as f:
+        disk = json.load(f)
+    v = disk["leak_gate"]["series"]["process_open_fds"]
+    assert v["leaking"] is True and v["ci_lo"] > 0.0, v
+    print(f"  injected leak ({n} fds retained): gate fired — "
+          f"{msg.split(';')[0]}")
+
+
+def main() -> int:
+    t0 = time.monotonic()
+    run_honest()
+    run_injected_leak()
+    print(f"OK: soak leak-gate smoke passed "
+          f"({time.monotonic() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

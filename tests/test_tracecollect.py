@@ -1,0 +1,134 @@
+"""Cross-node trace assembly (node/tracecollect.py) + truncation
+telemetry in export_chrome.
+
+Real OperationsServers, private Tracers, no live network beyond
+loopback: node A is the "gateway peer" served in-process, nodes B/C are
+"orderer"/"committer" behind real HTTP ops endpoints.  A transaction's
+spans are split across them — same trace id on A and B, a block trace
+only C knows reached via a link on A's root span — and the collector
+must merge all three into one Chrome export with per-node process rows.
+"""
+
+import os
+
+from fabric_tpu.node import tracecollect
+from fabric_tpu.ops_plane import tracing
+from fabric_tpu.ops_plane.metrics import MetricsRegistry
+from fabric_tpu.ops_plane.metrics import registry as global_registry
+from fabric_tpu.ops_plane.server import OperationsServer
+from fabric_tpu.ops_plane.tracing import FlightRecorder, SpanContext, Tracer
+
+_TRUNC = "tracing_export_links_truncated_total"
+
+
+def make_tracer() -> Tracer:
+    t = Tracer(FlightRecorder())
+    t.enabled = True
+    return t
+
+
+def record_fragment(t: Tracer, trace_id: str, name: str,
+                    links=()) -> None:
+    """A finished local fragment of an existing trace — the shape a
+    remote caller's traceparent produces on an orderer/committer."""
+    ctx = SpanContext(trace_id, os.urandom(8).hex(), True, remote=True)
+    with t.start_span(name, parent=ctx) as sp:
+        for linked in links:
+            sp.add_link(linked)
+
+
+def serve(t: Tracer):
+    ops = OperationsServer(metrics=MetricsRegistry())
+    tracing.register_routes(ops, t)
+    ops.start()
+    return ops, "127.0.0.1:%d" % ops.addr[1]
+
+
+def test_cluster_merge_spans_three_nodes_with_transitive_links():
+    t_gw, t_ord, t_cm = make_tracer(), make_tracer(), make_tracer()
+    block_tid = "ab" * 16
+    # gateway: the request trace, root linking the block trace
+    with t_gw.start_span("gateway.submit") as root:
+        req_tid = root.context.trace_id
+        root.add_link(block_tid)
+        with t_gw.start_span("endorse.collect"):
+            pass
+    # orderer: its own fragment of the SAME request trace
+    record_fragment(t_ord, req_tid, "orderer.deliver")
+    # committer: the block trace, which ONLY it recorded
+    record_fragment(t_cm, block_tid, "committer.commit_block")
+
+    ops_ord, ep_ord = serve(t_ord)
+    ops_cm, ep_cm = serve(t_cm)
+    try:
+        out = tracecollect.collect_cluster_trace(
+            req_tid, [ep_ord, ep_cm, "127.0.0.1:1"],   # + one dead peer
+            local_tracer=t_gw, local_name="peer:Org1")
+    finally:
+        ops_ord.stop()
+        ops_cm.stop()
+
+    assert out is not None
+    od = out["otherData"]
+    assert od["cluster"] is True and od["truncated"] is False
+    assert od["n_nodes"] == 3
+    assert set(od["nodes"]) == {"peer:Org1", ep_ord, ep_cm}
+    assert od["nodes"]["peer:Org1"] == 2          # root + child, deduped
+    assert od["n_traces_merged"] == 2             # request + linked block
+
+    spans = [e for e in out["traceEvents"] if e.get("ph") == "X"]
+    assert len(spans) == 4
+    assert len({e["pid"] for e in spans}) == 3    # one process row per node
+    for e in spans:
+        assert e["args"]["node"] in od["nodes"]
+        assert e["tid"] // tracecollect._TID_STRIDE == e["pid"]
+    names = {e["name"] for e in spans}
+    assert {"gateway.submit", "orderer.deliver",
+            "committer.commit_block"} <= names
+    procs = [e for e in out["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"]
+    assert {p["args"]["name"] for p in procs} == set(od["nodes"])
+
+
+def test_cluster_merge_unknown_trace_returns_none():
+    t = make_tracer()
+    ops, ep = serve(t)
+    try:
+        assert tracecollect.collect_cluster_trace(
+            "ff" * 16, [ep], local_tracer=make_tracer()) is None
+    finally:
+        ops.stop()
+
+
+def test_cluster_truncation_flags_and_counts():
+    t = make_tracer()
+    with t.start_span("root") as root:
+        tid = root.context.trace_id
+        root.add_link("cd" * 16)
+    record_fragment(t, "cd" * 16, "linked")
+    before = global_registry.counter(_TRUNC).total()
+    out = tracecollect.collect_cluster_trace(
+        tid, [], local_tracer=t, max_traces=1)
+    assert out["otherData"]["truncated"] is True
+    assert out["otherData"]["n_traces_merged"] == 1
+    assert global_registry.counter(_TRUNC).total() == before + 1
+
+
+def test_export_chrome_truncation_is_flagged_and_counted():
+    t = make_tracer()
+    # a chain of 20 traces, each linking the next: the closure from the
+    # head must cut at max_traces=16 — flagged in the export AND counted
+    ids = ["%032x" % i for i in range(1, 21)]
+    for i, tid in enumerate(ids):
+        nxt = [ids[i + 1]] if i + 1 < len(ids) else []
+        record_fragment(t, tid, f"stage[{i}]", links=nxt)
+    before = global_registry.counter(_TRUNC).total()
+    out = t.export_chrome(ids[0])
+    assert out["otherData"]["truncated"] is True
+    assert out["otherData"]["n_traces_merged"] == 16
+    assert global_registry.counter(_TRUNC).total() == before + 1
+    # an in-bounds closure stays clean and silent
+    out_tail = t.export_chrome(ids[-2])
+    assert out_tail["otherData"]["truncated"] is False
+    assert out_tail["otherData"]["n_traces_merged"] == 2
+    assert global_registry.counter(_TRUNC).total() == before + 1

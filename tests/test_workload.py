@@ -392,7 +392,8 @@ def test_trace_arrivals_bare_numbers_and_empty(tmp_path):
 
 _EXPECT_KINDS = {"converged", "zero_quarantines", "quarantine",
                  "fraud_proofs", "min_committed", "max_shed_frac",
-                 "exactly_once", "p99_ms", "snapshot_rejoin"}
+                 "exactly_once", "p99_ms", "snapshot_rejoin",
+                 "leak_free"}
 
 
 def test_scenario_catalog_is_wellformed():
