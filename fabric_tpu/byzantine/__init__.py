@@ -37,10 +37,14 @@ from fabric_tpu.byzantine.witness import WitnessLog
 from fabric_tpu.byzantine.monitor import (
     ByzantineMonitor,
     build_fraud_proof,
+    build_pardon,
     verify_fraud_proof,
     verify_fraud_proof_strict,
+    verify_pardon,
+    verify_pardon_strict,
 )
-from fabric_tpu.byzantine.proofgossip import MSG_FRAUD_PROOF, ProofGossip
+from fabric_tpu.byzantine.proofgossip import (MSG_FRAUD_PROOF, MSG_PARDON,
+                                              ProofGossip)
 from fabric_tpu.byzantine.ops import register_ops
 
 __all__ = [
@@ -48,9 +52,13 @@ __all__ = [
     "WitnessLog",
     "ByzantineMonitor",
     "build_fraud_proof",
+    "build_pardon",
     "verify_fraud_proof",
     "verify_fraud_proof_strict",
+    "verify_pardon",
+    "verify_pardon_strict",
     "MSG_FRAUD_PROOF",
+    "MSG_PARDON",
     "ProofGossip",
     "register_ops",
 ]

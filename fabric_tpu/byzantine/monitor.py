@@ -250,13 +250,73 @@ def verify_fraud_proof_strict(proof: dict, msps, ledger=None):
     return False, "unverifiable_single_header"
 
 
+def build_pardon(channel_id: str, pardoned: str, reason: str,
+                 clean_window_s: float, clean_since: float,
+                 signer=None) -> dict:
+    """A signed standing-restoration record, symmetric to a fraud
+    proof: WHO is pardoned, WHAT offense-based reason is being cleared,
+    and the clean-observation window the issuer attests to.  Receivers
+    re-verify the issuer's signature and that the cleared reason is not
+    a crime — a pardon can never launder an equivocation conviction."""
+    body = {
+        "v": 1, "kind": "pardon", "channel": channel_id,
+        "pardoned": pardoned, "reason": reason,
+        "clean_window_s": float(clean_window_s),
+        "clean_since": float(clean_since), "at": time.time(),
+    }
+    if signer is not None:
+        try:
+            body["issuer"] = _hex(signer.serialize())
+            canonical = json.dumps(body, sort_keys=True).encode()
+            body["signature"] = _hex(signer.sign(canonical))
+        except Exception:
+            logger.exception("pardon signing failed")
+    return body
+
+
+def verify_pardon(pardon: dict, msps) -> bool:
+    """Check the issuer's signature over the canonical pardon body."""
+    try:
+        from fabric_tpu.msp import deserialize_from_msps
+        body = {k: v for k, v in pardon.items() if k != "signature"}
+        canonical = json.dumps(body, sort_keys=True).encode()
+        ident = deserialize_from_msps(
+            msps, bytes.fromhex(pardon["issuer"]), validate=True)
+        if ident is None:
+            return False
+        return bool(ident.verify(canonical,
+                                 bytes.fromhex(pardon["signature"])))
+    except Exception:
+        return False
+
+
+def verify_pardon_strict(pardon: dict, msps):
+    """Independently re-verify a RECEIVED pardon — trust neither issuer
+    claim nor relay.  The issuer must validate against the channel MSPs
+    and have signed the canonical body (any tampering — a different
+    pardoned key, an altered reason — breaks the signature), and the
+    cleared reason must be offense-based: crime convictions are proven
+    by signed evidence and NEVER decay, so a 'pardon' naming one is
+    forged or malicious by construction.  -> (ok, why)."""
+    if pardon.get("kind") != "pardon":
+        return False, "not_a_pardon"
+    if not verify_pardon(pardon, msps):
+        return False, "bad_issuer_sig"
+    if pardon.get("reason") in CRIME_REASONS:
+        return False, "crime_never_decays"
+    if not pardon.get("pardoned"):
+        return False, "no_subject"
+    return True, "verified"
+
+
 class ByzantineMonitor:
     """One channel's detection/containment judge (thread-safe)."""
 
     def __init__(self, channel_id: str, witness: WitnessLog,
                  quarantine: QuarantineRegistry, ledger=None,
                  msps=None, signer=None, proof_dir: Optional[str] = None,
-                 confirm_quorum: int = 2):
+                 confirm_quorum: int = 2,
+                 pardon_window_s: Optional[float] = None):
         self.channel_id = channel_id
         self.witness = witness
         self.quarantine = quarantine
@@ -280,6 +340,18 @@ class ByzantineMonitor:
         # NEVER fired for remotely-received proofs (accept_remote_proof),
         # so re-broadcast loops terminate at the quarantine dedup.
         self.on_proof = None
+        # proof-backed pardon (r18): when pardon_window_s is set, an
+        # offense-quarantined identity that stays clean for the window
+        # is pardoned — a SIGNED pardon_NNNNN.json record persisted and
+        # gossiped exactly like a fraud proof, re-verified by receivers.
+        # None = disabled (quarantine stays permanent, r13 behaviour).
+        self.pardon_window_s = pardon_window_s
+        self.pardons: List[dict] = []
+        self._pardon_seq = 0
+        # on_pardon(record): fired once per NEW locally-issued pardon
+        # (never for remotely-received ones — same loop-termination
+        # discipline as on_proof).
+        self.on_pardon = None
         if proof_dir is not None:
             try:
                 os.makedirs(proof_dir, exist_ok=True)
@@ -287,7 +359,12 @@ class ByzantineMonitor:
                     if name.startswith("fraud_") and name.endswith(".json"):
                         with open(os.path.join(proof_dir, name)) as f:
                             self.proofs.append(json.load(f))
+                    elif name.startswith("pardon_") \
+                            and name.endswith(".json"):
+                        with open(os.path.join(proof_dir, name)) as f:
+                            self.pardons.append(json.load(f))
                 self._proof_seq = len(self.proofs)
+                self._pardon_seq = len(self.pardons)
             except Exception:
                 logger.exception("fraud proof dir unreadable: %s",
                                  proof_dir)
@@ -396,6 +473,89 @@ class ByzantineMonitor:
     def on_committed(self, height: int) -> None:
         self.witness.prune_below(height)
         self._retry_deferred()
+        if self.pardon_window_s is not None:
+            self.maybe_pardon()
+            self.quarantine.decay_scores(self.pardon_window_s)
+
+    # -- proof-backed pardon -------------------------------------------------
+
+    def maybe_pardon(self, now: Optional[float] = None) -> List[dict]:
+        """Issue pardons for every offense-quarantined identity whose
+        clean-observation window has elapsed.  Each pardon is a signed,
+        persisted record (pardon_NNNNN.json beside the fraud proofs) and
+        fires on_pardon for the gossip plane.  Returns the new records.
+        The registry's pardon() re-checks crime permanence, so even a
+        racing conviction cannot be laundered."""
+        if self.pardon_window_s is None:
+            return []
+        issued: List[dict] = []
+        for key in self.quarantine.pardonable_keys(self.pardon_window_s,
+                                                   now=now):
+            # snapshot the entry BEFORE pardon() resets it: the record
+            # must name the reason being cleared and the clean-since
+            # instant the issuer attests to
+            ent = self.quarantine.snapshot().get(key) or {}
+            reason = ent.get("reason") or "poison"
+            since = ent.get("last_offense_at") or ent.get("at") or 0.0
+            if not self.quarantine.pardon(key):
+                continue           # raced with a crime conviction: refused
+            record = build_pardon(self.channel_id, key, reason,
+                                  self.pardon_window_s, since,
+                                  self.signer)
+            with self._lock:
+                self.pardons.append(record)
+                self._persist_pardon(record)
+            issued.append(record)
+            logger.warning("[%s] issued pardon for %s (clean for %.1fs)",
+                           self.channel_id, key, self.pardon_window_s)
+            if self.on_pardon is not None:
+                try:
+                    self.on_pardon(record)
+                except Exception:
+                    logger.exception("pardon broadcast failed")
+        return issued
+
+    def accept_remote_pardon(self, pardon: dict,
+                             relay: Optional[str] = None) -> str:
+        """Judge a pardon received over the wire.  Restores standing
+        only when the record independently re-verifies AND our own
+        conviction for that identity is offense-based — a local CRIME
+        conviction (signed evidence we hold) is never overridden by
+        anyone's pardon.  -> 'pardoned' | 'duplicate' | 'rejected'."""
+        ok, why = verify_pardon_strict(pardon, self.msps)
+        if not ok:
+            logger.warning("[%s] remote pardon rejected (%s) relay=%s",
+                           self.channel_id, why, relay)
+            return "rejected"
+        key = pardon["pardoned"]
+        if not self.quarantine.is_quarantined(key):
+            return "duplicate"     # already restored (or never held here)
+        if not self.quarantine.pardon(key):
+            # pardon() refused: our local conviction is a crime
+            logger.warning("[%s] remote pardon for %s REFUSED: local "
+                           "crime conviction stands relay=%s",
+                           self.channel_id, key, relay)
+            return "rejected"
+        with self._lock:
+            self.pardons.append(pardon)
+            self._persist_pardon(pardon)
+        logger.warning("[%s] standing restored for %s via remote pardon "
+                       "relay=%s", self.channel_id, key, relay)
+        return "pardoned"
+
+    def _persist_pardon(self, record: dict) -> None:
+        """Caller holds the lock; same atomic discipline as proofs."""
+        if self.proof_dir is None:
+            return
+        try:
+            name = f"pardon_{self._pardon_seq:05d}.json"
+            self._pardon_seq += 1
+            tmp = os.path.join(self.proof_dir, name + ".tmp")
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(record, f, sort_keys=True)
+            os.replace(tmp, os.path.join(self.proof_dir, name))
+        except Exception:
+            logger.exception("pardon record not persisted")
 
     def convict_external(self, identity: str, reason: str,
                          evidence: Optional[dict] = None) -> None:
@@ -582,4 +742,6 @@ class ByzantineMonitor:
                 "witness": self.witness.stats(),
                 "disputed_heights": self.witness.disputed_heights(),
                 "fraud_proofs": len(self.proofs),
-                "deferred_proofs": len(self._deferred)}
+                "deferred_proofs": len(self._deferred),
+                "pardons": len(self.pardons),
+                "pardon_window_s": self.pardon_window_s}

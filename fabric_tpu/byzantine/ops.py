@@ -17,15 +17,19 @@ def byzantine_view(quarantine,
         "quarantined": quarantine.count(),
         "reasons": quarantine.reasons(),
         "identities": quarantine.snapshot(),
+        "pardons": quarantine.pardon_count(),
     }
     if monitors:
         channels = {}
         proofs = []
+        pardons = []
         for cid, mon in sorted(monitors.items()):
             channels[cid] = mon.snapshot()
             proofs.extend(mon.proofs)
+            pardons.extend(getattr(mon, "pardons", []))
         body["channels"] = channels
         body["fraud_proofs"] = proofs
+        body["pardon_records"] = pardons
     return body
 
 

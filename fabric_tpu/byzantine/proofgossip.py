@@ -36,6 +36,7 @@ from typing import Optional
 logger = logging.getLogger("fabric_tpu.byzantine")
 
 MSG_FRAUD_PROOF = "gossip.fraud_proof"
+MSG_PARDON = "gossip.pardon"
 
 
 class ProofGossip:
@@ -58,6 +59,14 @@ class ProofGossip:
         # first-conviction gate
         self._outbox = []
         self._rr = 0
+        # pardon lane (r18): standing restorations ride the same plane,
+        # symmetric counters + their own outbox so anti-entropy keeps
+        # offering BOTH record kinds
+        self.pardon_broadcasts = 0
+        self.pardon_relayed = 0
+        self.pardon_received = {"pardoned": 0, "duplicate": 0,
+                                "rejected": 0}
+        self._pardon_outbox = []
 
     # -- outbound ------------------------------------------------------------
 
@@ -77,37 +86,57 @@ class ProofGossip:
         return self.discovery.alive_ids()
 
     def _fan_out(self, proof: dict) -> None:
+        self._fan_out_record(proof, MSG_FRAUD_PROOF, "proof",
+                             self._outbox)
+
+    def _fan_out_record(self, record: dict, verb: str, field: str,
+                        outbox: list) -> None:
+        """Shared dissemination path for both record kinds: canonical
+        JSON bytes (re-encoding through the wire serde would break the
+        issuer's signature), bounded outbox, fanout to known peers."""
         try:
-            raw = json.dumps(proof, sort_keys=True).encode()
+            raw = json.dumps(record, sort_keys=True).encode()
         except Exception:
-            logger.exception("fraud proof not JSON-serializable")
+            logger.exception("%s record not JSON-serializable", field)
             return
-        if raw not in self._outbox:
-            self._outbox.append(raw)
-            del self._outbox[:-self.OUTBOX_MAX]
+        if raw not in outbox:
+            outbox.append(raw)
+            del outbox[:-self.OUTBOX_MAX]
         for to in self._targets()[:self.fanout]:
             try:
-                self.endpoint.send(to, MSG_FRAUD_PROOF, {"proof": raw})
+                self.endpoint.send(to, verb, {field: raw})
             except Exception:
-                logger.exception("fraud proof send to %s failed", to)
+                logger.exception("%s send to %s failed", field, to)
+
+    def broadcast_pardon(self, record: dict) -> None:
+        """ByzantineMonitor.on_pardon hook: fan a NEW locally-issued
+        pardon out to alive peers."""
+        self.pardon_broadcasts += 1
+        self._count("byzantine_pardons_broadcast_total",
+                    "pardon records broadcast for local restorations")
+        self._fan_out_record(record, MSG_PARDON, "pardon",
+                             self._pardon_outbox)
 
     def tick(self) -> None:
-        """Anti-entropy: re-offer every served proof to ONE known peer,
+        """Anti-entropy: re-offer every served record to ONE known peer,
         rotating through the membership — called from the gossip tick
-        cadence.  No proofs, no traffic (the crash-stop silence gate
+        cadence.  No records, no traffic (the crash-stop silence gate
         stays meaningful)."""
-        if not self._outbox:
+        if not self._outbox and not self._pardon_outbox:
             return
         targets = self._targets()
         if not targets:
             return
         to = targets[self._rr % len(targets)]
         self._rr += 1
-        for raw in list(self._outbox):
-            try:
-                self.endpoint.send(to, MSG_FRAUD_PROOF, {"proof": raw})
-            except Exception:
-                logger.exception("fraud proof re-offer to %s failed", to)
+        for verb, field, outbox in (
+                (MSG_FRAUD_PROOF, "proof", self._outbox),
+                (MSG_PARDON, "pardon", self._pardon_outbox)):
+            for raw in list(outbox):
+                try:
+                    self.endpoint.send(to, verb, {field: raw})
+                except Exception:
+                    logger.exception("%s re-offer to %s failed", field, to)
 
     # -- inbound -------------------------------------------------------------
 
@@ -133,6 +162,31 @@ class ProofGossip:
             self.relayed += 1
             self._fan_out(proof)
 
+    def handle_pardon(self, frm: str, body: dict) -> None:
+        """Judge one received pardon frame; re-broadcast only on a
+        fresh restoration (the same termination rule as proofs: a
+        duplicate or rejected pardon dies here)."""
+        try:
+            record = json.loads(bytes(body["pardon"]).decode())
+            if not isinstance(record, dict):
+                raise ValueError("pardon frame is not an object")
+        except Exception:
+            logger.warning("unparseable pardon frame from %s", frm)
+            self.pardon_received["rejected"] += 1
+            self._count("byzantine_pardons_received_total",
+                        "pardon records received via gossip",
+                        verdict="rejected")
+            return
+        verdict = self.monitor.accept_remote_pardon(record, relay=frm)
+        self.pardon_received[verdict] = \
+            self.pardon_received.get(verdict, 0) + 1
+        self._count("byzantine_pardons_received_total",
+                    "pardon records received via gossip", verdict=verdict)
+        if verdict == "pardoned":
+            self.pardon_relayed += 1
+            self._fan_out_record(record, MSG_PARDON, "pardon",
+                                 self._pardon_outbox)
+
     # -- plumbing ------------------------------------------------------------
 
     @staticmethod
@@ -145,4 +199,7 @@ class ProofGossip:
 
     def snapshot(self) -> dict:
         return {"broadcasts": self.broadcasts, "relayed": self.relayed,
-                "received": dict(self.received)}
+                "received": dict(self.received),
+                "pardon_broadcasts": self.pardon_broadcasts,
+                "pardon_relayed": self.pardon_relayed,
+                "pardon_received": dict(self.pardon_received)}

@@ -62,7 +62,8 @@ class QuarantineRegistry:
     def _entry(self, key: str) -> dict:
         return self._state.setdefault(
             key, {"quarantined": False, "reason": None, "score": 0,
-                  "offenses": {}, "at": None})
+                  "offenses": {}, "at": None, "last_offense_at": None,
+                  "pardons": 0})
 
     def is_quarantined(self, key: Optional[str]) -> bool:
         if key is None:
@@ -98,6 +99,7 @@ class QuarantineRegistry:
             ent["offenses"][reason] = ent["offenses"].get(reason, 0) \
                 + int(weight)
             ent["score"] += int(weight)
+            ent["last_offense_at"] = time.time()
             crossed = (not ent["quarantined"]
                        and ent["score"] >= self.score_threshold)
             self._save()
@@ -107,6 +109,84 @@ class QuarantineRegistry:
         if crossed:
             return self.quarantine(key, "poison")
         return False
+
+    # -- proof-backed pardon (fleet lifecycle r18) ---------------------------
+    # Offense-based quarantines ("poison": a SCORE crossed a threshold)
+    # may be pardoned after a clean-observation window — scores measure
+    # behaviour, and behaviour can improve.  Crime convictions never
+    # decay: equivocation/fork/tampered_attestation are proven by signed
+    # evidence, and a signature does not become less valid with time.
+
+    def pardonable_keys(self, clean_window_s: float,
+                        now: Optional[float] = None) -> list:
+        """Quarantined identities eligible for pardon: offense-based
+        reason AND no offense observed for `clean_window_s`."""
+        now = time.time() if now is None else now
+        out = []
+        with self._lock:
+            for key, ent in self._state.items():
+                if not ent.get("quarantined"):
+                    continue
+                if ent.get("reason") in CRIME_REASONS:
+                    continue
+                since = ent.get("last_offense_at") or ent.get("at") or now
+                if now - since >= clean_window_s:
+                    out.append(key)
+        return sorted(out)
+
+    def pardon(self, key: str) -> bool:
+        """Restore `key`'s standing (offense-based quarantines only).
+        Returns True when standing was restored, False when refused —
+        crime convictions NEVER decay, and a non-quarantined key has
+        nothing to pardon.  Live readers (standing-aware deliver, gossip
+        intake) see the restoration immediately: they consult
+        is_quarantined() per use, never a cached verdict."""
+        with self._lock:
+            ent = self._state.get(key)
+            if ent is None or not ent.get("quarantined"):
+                return False
+            if ent.get("reason") in CRIME_REASONS:
+                logger.warning("pardon REFUSED for %s: %s is a crime "
+                               "conviction", key, ent.get("reason"))
+                return False
+            ent["quarantined"] = False
+            ent["reason"] = None
+            ent["score"] = 0
+            ent["offenses"] = {}
+            ent["at"] = None
+            ent["pardons"] = int(ent.get("pardons", 0)) + 1
+            self._save()
+        logger.warning("identity %s PARDONED (standing restored)", key)
+        self._bump("byzantine_pardons_total",
+                   "offense quarantines pardoned after a clean window",
+                   "poison")
+        return True
+
+    def decay_scores(self, clean_window_s: float, amount: int = 1,
+                     now: Optional[float] = None) -> int:
+        """Sub-threshold standing decay: a NON-quarantined identity that
+        has stayed clean for a window sheds `amount` score (offense
+        tallies remain as history).  Returns how many entries decayed."""
+        now = time.time() if now is None else now
+        decayed = 0
+        with self._lock:
+            for ent in self._state.values():
+                if ent.get("quarantined") or ent.get("score", 0) <= 0:
+                    continue
+                since = max(ent.get("last_offense_at") or 0,
+                            ent.get("decayed_at") or 0)
+                if since and now - since >= clean_window_s:
+                    ent["score"] = max(0, ent["score"] - int(amount))
+                    ent["decayed_at"] = now
+                    decayed += 1
+            if decayed:
+                self._save()
+        return decayed
+
+    def pardon_count(self) -> int:
+        with self._lock:
+            return sum(int(e.get("pardons", 0))
+                       for e in self._state.values())
 
     def count(self) -> int:
         with self._lock:

@@ -22,6 +22,7 @@ import time
 from typing import Callable, List, Optional, Sequence, Tuple
 
 from fabric_tpu.comm import RpcError, connect
+from fabric_tpu.comm.rpc import RpcClosed
 from fabric_tpu.ops_plane import tracing
 from fabric_tpu.endorser.proposal import (
     ProposalResponse,
@@ -117,6 +118,18 @@ class GatewayClient:
                 self._conn = conn
         try:
             return conn.call(verb, body, timeout=timeout)
+        except RpcClosed:
+            # the peer went away (crash, drain+restart): drop the dead
+            # channel so the NEXT call redials — a client pinned to a
+            # rolling-restarted peer must recover when it returns
+            with self._lock:
+                if self._conn is conn:
+                    self._conn = None
+            try:
+                conn.close()
+            except Exception:
+                pass
+            raise
         except RpcError:
             raise
         except Exception:

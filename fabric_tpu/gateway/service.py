@@ -90,6 +90,11 @@ class GatewayService:
             "fan_call_timeout_s", max(10.0, self.fan_dial_timeout_s)))
         self._lock = threading.Lock()
         self._cv = threading.Condition(self._lock)
+        # serving -> draining -> drained: a draining gateway refuses NEW
+        # admissions (clients retry another peer) while the batcher
+        # keeps flushing what was already admitted — overload shedding
+        # is probabilistic and retryable, drain is absolute and orderly
+        self.lifecycle = "serving"
         self._queue: List[_Pending] = []
         self._inflight: Dict[str, _Pending] = {}
         # txid -> (status, info) of finished submissions (dedup window)
@@ -146,6 +151,7 @@ class GatewayService:
                 inflight = len(self._inflight)
                 recent = len(self._recent)
             return 200, {"queue_depth": depth,
+                         "lifecycle": self.lifecycle,
                          "max_queue": self.max_queue,
                          "inflight": inflight,
                          "dedup_window": recent,
@@ -164,6 +170,24 @@ class GatewayService:
         if self._thread.is_alive():
             self._thread.join(timeout=2.0)
         self.broadcaster.close()
+
+    def drain(self, timeout_s: float = 10.0) -> dict:
+        """Stop admitting new work and flush: the batcher keeps running
+        so already-admitted submissions finish against the orderer;
+        drained when queue + in-flight are both empty (a lapsed deadline
+        reports the remainder, nothing is dropped)."""
+        self.lifecycle = "draining"
+        deadline = time.monotonic() + float(timeout_s)
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._queue and not self._inflight:
+                    break
+            time.sleep(0.02)
+        with self._lock:
+            left = {"queue": len(self._queue),
+                    "inflight": len(self._inflight)}
+        self.lifecycle = "drained"
+        return left
 
     # helpers -----------------------------------------------------------
 
@@ -201,6 +225,10 @@ class GatewayService:
         nothing reaches the orderer (read path / queries)."""
         t0 = time.monotonic()
         try:
+            if self.lifecycle != "serving":
+                return {"status": 503, "message":
+                        "gateway draining: retry another peer",
+                        "payload": b""}
             # evaluates shed FIRST under overload: queries can retry on
             # any peer, and rejecting them frees endorsement simulation
             # capacity for submits that already paid for theirs
@@ -224,6 +252,10 @@ class GatewayService:
         gateway round trip (gateway/endorse.go's plan execution)."""
         t0 = time.monotonic()
         try:
+            if self.lifecycle != "serving":
+                return {"status": 503, "message":
+                        "gateway draining: retry another peer",
+                        "payload": b"", "endorsements": []}
             shed = self.admission.admit("endorse")
             if shed is not None:
                 return dict(shed.body(), status=_admission.SHED_STATUS,
@@ -300,6 +332,13 @@ class GatewayService:
                     return {"txid": txid, "status": st, "info": info,
                             "deduped": True}
                 if pending is None:
+                    # drain check AFTER the dedup window, same rationale
+                    # as shed below: a retry of an admitted txid still
+                    # attaches/replays, only NEW work is refused
+                    if self.lifecycle != "serving":
+                        return {"txid": txid, "status": 503,
+                                "info": "gateway draining: new submissions"
+                                        " refused, retry another peer"}
                     # shed check AFTER the dedup window: a retry of an
                     # already-admitted txid must attach/replay, never be
                     # shed — overload control cannot break idempotency.

@@ -26,7 +26,7 @@ from fabric_tpu.gossip.state import (
     MSG_STATE_REQ,
     MSG_STATE_RESP,
 )
-from fabric_tpu.byzantine.proofgossip import MSG_FRAUD_PROOF
+from fabric_tpu.byzantine.proofgossip import MSG_FRAUD_PROOF, MSG_PARDON
 
 _DISCOVERY_MSGS = {MSG_ALIVE, MSG_MEMBERSHIP_REQ, MSG_MEMBERSHIP_RESP}
 _STATE_MSGS = {MSG_BLOCK, MSG_STATE_REQ, MSG_STATE_RESP}
@@ -73,6 +73,8 @@ class GossipNode:
             self.cert_pull.handle(msg_type, frm, body)
         elif msg_type == MSG_FRAUD_PROOF and self.state.proofs is not None:
             self.state.proofs.handle(frm, body)
+        elif msg_type == MSG_PARDON and self.state.proofs is not None:
+            self.state.proofs.handle_pardon(frm, body)
 
     def tick(self) -> None:
         """One gossip period: heartbeat, elect, (leader) pull, anti-entropy."""

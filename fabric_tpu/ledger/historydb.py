@@ -59,6 +59,8 @@ class HistoryDB:
         self._savepoint: Optional[int] = None
         self._blocks_since_ckpt = 0
         self._ckpt_gen = 0
+        # gen -> lease expiry: see statedb.pin_generation
+        self._gen_pins: dict = {}
         self._pool: Optional[ThreadPoolExecutor] = None
         self.last_recovery = {"source": "fresh", "wal_blocks": 0,
                               "savepoint": None}
@@ -153,6 +155,18 @@ class HistoryDB:
                     return m
             return self._checkpoint_locked()
 
+    def pin_generation(self, gen: int, ttl_s: float = 60.0) -> None:
+        """Lease-pin a checkpoint generation against GC (see
+        statedb.pin_generation — same contract, history store)."""
+        with self._lock:
+            self._gen_pins[int(gen)] = time.monotonic() + float(ttl_s)
+
+    def _live_pins(self) -> set:
+        now = time.monotonic()
+        self._gen_pins = {g: t for g, t in self._gen_pins.items()
+                          if t > now}
+        return set(self._gen_pins)
+
     # shard-parallel checkpoint serialization: mirrors statedb's
     # core-count gate so single-core hosts never pay pool overhead
     _PARALLEL_CKPT_MIN = 512
@@ -196,7 +210,7 @@ class HistoryDB:
             meta={"savepoint": self._savepoint, "kind": "history"})
         with open(self._wal_path(), "wb") as f:
             f.truncate(0)
-        ckpt.gc_generations(self.root, {gen, gen - 1})
+        ckpt.gc_generations(self.root, {gen, gen - 1} | self._live_pins())
         self._ckpt_gen = gen
         self._blocks_since_ckpt = 0
         try:

@@ -16,9 +16,12 @@ MSPs):
   state.snapshot_chunk {channel, db, gen, file, offset} ->
       {data, eof, size}          (CHUNK_BYTES per call)
 
-The serving peer forces a checkpoint of both derived DBs
-(kvledger.snapshot_export) so a consistent manifest + shard-file set
-exists, then streams the exact on-disk files.  Integrity is end-to-end:
+The serving peer reuses the newest COMPLETE on-disk checkpoint pair
+when one exists (forcing one via kvledger.snapshot_export only when
+none does) and streams the exact on-disk files; served generations are
+lease-pinned against checkpoint GC so a new generation can be written
+mid-fetch without deleting the one being streamed.  Integrity is
+end-to-end:
 the manifest carries each shard file's sha256 and the installer refuses
 any assembled file whose hash mismatches — a corrupted/truncated
 transfer is re-fetched, never installed.
@@ -58,15 +61,76 @@ class SnapshotError(Exception):
 
 # -- serving side -----------------------------------------------------------
 
+def _manifest_on_disk(root: Optional[str],
+                      manifest: Optional[dict]) -> bool:
+    """True when every shard file the manifest lists is present with
+    the advertised size (content hashes are verified end-to-end by the
+    fetching client, so an existence+size probe is enough here)."""
+    if root is None or manifest is None:
+        return False
+    d = ckpt.gen_dir(root, int(manifest["gen"]))
+    for ent in manifest["shards"]:
+        try:
+            if os.path.getsize(
+                    os.path.join(d, os.path.basename(str(ent["file"])))) \
+                    != int(ent["bytes"]):
+                return False
+        except (OSError, KeyError, TypeError, ValueError):
+            return False
+    return True
+
+
+def _reusable_manifests(ledger) -> Tuple[Optional[dict], Optional[dict]]:
+    """The newest COMPLETE on-disk checkpoint pair, or (None, None).
+
+    Serving an existing generation instead of force-checkpointing per
+    meta request is what lets N peers bootstrap concurrently under
+    load: each forced checkpoint mints a new generation and GC keeps
+    only {gen, gen-1}, so concurrent exports used to delete the very
+    files another bootstrapper was mid-fetch — a refetch livelock.  A
+    STALE savepoint is harmless: the joiner simply joins at the
+    manifest's height and tail-replays more blocks to tip."""
+    sm = ckpt.read_manifest(ledger.statedb.root) \
+        if ledger.statedb.root is not None else None
+    if not _manifest_on_disk(ledger.statedb.root, sm):
+        return None, None
+    if ledger.historydb is None or ledger.historydb.root is None:
+        return sm, None
+    hm = ckpt.read_manifest(ledger.historydb.root)
+    # both DBs must describe the SAME savepoint for a coherent install
+    if (not _manifest_on_disk(ledger.historydb.root, hm)
+            or hm.get("savepoint") != sm.get("savepoint")):
+        return None, None
+    return sm, hm
+
+
 def export_meta(ledger) -> dict:
-    """Force-checkpoint the ledger's derived DBs and describe the
-    resulting snapshot (the state.snapshot_meta handler)."""
+    """Describe a servable snapshot (the state.snapshot_meta handler):
+    reuse the newest complete on-disk checkpoint generation when one
+    exists, force-checkpoint both derived DBs only when none does."""
     t0 = time.monotonic()
-    state_manifest, history_manifest = ledger.snapshot_export()
+    state_manifest, history_manifest = _reusable_manifests(ledger)
+    if state_manifest is not None:
+        try:
+            blk = ledger.blockstore.get_by_number(
+                int(state_manifest["savepoint"]))
+        except Exception:
+            # savepoint block pruned/unavailable (e.g. this peer itself
+            # snapshot-bootstrapped above it) — fall back to forcing
+            state_manifest = history_manifest = None
     if state_manifest is None:
-        raise SnapshotError("nothing to snapshot (empty or in-memory ledger)")
+        state_manifest, history_manifest = ledger.snapshot_export()
+        if state_manifest is None:
+            raise SnapshotError(
+                "nothing to snapshot (empty or in-memory ledger)")
+        blk = ledger.blockstore.get_by_number(
+            int(state_manifest["savepoint"]))
     savepoint = int(state_manifest["savepoint"])
-    blk = ledger.blockstore.get_by_number(savepoint)
+    # lease the served generations against concurrent checkpoint GC;
+    # serve_chunk refreshes the lease per chunk for the fetch duration
+    ledger.statedb.pin_generation(int(state_manifest["gen"]))
+    if history_manifest is not None:
+        ledger.historydb.pin_generation(int(history_manifest["gen"]))
     files = [{"db": "state", "gen": state_manifest["gen"],
               "file": ent["file"], "sha256": ent["sha256"],
               "bytes": ent["bytes"]}
@@ -102,12 +166,17 @@ def serve_chunk(ledger, db: str, gen: int, file: str, offset: int) -> dict:
     state.snapshot_chunk handler)."""
     if db == "state":
         droot = ledger.statedb.root
+        store = ledger.statedb
     elif db == "history":
         droot = None if ledger.historydb is None else ledger.historydb.root
+        store = ledger.historydb
     else:
         raise SnapshotError(f"unknown snapshot db {db!r}")
     if droot is None:
         raise SnapshotError(f"{db} store is not durable on this peer")
+    # refresh the GC lease while the fetch is in flight (export_meta
+    # took the initial lease; a slow bootstrap keeps renewing it)
+    store.pin_generation(int(gen))
     # only shard payload files live in a generation dir; reject anything
     # that could traverse out of it
     if (os.path.basename(file) != file or not file.startswith("shard_")

@@ -264,6 +264,10 @@ class StateDB:
         self._savepoint: Optional[int] = None
         self._batches_since_ckpt = 0
         self._ckpt_gen = 0
+        # gen -> lease-expiry monotonic time: generations a snapshot
+        # fetch is streaming from; checkpoint GC keeps them alive until
+        # the lease lapses (ledger/snapshot.py refreshes per chunk)
+        self._gen_pins: dict = {}
         # registered (ns, field) pairs; each shard holds its own
         # _FieldIndex slice (the statecouchdb index slot — reference
         # indexes ship in chaincode META-INF/statedb/couchdb/indexes and
@@ -689,6 +693,23 @@ class StateDB:
                     return m
             return self._checkpoint_locked()
 
+    def pin_generation(self, gen: int, ttl_s: float = 60.0) -> None:
+        """Lease-pin a checkpoint generation against GC: while the lease
+        is live, later checkpoints keep the generation's directory on
+        disk.  The snapshot chunk server refreshes the lease on every
+        chunk it serves, so an in-flight bootstrap fetch survives any
+        number of concurrent checkpoints; an abandoned fetch merely
+        delays GC by the TTL."""
+        with self._lock:
+            self._gen_pins[int(gen)] = time.monotonic() + float(ttl_s)
+
+    def _live_pins(self) -> set:
+        """Drop lapsed leases, return pinned gens (caller holds _lock)."""
+        now = time.monotonic()
+        self._gen_pins = {g: t for g, t in self._gen_pins.items()
+                          if t > now}
+        return set(self._gen_pins)
+
     def _checkpoint_locked(self) -> dict:
         t0 = time.monotonic()
         gen = self._ckpt_gen + 1
@@ -727,7 +748,7 @@ class StateDB:
             os.remove(self._snap_path())   # retire any legacy snapshot
         except OSError:
             pass
-        ckpt.gc_generations(self.root, {gen, gen - 1})
+        ckpt.gc_generations(self.root, {gen, gen - 1} | self._live_pins())
         self._ckpt_gen = gen
         self._batches_since_ckpt = 0
         self._observe_checkpoint(time.monotonic() - t0, gen)

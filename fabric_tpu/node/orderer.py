@@ -127,6 +127,19 @@ class OrdererNode:
         self.registrar = Registrar()
         self.raft_id = int(cfg["raft_id"])
         self.peer_ids = [int(p["raft_id"]) for p in cfg["cluster"]]
+        self.channel_id = channel_cfg.channel_id
+        # fleet lifecycle: serving -> draining -> drained.  A draining
+        # orderer refuses new broadcasts (clients fail over), hands off
+        # raft leadership, and fsyncs its WALs so the following stop()
+        # is a clean point-in-time exit rather than a crash.
+        self.lifecycle = "serving"
+        # per-channel raft membership: raft_id -> rich consenter entry
+        # ({raft_id, host, port, mspid, cert_fp}).  Seeded from the
+        # channel config (or the bootstrap cluster list) and THEREAFTER
+        # owned by committed membership config entries — persisted to
+        # <channel>/membership.json so a restart mid-churn reloads the
+        # post-reconfig set, not the genesis one.
+        self._membership: Dict[str, Dict[int, dict]] = {}
 
         self.rpc = RpcServer(cfg.get("host", "127.0.0.1"), int(cfg["port"]),
                              self.signer, msps)
@@ -158,6 +171,11 @@ class OrdererNode:
         byz_cfg = dict(cfg.get("byzantine", {}))
         self.byzantine = None
         self.byz_monitors: Dict[str, object] = {}
+        # clean-observation window before offense-based quarantines are
+        # pardoned; None = permanent (the r13 behaviour)
+        self.byz_pardon_window = (
+            float(byz_cfg["pardon_window_s"])
+            if byz_cfg.get("pardon_window_s") is not None else None)
         if byz_cfg.get("enabled", True):
             from fabric_tpu.byzantine import QuarantineRegistry
             self.byzantine = QuarantineRegistry(
@@ -205,6 +223,12 @@ class OrdererNode:
         self.rpc.serve("participation.join", self._rpc_join)
         self.rpc.serve("participation.list", self._rpc_list)
         self.rpc.serve("participation.remove", self._rpc_remove)
+        # fleet lifecycle + dynamic membership (admin-gated)
+        self.rpc.serve("admin.add_consenter", self._rpc_add_consenter)
+        self.rpc.serve("admin.remove_consenter", self._rpc_remove_consenter)
+        self.rpc.serve("admin.transfer_leadership",
+                       self._rpc_transfer_leadership)
+        self.rpc.serve("admin.drain", self._rpc_drain)
 
         # ops plane: /metrics, /healthz (system.go:75-267 parity) + the
         # channelparticipation REST API (channelparticipation/restapi.go)
@@ -220,6 +244,13 @@ class OrdererNode:
                                         int(cfg["ops_port"]))
             self.ops.register_checker(
                 "raft", lambda: self.support.chain.node.leader_id is not None)
+            self.ops.lifecycle_fn = lambda: self.lifecycle
+            # POST /drain: plain-HTTP ops convenience (same trust
+            # boundary caveat as the participation REST writes); the
+            # authenticated admin.drain RPC is the production surface
+            self.ops.register_route(
+                "POST", "/drain",
+                lambda path, body: (200, self.drain()))
             # profiling surface (orderer/common/server/main.go:408 slot)
             from fabric_tpu.ops_plane.profiling import register_routes
             register_routes(self.ops, enabled=bool(cfg.get("profiling")))
@@ -384,24 +415,50 @@ class OrdererNode:
 
     # -- channel lifecycle ---------------------------------------------------
 
-    def _channel_cluster_maps(self, channel_cfg: ChannelConfig):
-        """Derive THIS channel's raft membership from its config.
-
-        Rich consenter entries ({raft_id, host, port, mspid, cert_fp})
-        yield per-channel peer ids, addresses, and consenter identity
-        bindings — the reference authenticates cluster traffic against
-        per-channel consenter sets (orderer/common/cluster/comm.go).
-        Legacy int-only entries (or none) fall back to the bootstrap
-        cluster maps."""
+    def _load_membership(self, ch_dir: str,
+                         channel_cfg: ChannelConfig) -> Dict[int, dict]:
+        """THIS channel's raft membership, newest source first: the
+        persisted post-reconfig set (membership.json, written every time
+        a membership config entry commits), else the channel config's
+        rich consenter entries ({raft_id, host, port, mspid, cert_fp} —
+        the reference authenticates cluster traffic against per-channel
+        consenter sets, orderer/common/cluster/comm.go), else the
+        bootstrap cluster list.  A node restarting mid-churn therefore
+        comes back with the set as of its last committed conf entry —
+        NOT the genesis set — and the raft WAL replay re-fires the same
+        conf entries idempotently on top."""
+        import os
+        path = os.path.join(ch_dir, "membership.json")
+        if os.path.exists(path):
+            with open(path, "r", encoding="utf-8") as f:
+                return {int(e["raft_id"]): dict(e) for e in json.load(f)}
         rich = [c for c in channel_cfg.consenters if isinstance(c, dict)]
         if not rich:
-            return self.peer_ids, None, None
-        ids = sorted(int(c["raft_id"]) for c in rich)
-        consenters = {int(c["raft_id"]): (c["mspid"], c["cert_fp"])
-                      for c in rich}
-        peers = {int(c["raft_id"]): (c.get("host", "127.0.0.1"),
-                                     int(c["port"]))
-                 for c in rich if int(c["raft_id"]) != self.raft_id}
+            rich = list(self.cfg["cluster"])
+        return {int(c["raft_id"]): dict(c) for c in rich}
+
+    def _persist_membership(self, channel_id: str) -> None:
+        import os
+        members = self._membership.get(channel_id, {})
+        ch_dir = os.path.join(self.data_dir, channel_id)
+        path = os.path.join(ch_dir, "membership.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump([members[nid] for nid in sorted(members)], f,
+                      sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def _membership_maps(self, members: Dict[int, dict]):
+        """(raft ids, consenter identity map, peer address map) from a
+        membership set — the three views the raft node, the entry
+        verifier, and the transport each need."""
+        ids = sorted(members)
+        consenters = {nid: (m["mspid"], m["cert_fp"])
+                      for nid, m in members.items()}
+        peers = {nid: (m.get("host", "127.0.0.1"), int(m["port"]))
+                 for nid, m in members.items() if nid != self.raft_id}
         return ids, consenters, peers
 
     def _create_channel(self, channel_cfg: ChannelConfig, bundle_source):
@@ -419,8 +476,9 @@ class OrdererNode:
             with open(tmp, "wb") as f:
                 f.write(channel_cfg.serialize())
             os.replace(tmp, cfg_path)
-        peer_ids, ch_consenters, ch_peers = self._channel_cluster_maps(
-            channel_cfg)
+        members = self._load_membership(ch_dir, channel_cfg)
+        self._membership[cid] = members
+        peer_ids, ch_consenters, ch_peers = self._membership_maps(members)
         # every proposed entry is signed with this consenter's identity;
         # followers verify the chain before applying (cluster.py
         # EntryVerifier) — enforcement keys on entry_signer being set
@@ -441,7 +499,9 @@ class OrdererNode:
                 batch_timeout_s=batch.timeout_s),
             ledger=BlockStore(os.path.join(ch_dir, "ledger")),
             chain_factory=lambda cutter, writer, on_block: RaftChain(
-                node, cutter, writer, on_block=on_block),
+                node, cutter, writer, on_block=on_block,
+                on_conf=lambda conf, _cid=cid: self._on_membership(
+                    _cid, conf)),
             bundle_source=bundle_source)
         if self.verify_cache is not None:
             support.processor.verify_cache = self.verify_cache
@@ -459,7 +519,8 @@ class OrdererNode:
                 self.byzantine,
                 ledger=_BlockStoreLedger(support.ledger),
                 msps=bundle_source.current().msps, signer=self.signer,
-                proof_dir=os.path.join(ch_dir, "fraud_proofs"))
+                proof_dir=os.path.join(ch_dir, "fraud_proofs"),
+                pardon_window_s=self.byz_pardon_window)
         return support
 
     def join_channel(self, channel_cfg: ChannelConfig):
@@ -467,6 +528,142 @@ class OrdererNode:
         instance + ledger under this process's registrar."""
         src = BundleSource(Bundle(channel_cfg))
         return self._create_channel(channel_cfg, src)
+
+    # -- dynamic raft membership (committed through the log itself) ----------
+
+    def _on_membership(self, channel_id: str, conf: dict) -> None:
+        """A membership config entry COMMITTED on this channel.  Runs on
+        every replica (and re-runs on restart replay — conf entries do
+        not advance the chain's applied index — so it must be
+        idempotent): update the persisted membership set, then swap the
+        transport's consenter identity + address maps and rebind the
+        EntryVerifier in one atomic step.  From this instant a removed
+        consenter's raft traffic and signed entries are rejected."""
+        op = conf.get("op")
+        nid = int(conf.get("node", 0))
+        members = self._membership.setdefault(channel_id, {})
+        if op == "add":
+            entry = {"raft_id": nid,
+                     "host": conf.get("host", "127.0.0.1"),
+                     "port": int(conf.get("port", 0)),
+                     "mspid": conf.get("mspid", ""),
+                     "cert_fp": conf.get("cert_fp", "")}
+            if members.get(nid) == entry:
+                return                      # restart replay: already applied
+            members[nid] = entry
+        elif op == "remove":
+            if nid not in members:
+                return                      # restart replay: already applied
+            members.pop(nid)
+        else:
+            logger.warning("[%s] unknown membership op %r ignored",
+                           channel_id, op)
+            return
+        self._persist_membership(channel_id)
+        _ids, consenters, peers = self._membership_maps(members)
+        self.cluster.update_membership(channel_id, consenters, peers)
+        logger.info("[%s] membership %s node %d -> consenters %s",
+                    channel_id, op, nid, sorted(members))
+
+    def _rpc_add_consenter(self, body: dict, peer_identity) -> dict:
+        """Admin: propose an add-consenter config entry (leader only —
+        callers retry against the leader hint on not_leader)."""
+        self._require_admin(peer_identity)
+        cid = body.get("channel", self.channel_id)
+        support = self.registrar.get(cid)
+        if support is None:
+            raise ValueError(f"no such channel {cid!r}")
+        for fld in ("raft_id", "port", "mspid", "cert_fp"):
+            if not body.get(fld):
+                raise ValueError(f"add_consenter requires {fld!r} — an "
+                                 "unbound consenter could not be "
+                                 "authenticated on the cluster plane")
+        from fabric_tpu.orderer import raft as raftmod
+        try:
+            index = support.chain.propose_membership(
+                "add", int(body["raft_id"]),
+                host=body.get("host", "127.0.0.1"), port=int(body["port"]),
+                mspid=body["mspid"], cert_fp=body["cert_fp"])
+        except raftmod.NotLeaderError as exc:
+            return {"status": "not_leader", "leader": exc.leader_id or 0}
+        return {"status": "proposed", "channel": cid, "index": index}
+
+    def _rpc_remove_consenter(self, body: dict, peer_identity) -> dict:
+        """Admin: propose a remove-consenter config entry.  Removing the
+        leader itself is legal — it self-evicts at commit and the rest
+        of the cluster elects (callers wanting a gap-free handover
+        transfer leadership first, as the drain path does)."""
+        self._require_admin(peer_identity)
+        cid = body.get("channel", self.channel_id)
+        support = self.registrar.get(cid)
+        if support is None:
+            raise ValueError(f"no such channel {cid!r}")
+        from fabric_tpu.orderer import raft as raftmod
+        try:
+            index = support.chain.propose_membership(
+                "remove", int(body["raft_id"]))
+        except raftmod.NotLeaderError as exc:
+            return {"status": "not_leader", "leader": exc.leader_id or 0}
+        return {"status": "proposed", "channel": cid, "index": index}
+
+    def _rpc_transfer_leadership(self, body: dict, peer_identity) -> dict:
+        self._require_admin(peer_identity)
+        cid = body.get("channel", self.channel_id)
+        support = self.registrar.get(cid)
+        if support is None:
+            raise ValueError(f"no such channel {cid!r}")
+        sent = support.chain.transfer_leadership(int(body["to"]))
+        return {"status": "sent" if sent else "refused",
+                "leader": support.chain.node.leader_id or 0}
+
+    def _rpc_drain(self, body: dict, peer_identity) -> dict:
+        self._require_admin(peer_identity)
+        return self.drain(timeout_s=float(body.get("timeout_s", 10.0)))
+
+    # -- graceful drain ------------------------------------------------------
+
+    def drain(self, timeout_s: float = 10.0) -> dict:
+        """Orderly exit ramp: stop admitting broadcasts, hand raft
+        leadership to the most caught-up follower, let every committed
+        entry apply, then fsync the WALs.  After this returns the
+        process can be stopped with nothing in flight — a rolling
+        upgrade is drain -> stop -> restart -> rejoin-at-height instead
+        of a crash-stop."""
+        import time as _time
+        from fabric_tpu.orderer import raft as raftmod
+        self.lifecycle = "draining"
+        deadline = _time.monotonic() + timeout_s
+        leaders = {}
+        for cid, support in self.registrar.channels().items():
+            chain = support.chain
+            node = chain.node
+            # release leadership via explicit transfer: pick the most
+            # caught-up follower; retry until deposed or out of time
+            # (transfer_leadership nudges a lagging target's replication)
+            while node.role == raftmod.LEADER \
+                    and _time.monotonic() < deadline:
+                with chain._lock:
+                    targets = sorted(
+                        (n for n in node.nodes if n != node.id),
+                        key=lambda n: -node.match_index.get(n, 0))
+                if not targets:
+                    break               # single-node channel: nothing to do
+                for to in targets:
+                    if chain.transfer_leadership(to):
+                        break
+                _time.sleep(0.05)
+            # finish in-flight blocks: everything raft committed must be
+            # applied to the ledger before we call the WAL final
+            while _time.monotonic() < deadline:
+                with chain._lock:
+                    if node.applied_index >= node.commit_index:
+                        break
+                _time.sleep(0.02)
+            with chain._lock:
+                node._wal.sync()
+            leaders[cid] = node.leader_id or 0
+        self.lifecycle = "drained"
+        return {"lifecycle": self.lifecycle, "leaders": leaders}
 
     # -- rpc handlers --------------------------------------------------------
 
@@ -515,6 +712,12 @@ class OrdererNode:
         return {"channel": cid, "status": "removed"}
 
     def _rpc_broadcast(self, body: dict, peer_identity) -> dict:
+        if self.lifecycle != "serving":
+            # draining: refuse new work so clients fail over NOW; the
+            # leader hint points them at whoever holds (or will hold)
+            # leadership after our transfer
+            return {"status": 503, "info": "draining",
+                    "leader": self.support.chain.node.leader_id or 0}
         env = Envelope.deserialize(body["envelope"])
         resp = self.broadcast.handle(env)
         return {"status": resp.status, "info": resp.info or "",
@@ -523,6 +726,10 @@ class OrdererNode:
     def _rpc_broadcast_batch(self, body: dict, peer_identity) -> dict:
         """Gateway fan-in: many envelopes per RPC round trip.  Each is
         admitted independently; statuses/infos line up by index."""
+        if self.lifecycle != "serving":
+            n = len(body.get("envelopes", []))
+            return {"statuses": [503] * n, "infos": ["draining"] * n,
+                    "leader": self.support.chain.node.leader_id or 0}
         envs = [Envelope.deserialize(e) for e in body["envelopes"]]
         # verdict attestations carry no authority of their own: the
         # msgprocessor only honours them when the frame's handshake-

@@ -474,7 +474,8 @@ class PeerChannel:
                 WitnessLog(f"{ch_dir}/witness_log.json"),
                 node.byzantine, ledger=self.ledger, msps=self.msps,
                 signer=node.signer,
-                proof_dir=f"{ch_dir}/fraud_proofs")
+                proof_dir=f"{ch_dir}/fraud_proofs",
+                pardon_window_s=node.byz_pardon_window)
             self.gossip.state.monitor = self.byz_monitor
             self.deliver_client.blocked = (
                 lambda s: self.byz_monitor.blocked_source(
@@ -487,6 +488,10 @@ class PeerChannel:
                 self.byz_monitor)
             self.gossip.state.proofs = self.proof_gossip
             self.byz_monitor.on_proof = self.proof_gossip.broadcast
+            # proof-backed pardons ride the same plane: a NEW local
+            # restoration gossips its signed record, receivers
+            # re-verify independently (monitor.accept_remote_pardon)
+            self.byz_monitor.on_pardon = self.proof_gossip.broadcast_pardon
 
         self.deliver_healthy = True
         self._thread = threading.Thread(target=self._deliver_loop,
@@ -774,6 +779,12 @@ class PeerNode:
         # default; `byzantine: {"enabled": false}` restores blind trust.
         byz_cfg = dict(cfg.get("byzantine", {}))
         self.byzantine = None
+        # pardon window (seconds of clean observation before an
+        # offense-based quarantine is restored); None keeps the r13
+        # permanent-quarantine behaviour
+        self.byz_pardon_window = (
+            float(byz_cfg["pardon_window_s"])
+            if byz_cfg.get("pardon_window_s") is not None else None)
         if byz_cfg.get("enabled", True):
             from fabric_tpu.byzantine import QuarantineRegistry
             self.byzantine = QuarantineRegistry(
@@ -830,6 +841,9 @@ class PeerNode:
         self.gossip_mux = ChannelMux(transport, channel_cfg.channel_id)
 
         self._stop = threading.Event()
+        # serving -> draining -> drained (fleet lifecycle: rolling
+        # restarts drain a peer before killing it)
+        self.lifecycle = "serving"
         self.channels: Dict[str, PeerChannel] = {}
         self.cscc = Cscc(create_channel=self._cscc_create)
 
@@ -923,6 +937,12 @@ class PeerNode:
             self.ops.register_checker("orderer_reachable",
                                       self._check_orderers)
             self.ops.register_checker("bccsp", self._check_bccsp)
+            # lifecycle on /healthz (serving/draining/drained — an
+            # ORDERLY state, not a failure) + POST /drain to enter it
+            self.ops.lifecycle_fn = lambda: self.lifecycle
+            self.ops.register_route(
+                "POST", "/drain",
+                lambda path, body: (200, self.drain()))
             # /debug/profile (jax.profiler) + /debug/pprof (host), the
             # peer.profile.enabled slot (internal/peer/node/start.go:813)
             from fabric_tpu.ops_plane.profiling import register_routes
@@ -1422,6 +1442,39 @@ class PeerNode:
             ch.transient.persist(body["txid"], int(body["height"]), sets)
 
     # -- lifecycle -----------------------------------------------------------
+
+    def drain(self, timeout_s: float = 10.0) -> dict:
+        """Graceful drain for rolling restarts: refuse NEW client work
+        at the gateway while the batcher flushes everything already
+        admitted, wait for in-flight block commits to go quiet, then
+        force a checkpoint of every channel ledger (WAL truncated, the
+        next recovery opens from the checkpoint instead of replaying).
+        Idempotent; deliver/gossip reads keep serving throughout."""
+        deadline = time.monotonic() + float(timeout_s)
+        self.lifecycle = "draining"
+        flushed = {}
+        if self.gateway is not None:
+            flushed = self.gateway.drain(
+                max(0.0, deadline - time.monotonic()))
+        heights = {}
+        for cid, ch in list(self.channels.items()):
+            # in-flight blocks: wait for the commit height to go quiet
+            # (the deliver loop applies what it already pulled)
+            last = ch.ledger.height
+            quiet_at = time.monotonic() + 0.3
+            while time.monotonic() < min(deadline, quiet_at):
+                time.sleep(0.05)
+                h = ch.ledger.height
+                if h != last:
+                    last, quiet_at = h, time.monotonic() + 0.3
+            try:
+                ch.ledger.snapshot_export()  # checkpoint + WAL truncate
+            except Exception:
+                logger.exception("[%s] drain checkpoint failed", cid)
+            heights[cid] = ch.ledger.height
+        self.lifecycle = "drained"
+        return {"lifecycle": self.lifecycle, "gateway": flushed,
+                "heights": heights}
 
     def start(self) -> "PeerNode":
         self.rpc.start()

@@ -139,13 +139,22 @@ def provision_network(base_dir: str, n_orderers: int = 3,
                       channel_id: str = "ch",
                       chaincodes: List[dict] = None,
                       collections: List[dict] = None,
-                      batch: BatchConfig = None) -> dict:
+                      batch: BatchConfig = None,
+                      spare_orderers: int = 0) -> dict:
     """Full dev network: orderer cluster + peer-org peers on one channel.
 
     The nwo-style harness (reference: integration/nwo/network.go:173) —
     generates all crypto material and one JSON config per process.
     Returns {"orderers": [cfg paths], "peers": [cfg paths],
              "clients": {org: client cfg path}}.
+
+    `spare_orderers`: additionally issues N orderer identities + node
+    configs that are NOT in the genesis consenter set — provisioned but
+    unjoined, the raw material for dynamic-membership drills (an
+    add-consenter config entry carries the spare's binding to everyone).
+    Their cfg paths land under "spare_orderers"; each cfg carries its
+    own "cert_fp" so a drill can build the add_consenter request
+    without re-deriving it.
     """
     from fabric_tpu.orderer.cluster import cert_fingerprint
 
@@ -154,8 +163,10 @@ def provision_network(base_dir: str, n_orderers: int = 3,
     all_orgs = {"OrdererOrg": ord_org, **p_orgs}
 
     n_peers = len(p_orgs) * peers_per_org
-    ports = _free_ports(n_orderers + n_peers)
-    ord_ports, peer_ports = ports[:n_orderers], ports[n_orderers:]
+    ports = _free_ports(n_orderers + n_peers + spare_orderers)
+    ord_ports = ports[:n_orderers]
+    peer_ports = ports[n_orderers:n_orderers + n_peers]
+    spare_ports = ports[n_orderers + n_peers:]
 
     org_cfgs = []
     for name, org in all_orgs.items():
@@ -225,12 +236,45 @@ def provision_network(base_dir: str, n_orderers: int = 3,
             }, f)
         orderer_paths.append(path)
 
+    # spare orderers: identity + config on disk, EXCLUDED from the
+    # genesis consenter tuple and every bootstrap cluster list.  A
+    # spare that starts up is a silent learner (its raft node refuses
+    # to campaign while outside the consenter set) until a committed
+    # add-consenter config entry teaches the whole channel its binding.
+    spare_paths = []
+    spare_creds = [ord_org.issuer.issue(
+        f"orderer{n_orderers + s + 1}@OrdererOrg")
+        for s in range(spare_orderers)]
+    for s in range(spare_orderers):
+        rid = n_orderers + s + 1
+        node_dir = os.path.join(base_dir, f"orderer{rid}")
+        os.makedirs(node_dir, exist_ok=True)
+        cert, key = spare_creds[s]
+        path = os.path.join(base_dir, f"orderer{rid}.json")
+        with open(path, "w") as f:
+            json.dump({
+                "mspid": "OrdererOrg", "raft_id": rid,
+                "host": "127.0.0.1", "port": spare_ports[s],
+                "cert_pem": _cert_pem(cert).decode(),
+                "key_pem": _key_pem(key).decode(),
+                "cert_fp": cert_fingerprint(cert),
+                "channel_config_hex": cfg_hex,
+                "cluster": cluster, "data_dir": node_dir,
+                "verify_once": {"trust_attestations": True,
+                                "attestors": attestors,
+                                "attest_deliver": True},
+            }, f)
+        spare_paths.append(path)
+
     # the reverse direction: peers pin the orderer identities so the
     # admission-verdict digests riding deliver frames are honoured —
-    # again an explicit dev-provisioner opt-in, off by node default
+    # again an explicit dev-provisioner opt-in, off by node default.
+    # Spares are pinned too: attestor trust is an identity allowlist,
+    # not a membership statement, and a joined spare attests like any
+    # other consenter.
     orderer_attestors = [{"mspid": "OrdererOrg",
                           "cert_fp": cert_fingerprint(c)}
-                         for c, _k in creds]
+                         for c, _k in creds + spare_creds]
 
     # peers: each knows every OTHER peer's endpoint + org (privdata push,
     # discovery membership)
@@ -250,7 +294,13 @@ def provision_network(base_dir: str, n_orderers: int = 3,
                 "cert_pem": _cert_pem(cert).decode(),
                 "key_pem": _key_pem(key).decode(),
                 "channel_config_hex": cfg_hex,
-                "orderers": [["127.0.0.1", p] for p in ord_ports],
+                # the full ordering-service roster INCLUDING spares:
+                # endpoint knowledge is fleet provisioning, not
+                # membership — a spare that later joins (and may even
+                # lead) must be dialable, an unstarted one just fails
+                # dial and the broadcast/deliver failover walks on
+                "orderers": [["127.0.0.1", p]
+                             for p in ord_ports + spare_ports],
                 "peers": others,
                 "chaincodes": chaincodes,
                 "collections": collections,
@@ -281,7 +331,8 @@ def provision_network(base_dir: str, n_orderers: int = 3,
                     "key_pem": _key_pem(ckey).decode(),
                     "channel_config_hex": cfg_hex,
                     "channel_id": channel_id,
-                    "orderers": [["127.0.0.1", p] for p in ord_ports],
+                    "orderers": [["127.0.0.1", p]
+                                 for p in ord_ports + spare_ports],
                     "peers": [["127.0.0.1", p, o]
                               for (o, k, p) in peer_list],
                 }, f)
@@ -301,5 +352,6 @@ def provision_network(base_dir: str, n_orderers: int = 3,
             }, f)
         admins[org_name] = path
     return {"orderers": orderer_paths, "peers": peer_paths,
+            "spare_orderers": spare_paths,
             "clients": clients, "clients_ed25519": clients_ed25519,
             "admins": admins}

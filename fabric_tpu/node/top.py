@@ -149,6 +149,18 @@ def collect_node(addr: str, timeout: float = 2.0) -> dict:
         {labels.get("reason", "?") for labels, v in byz_series or ()
          if v})
     row["byz_offenses"] = _sum(metrics.get("byzantine_offenses_total"))
+    # pardon plane (r18): lifetime pardons + the live decaying standing
+    # score, read from /byzantine (the counters alone can't show decay —
+    # a counter never goes down, but standing scores do)
+    try:
+        byz = _get_json(addr, "/byzantine", timeout)
+        row["byz_pardons"] = byz.get("pardons")
+        row["byz_score"] = sum(
+            int(ent.get("score", 0) or 0)
+            for ent in (byz.get("identities") or {}).values())
+    except Exception:
+        row["byz_pardons"] = None
+        row["byz_score"] = None
     # verify-once plane: cache hit rate over all lookups, and the
     # rolling fraction of committed verify items whose verdicts were
     # speculatively cached before the block arrived
@@ -204,14 +216,18 @@ def collect_node(addr: str, timeout: float = 2.0) -> dict:
     except Exception:
         row["fault_plan"] = None
     try:
-        row["health"] = _get_json(addr, "/healthz", timeout).get("status")
+        hz = _get_json(addr, "/healthz", timeout)
     except Exception as exc:
         # /healthz answers 503 with a JSON body while degraded
         body = getattr(exc, "read", lambda: b"")()
         try:
-            row["health"] = json.loads(body).get("status")
+            hz = json.loads(body)
         except Exception:
-            row["health"] = "?"
+            hz = {}
+    row["health"] = hz.get("status", "?")
+    # fleet lifecycle (r18): serving / draining / drained, surfaced on
+    # /healthz by nodes that expose drain() — blank on older nodes
+    row["lifecycle"] = hz.get("lifecycle")
     return row
 
 
@@ -246,9 +262,9 @@ def _fmt_devices(devs) -> str:
 
 _COLS = ("NODE", "HT", "TX/S", "COLLECT", "DISP", "GATE", "COMMIT",
          "OCC", "DEV", "OVLP", "VCACHE", "SPEC", "STATE", "RES", "QD",
-         "BRKR", "SHED", "FAULTS", "BYZ", "SLO", "HEALTH")
-_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 11, 9, 4, 5, 9, 7, 10,
-           12, 8)
+         "BRKR", "SHED", "FAULTS", "BYZ", "LIFE", "SLO", "HEALTH")
+_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 11, 9, 4, 5, 9, 7, 12,
+           8, 12, 8)
 
 # gateway_admission_state gauge value -> short cell tag
 _ADM_SHORT = {0: "ok", 1: "EVAL", 2: "PROB", 3: "HARD"}
@@ -266,8 +282,12 @@ def _fmt_shed(row: dict) -> str:
 
 
 def _fmt_byz(row: dict) -> str:
-    """`<quarantined>[reason,..]/<offense score>`: `0` is the healthy
-    steady state (the byzantine plane is live and has convicted nobody);
+    """`<quarantined>[reason,..]/<offense score>~<standing>+<pardons>p`:
+    `0` is the healthy steady state (the byzantine plane is live and has
+    convicted nobody); `~N` is the LIVE decaying standing score summed
+    over known identities (offense counters only ever rise — the `~`
+    tail is what actually shrinks as clean windows elapse); `+Np` counts
+    pardons granted (offense quarantines restored after a clean window);
     `-` means the node exports no byzantine series (plane disabled)."""
     q = row.get("byz_quarantines")
     if q is None:
@@ -279,7 +299,22 @@ def _fmt_byz(row: dict) -> str:
     off = row.get("byz_offenses") or 0.0
     if off:
         cell += f"/{off:.0f}"
+    score = row.get("byz_score")
+    if score:
+        cell += f"~{score:.0f}"
+    pardons = row.get("byz_pardons")
+    if pardons:
+        cell += f"+{pardons:.0f}p"
     return cell
+
+
+def _fmt_life(row: dict) -> str:
+    """Fleet lifecycle cell: serving / draining / drained (from
+    /healthz); `-` on nodes without a drain-capable ops plane."""
+    lc = row.get("lifecycle")
+    if not lc:
+        return "-"
+    return str(lc)
 
 
 def _fmt_state(row: dict) -> str:
@@ -342,6 +377,7 @@ _SORT_KEYS = {
     "rate": "rate", "occupancy": "occupancy", "dev": "devices",
     "vcache": "vcache", "spec": "spec", "shed": "shed_total",
     "state": "state_keys", "byz": "byz_quarantines", "res": "rss",
+    "life": "lifecycle",
 }
 
 
@@ -360,6 +396,9 @@ def sort_rows(rows: List[dict], column: str) -> List[dict]:
         elif key == "devices":
             vals = [x for x in (v or {}).values() if x is not None]
             v = min(vals) if vals else None
+        elif key == "lifecycle":
+            # nodes leaving the fleet rise to the top
+            v = {"drained": 2.0, "draining": 1.0, "serving": 0.0}.get(v)
         if not isinstance(v, (int, float)):
             return (1, 0.0)
         return (0, -float(v))
@@ -402,7 +441,8 @@ def render(rows: List[dict], spark_name: Optional[str] = None) -> str:
             f"{r.get('queue_depth', 0):.0f}",
             f"{r.get('breakers_open', 0):.0f}",
             _fmt_shed(r),
-            faults, _fmt_byz(r), slo, str(r.get("health", "?")))
+            faults, _fmt_byz(r), _fmt_life(r), slo,
+            str(r.get("health", "?")))
         if spark_name:
             cells = cells + (r.get("spark") or "-",)
         lines.append("  ".join(str(c).ljust(w)

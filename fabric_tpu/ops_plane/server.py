@@ -28,6 +28,13 @@ class OperationsServer:
                  metrics: Optional[MetricsRegistry] = None):
         self.metrics = metrics or default_registry
         self._checkers: Dict[str, Callable] = {}
+        # fleet lifecycle: a provider returning "serving" | "draining" |
+        # "drained", surfaced in the /healthz body so rollout tooling
+        # (chaos rolling_restart, node.top LIFECYCLE column) can watch a
+        # drain complete without a separate endpoint.  A draining node
+        # still answers 200 when its checkers pass — drain is an
+        # ORDERLY state, not a failure.
+        self.lifecycle_fn: Optional[Callable] = None
         # extension routes: (method, path-prefix) -> fn(path, body) ->
         # (code, json-able) — e.g. the orderer's channelparticipation REST
         self._routes: Dict[tuple, Callable] = {}
@@ -50,9 +57,14 @@ class OperationsServer:
                     self._send(200, ops.metrics.expose_text().encode())
                 elif self.path == "/healthz":
                     ok, failed = ops.run_checks()
-                    body = json.dumps(
-                        {"status": "OK" if ok else "Service Unavailable",
-                         "failed_checks": failed}).encode()
+                    out = {"status": "OK" if ok else "Service Unavailable",
+                           "failed_checks": failed}
+                    if ops.lifecycle_fn is not None:
+                        try:
+                            out["lifecycle"] = str(ops.lifecycle_fn())
+                        except Exception:
+                            pass
+                    body = json.dumps(out).encode()
                     self._send(200 if ok else 503, body, "application/json")
                 elif self.path == "/version":
                     self._send(200, json.dumps({"version": VERSION}).encode(),

@@ -170,6 +170,16 @@ class EntryVerifier:
         # consenter, not per retransmitted entry
         self._idents: Dict[bytes, tuple] = {}
 
+    def set_consenters(self, consenters) -> None:
+        """Rebind after a committed membership change.  The proposer
+        cache is cleared so a RETIRED consenter's cached (binding,
+        identity) cannot keep vouching for its entries — from the commit
+        point forward its proposals fail the binding check.  The _seen
+        slot cache survives: equivocation evidence keyed by (term,
+        index, binding) stays valid across reconfigs."""
+        self.bindings = {f"{m}|{f}" for m, f in consenters.values()}
+        self._idents.clear()
+
     def _proposer(self, raw: bytes):
         cached = self._idents.get(raw)
         if cached is not None:
@@ -358,6 +368,37 @@ class ClusterService:
                     channel_id, self.msps,
                     consenters if consenters is not None
                     else self.consenters)
+        self._wake.set()
+
+    def update_membership(self, channel_id: str,
+                          consenters: Dict[int, Tuple[str, str]],
+                          peers: Dict[int, Tuple[str, int]]) -> None:
+        """Atomically swap a channel's consenter identity map + peer
+        address map and rebind its EntryVerifier — called when a
+        membership config entry COMMITS (never on mere proposal).  One
+        lock scope so _on_step can never observe a new consenter set
+        with a stale verifier (or vice versa): a removed consenter's
+        messages are rejected at the consenter-lookup gate and its
+        entries at the binding check from the same instant.
+
+        Outbound ADDRESSES merge instead of replacing: the address map
+        is plumbing, not authorization (inbound is gated on the
+        consenter map above), and the leader's farewell append to a
+        just-removed server — the one message that lets it observe its
+        own removal and self-evict — must still be deliverable after
+        the commit that removed it.  Nothing else addresses a node
+        outside the raft node set, so a retired address is inert; a
+        re-added node id takes the fresh address."""
+        with self._lock:
+            self._chan_consenters[channel_id] = dict(consenters)
+            merged = dict(self._chan_peers.get(channel_id, {}))
+            merged.update({nid: tuple(a) for nid, a in peers.items()})
+            self._chan_peers[channel_id] = merged
+            verifier = self._verifiers.get(channel_id)
+            if verifier is not None:
+                verifier.set_consenters(consenters)
+            for addr in peers.values():
+                self._sender_for(tuple(addr))
         self._wake.set()
 
     def remove_chain(self, channel_id: str) -> None:

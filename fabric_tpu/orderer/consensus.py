@@ -180,12 +180,18 @@ class RaftChain(Chain):
     """
 
     def __init__(self, node, cutter: BlockCutter, writer: BlockWriter,
-                 on_block: Optional[Callable] = None, entry_signer=None):
+                 on_block: Optional[Callable] = None, entry_signer=None,
+                 on_conf: Optional[Callable] = None):
         from fabric_tpu.utils import serde as _serde
         self._serde = _serde
         self.node = node
         self.cutter = cutter
         self.writer = writer
+        # membership hook: called with the decoded conf payload
+        # ({"op","node",...}) each time a membership entry COMMITS.  Conf
+        # entries do not advance _last_applied, so they replay on restart
+        # — the hook MUST be idempotent.
+        self.on_conf = on_conf or (lambda conf: None)
         # consenter entry signing (round 14): install the signer on the
         # raft node so every local append — proposals, conf changes, the
         # new-leader no-op — carries (proposer, sig); the cluster service
@@ -247,6 +253,21 @@ class RaftChain(Chain):
         from fabric_tpu.orderer import raft as raftmod
         if self.node.role != raftmod.LEADER:
             raise raftmod.NotLeaderError(self.node.leader_id)
+
+    def propose_membership(self, op: str, node_id: int, **meta) -> int:
+        """Propose an add/remove-consenter config entry through the log
+        (leader only).  Returns the entry's raft index; the change takes
+        effect — on every replica, including this one — when the entry
+        commits and on_conf fires."""
+        with self._lock:
+            self._check_running()
+            self._check_leader()
+            return self.node.propose_conf(op, node_id, **meta)
+
+    def transfer_leadership(self, to: int) -> bool:
+        """Ask raft to hand leadership to `to` (drain path)."""
+        with self._lock:
+            return self.node.transfer_leadership(to)
 
     def tick_batch(self, now: Optional[float] = None) -> bool:
         """Cut + propose the pending batch when the batch timeout fires."""
@@ -319,7 +340,18 @@ class RaftChain(Chain):
                     self._on_snapshot_entry(e)
                 elif e.kind == raftmod.ENTRY_NORMAL:
                     self._apply(e)
-                # ENTRY_CONF is applied inside the raft node (membership)
+                elif e.kind == raftmod.ENTRY_CONF:
+                    # the raft-internal effect (node set change) already
+                    # ran inside take_ready; surface the full payload so
+                    # the owning node can follow — consenter identity
+                    # maps, transport addresses, persisted channel state
+                    try:
+                        self.on_conf(self._serde.decode(e.data))
+                    except Exception:
+                        import logging
+                        logging.getLogger(
+                            "fabric_tpu.orderer.consensus").exception(
+                            "membership conf hook failed")
             # compact only after the entries above hit the ledger — and
             # never while catching up, when _last_applied/height lag the
             # raft applied index and would bake stale state into the snap

@@ -36,6 +36,7 @@ MSG_VOTE_RESP = "vote_resp"
 MSG_APP = "app"            # AppendEntries (heartbeat when entries empty)
 MSG_APP_RESP = "app_resp"
 MSG_SNAP = "snap"          # InstallSnapshot
+MSG_TIMEOUT_NOW = "timeout_now"  # leadership transfer: campaign NOW
 
 ENTRY_NORMAL = "normal"
 ENTRY_CONF = "conf"        # data: serde{"op": "add"|"remove", "node": id}
@@ -329,6 +330,13 @@ class RaftNode:
             if e.kind == ENTRY_CONF:
                 self._apply_conf(e)
             r.committed.append(e)
+        # messages minted while applying (the farewell append to a
+        # removed consenter) must ride THIS ready: the application's
+        # conf hook runs on r.committed and drops the removed node's
+        # transport address — a later ready could no longer reach it
+        if self._ready.messages:
+            r.messages.extend(self._ready.messages)
+            self._ready.messages = []
         return r
 
     def maybe_compact(self) -> None:
@@ -360,10 +368,16 @@ class RaftNode:
         self._maybe_commit()  # single-node cluster commits immediately
         return e.index
 
-    def propose_conf(self, op: str, node: int) -> int:
+    def propose_conf(self, op: str, node: int, **meta) -> int:
+        """Single-server membership change through the log itself.
+        Extra keyword payload (host/port/mspid/cert_fp for an added
+        consenter) rides inside the entry so every replica — including
+        ones that restart and re-apply — learns the full transport +
+        identity binding from the SAME committed record; _apply_conf
+        only reads op/node, so old replicas ignore the extras."""
         if self.role != LEADER:
             raise NotLeaderError(self.leader_id)
-        data = serde.encode({"op": op, "node": node})
+        data = serde.encode({"op": op, "node": node, **meta})
         e = self._new_entry(data, ENTRY_CONF)
         self.log.append(e)
         self._persist_entries([e])
@@ -371,6 +385,22 @@ class RaftNode:
         self._broadcast_append()
         self._maybe_commit()
         return e.index
+
+    def transfer_leadership(self, to: int) -> bool:
+        """Graceful handover (etcd/raft MsgTransferLeader): tell an
+        up-to-date follower to campaign NOW.  Only fires when `to`'s
+        match index is caught up to our last entry — transferring to a
+        lagging follower would force an availability gap while it
+        catches up.  Returns True when the order was sent; the caller
+        polls role/leader_id for the outcome (the transferee's higher
+        term deposes us via the normal vote path)."""
+        if self.role != LEADER or to == self.id or to not in self.nodes:
+            return False
+        if self.match_index.get(to, 0) < self.last_index():
+            self._send_append(to)   # nudge replication along
+            return False
+        self._send(Message(MSG_TIMEOUT_NOW, self.id, to, self.term))
+        return True
 
     def tick(self) -> None:
         self._elapsed += 1
@@ -396,8 +426,18 @@ class RaftNode:
                    MSG_VOTE_RESP: self._on_vote_resp,
                    MSG_APP: self._on_append,
                    MSG_APP_RESP: self._on_append_resp,
-                   MSG_SNAP: self._on_snapshot}[m.type]
+                   MSG_SNAP: self._on_snapshot,
+                   MSG_TIMEOUT_NOW: self._on_timeout_now}[m.type]
         handler(m)
+
+    def _on_timeout_now(self, m: Message) -> None:
+        """Leadership-transfer order from the current leader: campaign
+        immediately, without waiting out the election timeout.  The
+        up-to-date check in _campaign's voters still applies, so a
+        stale transferee cannot steal the log."""
+        if m.frm != self.leader_id or self.role == LEADER:
+            return
+        self._campaign()
 
     def compact(self, index: int) -> None:
         """Take a snapshot at `index` and drop the log prefix."""
@@ -638,6 +678,13 @@ class RaftNode:
                 self.match_index.setdefault(n, 0)
             if self.id not in self.nodes:
                 self._become_follower(self.term, None)  # self-eviction
+            elif d["op"] == "remove" and d["node"] != self.id:
+                # farewell append: replication to the removed server
+                # stops the instant its removal commits, so without one
+                # last append carrying the new commit index it never
+                # learns it was removed and can never self-evict (the
+                # classic removed-server problem)
+                self._send_append(int(d["node"]))
 
     # -- plumbing ------------------------------------------------------------
 

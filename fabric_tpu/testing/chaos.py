@@ -47,14 +47,15 @@ class ChaosNet:
                  gateway_cfg: Optional[dict] = None,
                  peer_overrides: Optional[dict] = None,
                  orderer_overrides: Optional[dict] = None,
-                 node_factory=None):
+                 node_factory=None, spare_orderers: int = 0):
         from fabric_tpu.node.provision import provision_network
         self.base_dir = str(base_dir)
         self.channel_id = channel_id
         self.paths = provision_network(
             self.base_dir, n_orderers=n_orderers,
             peer_orgs=list(peer_orgs), peers_per_org=peers_per_org,
-            channel_id=channel_id, batch=batch)
+            channel_id=channel_id, batch=batch,
+            spare_orderers=spare_orderers)
         self.gateway_cfg = gateway_cfg or {
             "linger_s": 0.002, "max_batch": 8,
             "broadcast_deadline_s": 20.0}
@@ -70,6 +71,14 @@ class ChaosNet:
             self._specs[self._name_of(p)] = ("orderer", p)
         for p in self.paths["peers"]:
             self._specs[self._name_of(p)] = ("peer", p)
+        # spare orderers: provisioned (identity + cfg on disk) but NOT
+        # auto-started — a membership drill starts one with restart()
+        # after committing its add-consenter config entry
+        self._spares: set = set()
+        for p in self.paths.get("spare_orderers", []):
+            name = self._name_of(p)
+            self._specs[name] = ("orderer", p)
+            self._spares.add(name)
         self.nodes: Dict[str, object] = {}      # name -> live node
 
     @staticmethod
@@ -100,13 +109,24 @@ class ChaosNet:
 
     def start(self, leader_timeout_s: float = 60.0) -> "ChaosNet":
         for name, (kind, _) in self._specs.items():
-            if kind == "orderer":
+            if kind == "orderer" and name not in self._spares:
                 self.nodes[name] = self._build(name).start()
         self.wait_for_leader(leader_timeout_s)
         for name, (kind, _) in self._specs.items():
             if kind == "peer":
                 self.nodes[name] = self._build(name).start()
         return self
+
+    def spare_names(self) -> List[str]:
+        """Provisioned-but-unjoined orderers, in raft-id order."""
+        return sorted(self._spares)
+
+    def spare_cfg(self, name: str) -> dict:
+        """The spare's node config (raft_id, port, cert_fp, ...) — the
+        material an add-consenter proposal needs."""
+        _, path = self._specs[name]
+        with open(path) as f:
+            return json.load(f)
 
     def kill(self, name: str) -> None:
         """Crash-stop one node: close its listeners and abandon it.
@@ -140,6 +160,44 @@ class ChaosNet:
         if kind == "orderer":
             self.wait_for_leader(wait_s)
         return node
+
+    def drain(self, name: str, timeout_s: float = 10.0) -> dict:
+        """Graceful drain of one running node (peer or orderer): stop
+        admitting, flush in-flight work, checkpoint/fsync, release
+        leadership — the opposite of kill()'s crash-stop."""
+        node = self.nodes.get(name)
+        if node is None:
+            raise KeyError(f"{name!r} is not running")
+        logger.warning("chaos: draining %s", name)
+        return node.drain(timeout_s=timeout_s)
+
+    def rolling_restart(self, names: Optional[List[str]] = None,
+                        drain_timeout_s: float = 10.0,
+                        settle_s: float = 60.0) -> Dict[str, dict]:
+        """The rolling-upgrade primitive: drain -> kill -> restart each
+        named node in turn (default: every running node, orderers
+        first), waiting for peer convergence after each peer restart so
+        at most one node is ever down.  Returns per-node drain reports
+        (a failed drain is recorded, the roll continues — an upgrade
+        must not wedge on one stuck node)."""
+        if names is None:
+            names = [n for n, (k, _) in self._specs.items()
+                     if n in self.nodes]
+        reports: Dict[str, dict] = {}
+        for name in names:
+            if name not in self.nodes:
+                continue
+            try:
+                reports[name] = self.drain(name,
+                                           timeout_s=drain_timeout_s)
+            except Exception as exc:
+                logger.exception("chaos: drain of %s failed", name)
+                reports[name] = {"error": str(exc)}
+            self.kill(name)
+            self.restart(name)
+            if self._specs[name][0] == "peer":
+                self.wait_converged(timeout_s=settle_s)
+        return reports
 
     def stop_all(self) -> None:
         # peers first so their deliver loops stop hammering dead orderers

@@ -24,6 +24,21 @@ this framework already owns:
                   accepts ({"p256": w, "ed25519": w}); idemix creators
                   are validated end-to-end by the idemix test lane —
                   channel-config idemix enrollment is a roadmap item
+  fan_out         shard the ONE seeded arrival process across every
+                  gateway peer (socket slot i -> peer i mod n) instead
+                  of pinning the whole population to peer 0 — the load
+                  shape fleet-lifecycle drills need, since a drill that
+                  drains peers must see traffic ON the drained peer
+  rolling_upgrade background drill: drain -> kill -> restart every node
+                  one at a time under load (ChaosNet.rolling_restart),
+                  recording pre/post heights for the no-regression gate
+  membership_churn background drill: add a provisioned spare orderer
+                  through an add-consenter config entry, start it,
+                  transfer leadership onto it, then remove an original
+                  consenter — all mid-traffic
+  scale_out       background drill: N peers wiped + snapshot-bootstrapped
+                  simultaneously from ONE source peer under load (the
+                  elastic-join path; exercises concurrent chunk serving)
   phases          open-loop arrival phases (workload.runner format)
   expect          in-run SLO assertions, evaluated before the report is
                   written: convergence, quarantine counts BY REASON,
@@ -276,6 +291,88 @@ SCENARIOS: Dict[str, dict] = {
             }},
         ],
     },
+    "rolling-upgrade": {
+        "description": "drain -> restart every node one at a time while "
+                       "the open loop keeps firing across all gateway "
+                       "peers: each node must hand off cleanly (orderers "
+                       "transfer leadership, peers checkpoint), come "
+                       "back from disk without losing committed height, "
+                       "and the fleet must end converged with every "
+                       "txid committed exactly once and ZERO "
+                       "quarantines — an upgrade is not a crime",
+        "topology": {"n_orderers": 3, "peer_orgs": ["Org1", "Org2"],
+                     "peers_per_org": 1},
+        "fan_out": True,
+        # inline mode: endorsement happens at fire time, so the drill
+        # overlaps real traffic (pool mode would pre-endorse everything
+        # against a peer the drill is about to drain)
+        "mode": "inline",
+        "rolling_upgrade": {"after_s": 2.0, "drain_timeout_s": 6.0,
+                            "settle_s": 60.0},
+        "phases": [
+            {"name": "steady", "duration_s": 14.0,
+             "arrivals": {"kind": "constant", "rate": 12.0}},
+        ],
+        "expect": [
+            {"kind": "rolling_upgrade"},
+            {"kind": "no_height_regression"},
+            {"kind": "converged", "min_height": 2, "timeout_s": 90.0},
+            {"kind": "exactly_once"},
+            {"kind": "zero_quarantines"},
+            {"kind": "min_committed", "value": 1},
+            {"kind": "sojourn_p99_ms", "max_ms": 30000},
+        ],
+    },
+    "membership-churn": {
+        "description": "dynamic consenter set under load: a provisioned "
+                       "spare orderer is added through an add-consenter "
+                       "config entry riding the raft log itself, "
+                       "started, handed leadership, then an original "
+                       "consenter is removed by a second config entry — "
+                       "the removed node self-evicts, every remaining "
+                       "node drops it from the signed-entry verifier, "
+                       "and throughput/exactly-once hold throughout "
+                       "with zero false-positive quarantines",
+        "topology": {"n_orderers": 3, "peer_orgs": ["Org1", "Org2"],
+                     "peers_per_org": 1, "spare_orderers": 1},
+        "membership_churn": {"after_s": 2.0, "remove": "orderer1"},
+        "phases": [
+            {"name": "steady", "duration_s": 14.0,
+             "arrivals": {"kind": "constant", "rate": 10.0}},
+        ],
+        "expect": [
+            {"kind": "membership_churn"},
+            {"kind": "converged", "min_height": 2, "timeout_s": 90.0},
+            {"kind": "exactly_once"},
+            {"kind": "zero_quarantines"},
+            {"kind": "min_committed", "value": 1},
+        ],
+    },
+    "elastic-scale-out": {
+        "description": "elastic join: two peers are wiped and snapshot-"
+                       "bootstrap SIMULTANEOUSLY from one serving peer "
+                       "while that peer is also carrying the client "
+                       "load — both must install the same checkpoint "
+                       "generation (the chunk server lease-pins it "
+                       "against concurrent checkpoint GC), join deliver "
+                       "at snapshot height, and converge with the fleet",
+        "topology": {"n_orderers": 3, "peer_orgs": ["Org1", "Org2"],
+                     "peers_per_org": 2},
+        "scale_out": {"source": "peerOrg1_0",
+                      "joiners": ["peerOrg2_0", "peerOrg2_1"],
+                      "after_s": 3.0},
+        "phases": [
+            {"name": "steady", "duration_s": 12.0,
+             "arrivals": {"kind": "constant", "rate": 10.0}},
+        ],
+        "expect": [
+            {"kind": "scale_out"},
+            {"kind": "converged", "min_height": 3, "timeout_s": 90.0},
+            {"kind": "exactly_once"},
+            {"kind": "zero_quarantines"},
+            {"kind": "min_committed", "value": 1},
+        ],
+    },
     "burst-partition": {
         "description": "square-wave bursts while Org2's outbound links "
                        "black-hole for a mid-run window (crash-stop "
@@ -439,6 +536,257 @@ def _snapshot_rejoin(net, spec: dict) -> dict:
     out["from_honest"] = src == list(honest_addr)
     out["refused_quarantined"] = src != list(evil_addr)
     return out
+
+
+# ---------------------------------------------------------------------------
+# fleet lifecycle drills (background threads riding the load phases)
+
+def _admin_call(net, admin, msps, method: str, body: dict,
+                timeout_s: float = 30.0,
+                retry_on=("not_leader",)):
+    """Issue one admin RPC against whichever running orderer currently
+    leads: walk the consenters, follow not_leader verdicts (and any
+    other status named in `retry_on`), retry until something terminal
+    comes back.  Returns (orderer-name, response) — (None, last-error)
+    when the deadline lapses."""
+    from fabric_tpu.comm.rpc import connect
+    deadline = time.time() + timeout_s
+    last = None
+    while time.time() < deadline:
+        for oname, (kind, _) in list(net._specs.items()):
+            if kind != "orderer" or oname not in net.nodes:
+                continue
+            try:
+                conn = connect(net.orderer_addr(oname), admin, msps,
+                               timeout=5.0)
+                try:
+                    out = conn.call(method, body, timeout=10.0)
+                finally:
+                    conn.close()
+                if out.get("status") not in retry_on:
+                    return oname, out
+                last = out
+            except Exception as exc:       # dial/refusal: try the next
+                last = {"error": str(exc)}
+        time.sleep(0.2)
+    return None, last
+
+
+def _load_admin(net):
+    """(admin-signer, msps) for the first peer org — membership RPCs
+    are Admins-gated, and a peer-org admin of the bootstrap channel
+    satisfies the orderer's participation gate."""
+    from fabric_tpu.node.orderer import load_signing_identity
+    org = sorted(net.paths["admins"])[0]
+    with open(net.paths["admins"][org]) as f:
+        ac = json.load(f)
+    admin = load_signing_identity(
+        ac["mspid"], ac["cert_pem"].encode(), ac["key_pem"].encode())
+    return admin, net.peers()[0].msps
+
+
+def _rolling_upgrade_thread(net, spec: dict, out: dict) -> threading.Thread:
+    """Background rolling restart: after `after_s`, drain -> kill ->
+    restart every running node one at a time while the open loop keeps
+    firing.  Pre/post heights land in `out` for the no-regression gate."""
+    rcfg = dict(spec.get("rolling_upgrade") or {})
+
+    def _run() -> None:
+        time.sleep(float(rcfg.get("after_s", 2.0)))
+        out["pre_heights"] = net.heights()
+        try:
+            out["drains"] = net.rolling_restart(
+                drain_timeout_s=float(rcfg.get("drain_timeout_s", 6.0)),
+                settle_s=float(rcfg.get("settle_s", 60.0)))
+        except Exception as exc:
+            logger.exception("rolling upgrade drill failed")
+            out["error"] = str(exc)
+        out["post_heights"] = net.heights()
+        out["regressed"] = sorted(
+            n for n, h in out["pre_heights"].items()
+            if out["post_heights"].get(n, 0) < h)
+        out["done"] = True
+
+    t = threading.Thread(target=_run, name="scenario-roll", daemon=True)
+    t.start()
+    return t
+
+
+def _membership_churn_thread(net, spec: dict, out: dict) -> threading.Thread:
+    """Background membership churn: add the provisioned spare consenter
+    through the log, start it, transfer leadership onto it, remove an
+    original consenter, then prove the removed node is out — every
+    remaining consenter's raft node set excludes it, the removed node
+    self-evicted, and (once killed) the fleet keeps committing without
+    it."""
+    mcfg = dict(spec.get("membership_churn") or {})
+
+    def _wait(pred, timeout_s: float) -> bool:
+        deadline = time.time() + float(timeout_s)
+        while time.time() < deadline:
+            try:
+                if pred():
+                    return True
+            except Exception:
+                pass
+            time.sleep(0.1)
+        return False
+
+    def _run() -> None:
+        time.sleep(float(mcfg.get("after_s", 2.0)))
+        try:
+            admin, msps = _load_admin(net)
+            spare = net.spare_names()[0]
+            scfg = net.spare_cfg(spare)
+            spare_rid = int(scfg["raft_id"])
+            out["spare"] = spare
+
+            # 1. add-consenter config entry THROUGH the raft log
+            who, resp = _admin_call(net, admin, msps, "admin.add_consenter",
+                                    {"raft_id": spare_rid,
+                                     "host": scfg.get("host", "127.0.0.1"),
+                                     "port": int(scfg["port"]),
+                                     "mspid": scfg["mspid"],
+                                     "cert_fp": scfg["cert_fp"]})
+            out["add"] = {"via": who, "resp": resp}
+            if who is None or resp.get("status") != "proposed":
+                out["error"] = f"add_consenter failed: {resp}"
+                return
+
+            # 2. start the spare; it replicates the log (including its
+            # own add entry) from the leader and becomes a voter
+            spare_node = net.restart(spare)
+            out["added_joined"] = _wait(
+                lambda: spare_rid in spare_node.support.chain.node.nodes
+                and spare_node.support.chain.node.applied_index
+                >= int(resp.get("index", 1)), 30.0)
+
+            # 3. leadership onto the NEW consenter (the gap-free
+            # handover the drain path uses; retried — a transfer is a
+            # request, the target still has to win its election)
+            def _spare_leads():
+                return spare_node.support.chain.node.role == "leader"
+            deadline = time.time() + 30.0
+            while not _spare_leads() and time.time() < deadline:
+                _admin_call(net, admin, msps, "admin.transfer_leadership",
+                            {"to": spare_rid}, timeout_s=5.0,
+                            retry_on=("not_leader", "refused"))
+                _wait(_spare_leads, 2.0)
+            out["leader_transferred"] = _spare_leads()
+
+            # 4. remove an ORIGINAL consenter by a second config entry
+            victim = str(mcfg.get("remove", "orderer1"))
+            with open(net._specs[victim][1]) as f:
+                victim_rid = int(json.load(f)["raft_id"])
+            victim_node = net.nodes[victim]
+            who, resp = _admin_call(net, admin, msps,
+                                    "admin.remove_consenter",
+                                    {"raft_id": victim_rid})
+            out["remove"] = {"via": who, "resp": resp, "node": victim}
+            if who is None or resp.get("status") != "proposed":
+                out["error"] = f"remove_consenter failed: {resp}"
+                return
+
+            # 5. the removal must take everywhere: remaining consenters
+            # drop the victim from their raft node sets (its entries are
+            # rejected at the consenter-authorization gate from the
+            # commit point forward) and the victim self-evicts
+            remaining = [net.nodes[n] for n, (k, _) in net._specs.items()
+                         if k == "orderer" and n in net.nodes
+                         and n != victim]
+            out["removed_isolated"] = _wait(
+                lambda: all(victim_rid not in o.support.chain.node.nodes
+                            for o in remaining), 30.0)
+            out["removed_self_evicted"] = _wait(
+                lambda: victim_rid
+                not in victim_node.support.chain.node.nodes, 30.0)
+            # decommission the now-external process; deliver clients
+            # fail over and the fleet must keep committing without it
+            net.kill(victim)
+        except Exception as exc:
+            logger.exception("membership churn drill failed")
+            out["error"] = str(exc)
+        finally:
+            out["done"] = True
+
+    t = threading.Thread(target=_run, name="scenario-churn", daemon=True)
+    t.start()
+    return t
+
+
+def _scale_out_thread(net, spec: dict, out: dict) -> threading.Thread:
+    """Background elastic scale-out: wipe N peers and snapshot-bootstrap
+    them SIMULTANEOUSLY from one source peer that is still serving the
+    client load — the concurrent-fetch path the chunk server's
+    generation leases exist for."""
+    scfg = dict(spec.get("scale_out") or {})
+
+    def _run() -> None:
+        import shutil
+        time.sleep(float(scfg.get("after_s", 3.0)))
+        try:
+            source = str(scfg.get("source", "peerOrg1_0"))
+            joiners = [str(j) for j in (scfg.get("joiners") or [])]
+            with open(net._specs[source][1]) as f:
+                src_cfg = json.load(f)
+            src_addr = [src_cfg.get("host", "127.0.0.1"),
+                        int(src_cfg["port"])]
+            # the source needs a snapshotable history first (pool-mode
+            # pre-endorsement can hold the load back for a while, so
+            # this wait is generous)
+            deadline = time.time() + 120.0
+            while time.time() < deadline:
+                src = net.nodes.get(source)
+                if src is not None and \
+                        src.channels[net.channel_id].ledger.height >= 2:
+                    break
+                time.sleep(0.1)
+            out["source"] = source
+            results: Dict[str, dict] = {}
+
+            def _join(name: str) -> None:
+                try:
+                    with open(net._specs[name][1]) as f:
+                        vcfg = json.load(f)
+                    net.kill(name)
+                    root = os.path.join(vcfg["data_dir"], "channels",
+                                        net.channel_id, "ledger")
+                    if not os.path.isdir(root):
+                        root = os.path.join(vcfg["data_dir"], "ledger")
+                    shutil.rmtree(root, ignore_errors=True)
+                    vcfg["bootstrap_snapshot"] = {
+                        "enabled": True, "from": [src_addr],
+                        "chunk_timeout_s": 5.0, "attempts": 6}
+                    with open(net._specs[name][1], "w") as f:
+                        json.dump(vcfg, f)
+                    node = net.restart(name)
+                    ch = node.channels[net.channel_id]
+                    results[name] = {
+                        "bootstrap": getattr(ch, "snapshot_bootstrap",
+                                             None),
+                        "base": int(ch.ledger.blockstore.base),
+                        "height": int(ch.ledger.height)}
+                except Exception as exc:
+                    logger.exception("scale-out join of %s failed", name)
+                    results[name] = {"error": str(exc)}
+
+            threads = [threading.Thread(target=_join, args=(n,),
+                                        name=f"scale-out-{n}",
+                                        daemon=True) for n in joiners]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=120.0)
+            out["joiners"] = results
+        except Exception as exc:
+            logger.exception("scale-out drill failed")
+            out["error"] = str(exc)
+        finally:
+            out["done"] = True
+
+    t = threading.Thread(target=_run, name="scenario-scale", daemon=True)
+    t.start()
+    return t
 
 
 # ---------------------------------------------------------------------------
@@ -638,6 +986,65 @@ def _check_expectations(spec: dict, net, report: dict,
             if missing:
                 violations.append(
                     f"leak_free: insufficient samples for {missing}")
+        elif kind == "rolling_upgrade":
+            ru = report.get("rolling_upgrade") or {}
+            if not ru.get("done"):
+                violations.append("rolling_upgrade: drill never finished")
+            elif ru.get("error"):
+                violations.append(f"rolling_upgrade: {ru['error']}")
+            else:
+                stuck = {n: r for n, r in (ru.get("drains") or {}).items()
+                         if r.get("lifecycle") != "drained"}
+                if stuck:
+                    violations.append(
+                        f"rolling_upgrade: nodes never drained {stuck}")
+        elif kind == "no_height_regression":
+            ru = report.get("rolling_upgrade") or {}
+            if ru.get("regressed"):
+                violations.append(
+                    f"no_height_regression: committed height lost on "
+                    f"{ru['regressed']} (pre={ru.get('pre_heights')}, "
+                    f"post={ru.get('post_heights')})")
+        elif kind == "membership_churn":
+            mc = report.get("membership_churn") or {}
+            if not mc.get("done"):
+                violations.append("membership_churn: drill never finished")
+            elif mc.get("error"):
+                violations.append(f"membership_churn: {mc['error']}")
+            else:
+                for flag in ("added_joined", "leader_transferred",
+                             "removed_isolated", "removed_self_evicted"):
+                    if not mc.get(flag):
+                        violations.append(
+                            f"membership_churn: {flag} is false ({mc})")
+        elif kind == "scale_out":
+            so = report.get("scale_out") or {}
+            joiners = so.get("joiners") or {}
+            if not so.get("done") or not joiners:
+                violations.append(
+                    f"scale_out: drill incomplete ({so})")
+            elif so.get("error"):
+                violations.append(f"scale_out: {so['error']}")
+            else:
+                for name, r in joiners.items():
+                    if r.get("error"):
+                        violations.append(
+                            f"scale_out[{name}]: {r['error']}")
+                    elif int(r.get("base", 0) or 0) <= 0:
+                        violations.append(
+                            f"scale_out[{name}]: no snapshot installed "
+                            f"(joined from genesis, base="
+                            f"{r.get('base')})")
+        elif kind == "sojourn_p99_ms":
+            # accepted-path tail straight off the runner's totals:
+            # arrival -> orderer ack for every ADMITTED submission
+            v = (tot.get("sojourn_ms") or {}).get("p99")
+            limit = float(check["max_ms"])
+            if v is None:
+                violations.append("sojourn_p99_ms: nothing accepted")
+            elif float(v) > limit:
+                violations.append(
+                    f"sojourn_p99_ms: {v}ms > {limit}ms")
         elif kind == "exactly_once":
             dup_peers = {}
             for name, node in net.nodes.items():
@@ -705,7 +1112,8 @@ def run_scenario(name: str, seed: int = 7,
                    n_orderers=int(topo.get("n_orderers", 3)),
                    peer_orgs=tuple(topo.get("peer_orgs", ["Org1"])),
                    peers_per_org=int(topo.get("peers_per_org", 1)),
-                   node_factory=factory)
+                   node_factory=factory,
+                   spare_orderers=int(topo.get("spare_orderers", 0)))
     plan = build_plan(spec, seed)
     poison_sent: dict = {}
     clients = None
@@ -725,6 +1133,8 @@ def run_scenario(name: str, seed: int = 7,
     # cluster's RSS/fd/thread/GC/cache series
     ts_store = None
     ts_collector = None
+    drills: List[threading.Thread] = []
+    drill_out: Dict[str, dict] = {}
     if spec.get("observe") or any(c.get("kind") == "leak_free"
                                   for c in spec.get("expect", [])):
         from fabric_tpu.ops_plane import resources as _res
@@ -739,6 +1149,14 @@ def run_scenario(name: str, seed: int = 7,
             faults.install(plan)
         poison = (None if not spec.get("poison")
                   else _poison_thread(net, spec, poison_sent))
+
+        # -- fleet lifecycle drills (ride the load in the background) --
+        for key, launch in (("rolling_upgrade", _rolling_upgrade_thread),
+                            ("membership_churn", _membership_churn_thread),
+                            ("scale_out", _scale_out_thread)):
+            if spec.get(key):
+                drill_out[key] = {}
+                drills.append(launch(net, spec, drill_out[key]))
 
         # -- client population (identity blend over schemes) ----------
         org = list(topo.get("peer_orgs", ["Org1"]))[0]
@@ -759,10 +1177,17 @@ def run_scenario(name: str, seed: int = 7,
         ed_slots = int(round(sockets * blend.get("ed25519", 0.0)
                              / total_w))
         peer = net.peers()[0]
+        # fan-out: ONE seeded arrival process sharded across every
+        # gateway peer (slot i -> peer i mod n) — lifecycle drills need
+        # traffic ON the node being drained, not a spectator fleet
+        gw_peers = (list(net.peers()) if spec.get("fan_out")
+                    else [peer])
+        gw_addrs = [p.rpc.addr for p in gw_peers]
 
         def _factory(slot: int):
             scheme = "ed25519" if slot < ed_slots else "p256"
-            return GatewayClient(peer.rpc.addr, signers[scheme],
+            return GatewayClient(gw_addrs[slot % len(gw_addrs)],
+                                 signers[scheme],
                                  peer.msps, channel_id=net.channel_id,
                                  seed=seed * 1000 + slot,
                                  call_timeout=30.0)
@@ -817,6 +1242,10 @@ def run_scenario(name: str, seed: int = 7,
         # -- post-run drills ------------------------------------------
         if spec.get("snapshot_rejoin"):
             report["snapshot_rejoin"] = _snapshot_rejoin(net, spec)
+        for d in drills:
+            d.join(timeout=300.0)
+        for key, out_d in drill_out.items():
+            report[key] = dict(out_d)
 
         # -- post-run evidence + SLO evaluation ------------------------
         report["byzantine"] = _byz_state(net)
@@ -847,6 +1276,11 @@ def run_scenario(name: str, seed: int = 7,
                          "checks": len(spec.get("expect", [])),
                          "violations": violations}
     finally:
+        # lifecycle drills drive kill/restart on their own threads: let
+        # them finish before the net (and its tmpdir) is torn down, or
+        # teardown races a mid-restart node
+        for d in drills:
+            d.join(timeout=300.0)
         if slo_eval is not None:
             slo_eval.stop()
         if ts_collector is not None:

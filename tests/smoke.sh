@@ -64,6 +64,9 @@ python tests/smoke_snapshot.py
 echo "== byzantine scenario drills (equivocation containment + crash-stop control) =="
 python tests/smoke_scenarios.py
 
+echo "== rolling upgrade drill (drain+restart every node under load, no height regression) =="
+python tests/smoke_rolling_upgrade.py
+
 echo "== two-faced orderer drill (fraud-proof gossip, network-wide conviction) =="
 python tests/smoke_proof_gossip.py
 

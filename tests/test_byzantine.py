@@ -337,3 +337,136 @@ def test_top_byz_column_formats():
     out = _fmt_byz({"byz_quarantines": 1, "byz_reasons": ["equiv"],
                     "byz_offenses": 3})
     assert "1" in out and "equiv" in out
+
+
+# ---------------------------------------------------------------------------
+# proof-backed pardon (fleet lifecycle r18): offense quarantines decay
+# after a clean-observation window; crimes never do; every pardon is a
+# signed, persisted record that receivers independently re-verify
+
+def test_pardon_after_clean_window(tmp_path, msps, signers):
+    import time as _time
+
+    from fabric_tpu.byzantine import verify_pardon_strict
+    q = QuarantineRegistry(str(tmp_path / "q.json"), score_threshold=2)
+    w = WitnessLog(str(tmp_path / "w.json"))
+    mon = ByzantineMonitor("ch", w, q, msps=msps, signer=signers[0],
+                           proof_dir=str(tmp_path / "proofs"),
+                           pardon_window_s=30.0)
+    fired = []
+    mon.on_pardon = fired.append
+
+    key = "Org1|deadbeef"
+    q.offense(key, "garbage_frame")
+    q.offense(key, "garbage_frame")
+    assert q.is_quarantined(key)
+
+    # window not elapsed: still convicted
+    assert mon.maybe_pardon(now=_time.time()) == []
+    assert q.is_quarantined(key)
+
+    records = mon.maybe_pardon(now=_time.time() + 60.0)
+    assert [r["pardoned"] for r in records] == [key]
+    assert not q.is_quarantined(key)
+    assert fired == records              # gossip hook fired once
+    # the record is a signed artifact receivers can re-verify
+    ok, why = verify_pardon_strict(records[0], msps)
+    assert ok and why == "verified"
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "proofs"), "pardon_00000.json"))
+    # idempotent: nothing left to pardon
+    assert mon.maybe_pardon(now=_time.time() + 120.0) == []
+
+
+def test_crime_convictions_never_decay(tmp_path, msps, signers):
+    from fabric_tpu.byzantine import build_pardon, verify_pardon_strict
+    q = QuarantineRegistry(str(tmp_path / "q.json"))
+    w = WitnessLog(str(tmp_path / "w.json"))
+    mon = ByzantineMonitor("ch", w, q, msps=msps, signer=signers[0],
+                           proof_dir=str(tmp_path / "proofs"),
+                           pardon_window_s=0.0)
+    key = _binding(signers[1])
+    q.quarantine(key, "equivocation")
+    # never eligible, however long the clean window
+    assert q.pardonable_keys(0.0) == []
+    assert not q.pardon(key)
+    assert q.is_quarantined(key)
+    assert mon.maybe_pardon(now=1e18) == []
+    # even a VALIDLY SIGNED pardon naming a crime is rejected by
+    # construction — a pardon can never launder an equivocation
+    forged = build_pardon("ch", key, "equivocation", 5.0, 0.0,
+                          signers[0])
+    ok, why = verify_pardon_strict(forged, msps)
+    assert not ok and why == "crime_never_decays"
+    assert mon.accept_remote_pardon(forged) == "rejected"
+    assert q.is_quarantined(key)
+
+
+def test_remote_pardon_verdicts(tmp_path, msps, signers):
+    from fabric_tpu.byzantine import build_pardon
+    q = QuarantineRegistry(str(tmp_path / "q.json"), score_threshold=1)
+    w = WitnessLog(str(tmp_path / "w.json"))
+    mon = ByzantineMonitor("ch", w, q, msps=msps, signer=signers[0],
+                           proof_dir=str(tmp_path / "proofs"))
+    key = "Org9|cafe"
+    q.offense(key, "bad_block_sig")
+    assert q.is_quarantined(key)
+
+    pardon = build_pardon("ch", key, "poison", 5.0, 0.0, signers[1])
+    # tampering with any field breaks the issuer's signature
+    assert mon.accept_remote_pardon(
+        dict(pardon, pardoned="Org9|beef")) == "rejected"
+    assert q.is_quarantined(key)
+    assert mon.accept_remote_pardon(pardon, relay="osn2") == "pardoned"
+    assert not q.is_quarantined(key)
+    # a re-gossiped copy is a no-op, not a fresh restoration
+    assert mon.accept_remote_pardon(pardon) == "duplicate"
+
+
+def test_pardons_reload_across_restart(tmp_path, msps, signers):
+    import time as _time
+    q = QuarantineRegistry(str(tmp_path / "q.json"), score_threshold=1)
+    w = WitnessLog(str(tmp_path / "w.json"))
+    mon = ByzantineMonitor("ch", w, q, msps=msps, signer=signers[0],
+                           proof_dir=str(tmp_path / "proofs"),
+                           pardon_window_s=1.0)
+    key = "Org3|feed"
+    q.offense(key, "garbage_frame")
+    records = mon.maybe_pardon(now=_time.time() + 10.0)
+    assert len(records) == 1
+
+    # restart: fresh registry + monitor over the same state dirs
+    q2 = QuarantineRegistry(str(tmp_path / "q.json"), score_threshold=1)
+    assert not q2.is_quarantined(key)
+    assert q2.pardon_count() == 1
+    w2 = WitnessLog(str(tmp_path / "w.json"))
+    mon2 = ByzantineMonitor("ch", w2, q2, msps=msps, signer=signers[0],
+                            proof_dir=str(tmp_path / "proofs"),
+                            pardon_window_s=1.0)
+    assert [p["pardoned"] for p in mon2.pardons] == [key]
+    # the sequence continues instead of overwriting pardon_00000.json
+    q2.offense(key, "garbage_frame")
+    mon2.maybe_pardon(now=_time.time() + 10.0)
+    assert os.path.exists(
+        os.path.join(str(tmp_path / "proofs"), "pardon_00001.json"))
+
+
+def test_on_committed_drives_pardon_and_decay(tmp_path, msps, signers):
+    q = QuarantineRegistry(str(tmp_path / "q.json"), score_threshold=3)
+    w = WitnessLog(str(tmp_path / "w.json"))
+    mon = ByzantineMonitor("ch", w, q, msps=msps, signer=signers[0],
+                           proof_dir=str(tmp_path / "proofs"),
+                           pardon_window_s=0.0)
+    convicted, scored = "OrgA|aa", "OrgB|bb"
+    for _ in range(3):
+        q.offense(convicted, "garbage_frame")
+    q.offense(scored, "garbage_frame")
+    assert q.is_quarantined(convicted)
+    assert q.snapshot()[scored]["score"] == 1
+
+    # the commit hook is the pardon clock: each committed block gives
+    # eligible identities their standing back and decays sub-threshold
+    # scores of everyone who stayed clean for the window
+    mon.on_committed(7)
+    assert not q.is_quarantined(convicted)
+    assert q.snapshot()[scored]["score"] == 0

@@ -169,3 +169,95 @@ def test_raftnode_signs_every_local_append(msps, signers):
         ident_ok = signers[0].verify(
             entry_signed_bytes(e.term, e.index, e.data, e.kind), e.sig)
         assert ident_ok
+
+
+# ---------------------------------------------------------------------------
+# dynamic membership (fleet lifecycle r18): the verifier follows the
+# committed consenter set, and the persisted set survives restarts
+
+def test_reconfig_retires_consenter_rejects_its_entries(msps, signers):
+    """From the commit point of a remove-consenter config entry forward,
+    the retired consenter's proposals fail the binding check — including
+    byte-identical retransmits of entries it signed BEFORE the reconfig
+    (set_consenters clears the proposer cache, so the stale identity
+    cannot keep vouching)."""
+    v = _verifier(msps, signers)
+    pre = _entry(signers[2], 1, 1, b"pre-reconfig")
+    ok, why, _ = v.check([pre])
+    assert ok and why is None
+
+    # the remove commits: consenter 3 is out of the set
+    v.set_consenters({i + 1: (s.mspid, cert_fingerprint(s.cert))
+                      for i, s in enumerate(signers[:2])})
+    ok, why, _ = v.check([_entry(signers[2], 1, 2, b"post-reconfig")])
+    assert not ok and why == "bad_proposer"
+    ok, why, _ = v.check([pre])         # retransmit of the old entry
+    assert not ok and why == "bad_proposer"
+    # surviving consenters are untouched
+    ok, why, _ = v.check([_entry(signers[0], 1, 2, b"post-reconfig")])
+    assert ok and why is None
+
+
+def test_equivocation_evidence_survives_reconfig(msps, signers):
+    """The (term, index, binding) slot cache outlives membership churn:
+    a consenter that equivocates, gets removed, and is later re-admitted
+    is still convicted against its pre-reconfig payload."""
+    full = {i + 1: (s.mspid, cert_fingerprint(s.cert))
+            for i, s in enumerate(signers)}
+    v = _verifier(msps, signers)
+    ok, _, _ = v.check([_entry(signers[2], 1, 1, b"payload-a")])
+    assert ok
+    v.set_consenters({k: full[k] for k in (1, 2)})       # removed...
+    v.set_consenters(full)                               # ...re-admitted
+    ok, why, crimes = v.check([_entry(signers[2], 1, 1, b"payload-b")])
+    assert not ok and why == "entry_equivocation"
+    assert crimes and crimes[0]["kind"] == "raft_entry_equivocation"
+
+
+def test_membership_json_restart_prefers_persisted_set(tmp_path):
+    """A node restarting mid-churn reloads the POST-reconfig consenter
+    map from membership.json, not the genesis/channel-config set; only
+    when no reconfig ever committed does the channel config apply."""
+    import os
+    from types import SimpleNamespace
+
+    from fabric_tpu.node.orderer import OrdererNode
+
+    members = {
+        1: {"raft_id": 1, "host": "127.0.0.1", "port": 7101,
+            "mspid": "OrdererOrg", "cert_fp": "fp1"},
+        4: {"raft_id": 4, "host": "127.0.0.1", "port": 7104,
+            "mspid": "OrdererOrg", "cert_fp": "fp4"},
+    }
+    stub = SimpleNamespace(_membership={"ch": members},
+                           data_dir=str(tmp_path),
+                           cfg={"cluster": []}, raft_id=1)
+    ch_dir = os.path.join(str(tmp_path), "ch")
+    os.makedirs(ch_dir)
+    OrdererNode._persist_membership(stub, "ch")
+
+    genesis = SimpleNamespace(consenters=[
+        {"raft_id": 1, "host": "127.0.0.1", "port": 7101,
+         "mspid": "OrdererOrg", "cert_fp": "fp1"},
+        {"raft_id": 2, "host": "127.0.0.1", "port": 7102,
+         "mspid": "OrdererOrg", "cert_fp": "fp2"},
+        {"raft_id": 3, "host": "127.0.0.1", "port": 7103,
+         "mspid": "OrdererOrg", "cert_fp": "fp3"},
+    ])
+    # the persisted post-reconfig set wins over the bootstrap list
+    loaded = OrdererNode._load_membership(stub, ch_dir, genesis)
+    assert sorted(loaded) == [1, 4]
+    assert loaded[4]["port"] == 7104
+
+    # a channel that never reconfigured falls back to the channel config
+    fresh_dir = os.path.join(str(tmp_path), "fresh")
+    os.makedirs(fresh_dir)
+    loaded = OrdererNode._load_membership(stub, fresh_dir, genesis)
+    assert sorted(loaded) == [1, 2, 3]
+
+    # the three derived views agree with the persisted set
+    ids, consenters, peers = OrdererNode._membership_maps(
+        stub, {int(k): v for k, v in members.items()})
+    assert ids == [1, 4]
+    assert consenters[4] == ("OrdererOrg", "fp4")
+    assert 1 not in peers and peers[4] == ("127.0.0.1", 7104)

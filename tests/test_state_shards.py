@@ -356,6 +356,59 @@ def test_serve_chunk_rejects_traversal_and_unknown_db(tmp_path, org):
         snapshot.serve_chunk(src, "state", 99999, ent["file"], 0)
 
 
+def test_snapshot_fetch_survives_concurrent_checkpoints(tmp_path, org):
+    """A bootstrap fetch keeps serving while the source checkpoints
+    concurrently: export_meta reuses the on-disk generation instead of
+    minting one per request, and the served generation is lease-pinned
+    so checkpoint GC (which otherwise retains only {gen, gen-1}) cannot
+    delete it mid-fetch."""
+    src_root = str(tmp_path / "src")
+    src = KVLedger("ch", LedgerConfig(root=src_root, snapshot_every=100,
+                                      state_shards=4))
+    _commit_all(src, _endorser_envs(org, n_blocks=4))
+    meta = snapshot.export_meta(src)
+    assert len(meta["files"]) >= 2
+
+    # a second meta request while nothing changed serves the SAME
+    # generation — N concurrent bootstrappers share one snapshot
+    meta2 = snapshot.export_meta(src)
+    assert meta2["state_manifest"]["gen"] == meta["state_manifest"]["gen"]
+
+    # fetch with TWO forced checkpoints landing mid-flight (two fresh
+    # generations: without the pin, {gen, gen-1} retention would have
+    # deleted the generation being fetched after the second one)
+    forced_gen = None
+    payloads = {"state": [], "history": []}
+    for i, ent in enumerate(meta["files"]):
+        if i == 1:
+            for _ in range(2):
+                _commit_all(src, _endorser_envs(org, n_blocks=1,
+                                                txs_per_block=3))
+                forced_gen = int(src.snapshot_export()[0]["gen"])
+            assert forced_gen > int(meta["state_manifest"]["gen"])
+        buf = bytearray()
+        while True:
+            resp = snapshot.serve_chunk(src, ent["db"], ent["gen"],
+                                        ent["file"], len(buf))
+            buf += resp["data"]
+            if resp["eof"]:
+                break
+        assert hashlib.sha256(bytes(buf)).hexdigest() == ent["sha256"]
+        payloads[ent["db"]].append(bytes(buf))
+
+    # a NEW meta request after the checkpoints serves the new tip
+    meta3 = snapshot.export_meta(src)
+    assert int(meta3["state_manifest"]["gen"]) == forced_gen
+
+    # the stale-but-consistent snapshot still installs; the joiner just
+    # joins lower and tail-replays the post-snapshot blocks to tip
+    dst_root = str(tmp_path / "dst")
+    snapshot.install(dst_root, "ch", meta, payloads)
+    dst = KVLedger("ch", LedgerConfig(root=dst_root, state_shards=4))
+    assert dst.height == meta["height"]
+    assert dst.commit_hash == meta["commit_hash"]
+
+
 def test_needs_bootstrap_only_on_virgin_dirs(tmp_path, org):
     root = str(tmp_path / "lg")
     assert snapshot.needs_bootstrap(root, "ch")

@@ -393,7 +393,8 @@ def test_trace_arrivals_bare_numbers_and_empty(tmp_path):
 _EXPECT_KINDS = {"converged", "zero_quarantines", "quarantine",
                  "fraud_proofs", "min_committed", "max_shed_frac",
                  "exactly_once", "p99_ms", "snapshot_rejoin",
-                 "leak_free"}
+                 "leak_free", "rolling_upgrade", "no_height_regression",
+                 "membership_churn", "scale_out", "sojourn_p99_ms"}
 
 
 def test_scenario_catalog_is_wellformed():
@@ -403,7 +404,8 @@ def test_scenario_catalog_is_wellformed():
     for required in ("geo-wan", "equivocation", "two-faced",
                      "gossip-poison", "tampered-attestation",
                      "mixed-identity", "burst-partition",
-                     "snapshot-under-adversary"):
+                     "snapshot-under-adversary", "rolling-upgrade",
+                     "membership-churn", "elastic-scale-out"):
         assert required in names
     for name in names:
         spec = scenarios.SCENARIOS[name]
