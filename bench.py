@@ -493,6 +493,153 @@ def bench_commit_stage(n_tx: int = 300, n_blocks: int = 4) -> dict:
     return det
 
 
+def bench_wavefront(n_tx: int = 120, n_blocks: int = 12,
+                    window: int = 4, rounds: int = 5) -> dict:
+    """Cross-block wavefront (ISSUE 19 proof point): the SAME seeded
+    conflicting block stream through the commit window at depth
+    `window` (producer thread admits + wave-validates block N+1 against
+    the pending overlay while a consumer thread runs block N's
+    commit_finish -> batched apply) vs the SAME machinery at depth 1
+    (per-block: admit and finish strictly alternate, zero overlap) —
+    that pair isolates what cross-block overlap buys, with the raw
+    serial-oracle `commit` rate reported alongside for scale.  Ledgers
+    are disk-rooted so the WAL/blockstore fsyncs release the GIL — the
+    only true concurrency a 1-core box has.  Each mode runs `rounds`
+    times interleaved and the BEST round is reported (a shared-core
+    cpu-virtual box steals 30%+ run-to-run; best-of measures the
+    pipeline, not the neighbours).  Hash identity windowed == per-block
+    == serial is asserted in-bench — a throughput number from a
+    diverging pipeline would be worthless.  Envelope construction
+    (ECDSA signing) happens outside the timed region.  CAVEAT:
+    cpu-virtual — overlap fraction and the windowed/per-block ratio
+    show the pipeline is real, not what a TPU host would sustain."""
+    import queue as _queue
+    import random
+    import tempfile
+    import threading
+    import time as _time
+
+    from fabric_tpu.ledger import KVLedger, LedgerConfig
+    from fabric_tpu.msp.ca import DevOrg
+    from fabric_tpu.protocol import (KVRead, KVWrite, NsRwSet, TxFlags,
+                                     TxRwSet, Version, build,
+                                     block_header_hash)
+    from fabric_tpu.protocol.txflags import ValidationCode
+    from fabric_tpu.protocol.types import META_TXFLAGS
+
+    org = DevOrg("Org1")
+
+    def env_of(rwset):
+        return build.endorser_tx("ch", "cc", "1.0", rwset,
+                                 org.admin, [org.admin])
+
+    # conflicting stream: ~1/3 of each block re-reads keys its
+    # predecessor wrote (deferred behind the pending overlay), the rest
+    # writes fresh keys (early waves, overlappable with N-1's apply)
+    rng = random.Random(19)
+    keys = [f"w{i:02d}" for i in range(16)]
+    blocks_envs = [[env_of(TxRwSet((NsRwSet(
+        "cc", writes=(KVWrite(k, b"seed"),)),))) for k in keys]]
+    for blk in range(1, n_blocks):
+        envs = []
+        for t in range(n_tx):
+            if t % 3 == 0:
+                k = rng.choice(keys)
+                envs.append(env_of(TxRwSet((NsRwSet(
+                    "cc", reads=(KVRead(k, Version(blk - 1, 0)),),
+                    writes=(KVWrite(k, bytes([blk & 0xff])),)),))))
+            else:
+                envs.append(env_of(TxRwSet((NsRwSet(
+                    "cc", writes=(KVWrite(f"b{blk}t{t}", b"x"),)),))))
+        blocks_envs.append(envs)
+
+    def stream_blocks():
+        out, prev = [], b"\x00" * 32
+        for num, envs in enumerate(blocks_envs):
+            block = build.new_block(num, prev, envs)
+            block.metadata.items[META_TXFLAGS] = TxFlags(
+                len(envs), ValidationCode.VALID).to_bytes()
+            out.append(block)
+            prev = block_header_hash(block.header)
+        return out
+
+    total_tx = sum(len(e) for e in blocks_envs)
+
+    def run_serial(root):
+        lg = KVLedger("ch", LedgerConfig(root=root))
+        t0 = _time.perf_counter()
+        for block in stream_blocks():
+            lg.commit(block)
+        return _time.perf_counter() - t0, lg
+
+    def run_windowed(root, depth):
+        lg = KVLedger("ch", LedgerConfig(root=root, commit_window=depth))
+        tickets: "_queue.Queue" = _queue.Queue()
+        slots = threading.Semaphore(depth)
+        errors = []
+
+        def consume():
+            try:
+                while True:
+                    ticket = tickets.get()
+                    if ticket is None:
+                        return
+                    lg.commit_finish(ticket)
+                    slots.release()
+            except Exception as exc:
+                errors.append(exc)
+
+        consumer = threading.Thread(target=consume, daemon=True)
+        t0 = _time.perf_counter()
+        consumer.start()
+        for block in stream_blocks():
+            slots.acquire()
+            tickets.put(lg.commit_begin(block))
+        tickets.put(None)
+        consumer.join(timeout=120)
+        dt = _time.perf_counter() - t0
+        if errors:
+            raise errors[0]
+        return dt, lg
+
+    best = {"serial": None, "perblock": None, "windowed": None}
+    st = None
+    with tempfile.TemporaryDirectory() as tmp:
+        run_windowed(f"{tmp}/warm", window)     # page-cache/alloc warmup
+        for r in range(rounds):
+            dt_s, lg_s = run_serial(f"{tmp}/s{r}")
+            dt_1, lg_1 = run_windowed(f"{tmp}/p{r}", 1)
+            dt_w, lg_w = run_windowed(f"{tmp}/w{r}", window)
+            assert (lg_w.commit_hash == lg_s.commit_hash
+                    == lg_1.commit_hash), \
+                "windowed/per-block/serial commit divergence in bench"
+            for mode, dt in (("serial", dt_s), ("perblock", dt_1),
+                             ("windowed", dt_w)):
+                if best[mode] is None or dt < best[mode]:
+                    best[mode] = dt
+            if best["windowed"] == dt_w:
+                st = lg_w._commit_window.stats()
+    rate = {m: total_tx / dt for m, dt in best.items()}
+    return {
+        "wavefront_serial_txs_per_sec": round(rate["serial"], 1),
+        "wavefront_perblock_txs_per_sec": round(rate["perblock"], 1),
+        "wavefront_windowed_txs_per_sec": round(rate["windowed"], 1),
+        "wavefront_windowed_speedup": round(
+            rate["windowed"] / rate["perblock"], 2),
+        "wavefront_window": window,
+        "wavefront_overlap_frac": round(st["overlap_frac"], 3),
+        "wavefront_early_txs": st["early_txs"],
+        "wavefront_deferred_txs": st["deferred_txs"],
+        "wavefront_note": ("cpu-virtual: 1 shared core — overlap_frac "
+                           "proves validate/apply pipelining is live "
+                           "(fsync is the only GIL-free span to hide "
+                           "under); speedup is windowed vs per-block "
+                           "through the same window machinery, best of "
+                           "%d interleaved rounds, and is not a "
+                           "TPU-host number" % rounds),
+    }
+
+
 def bench_state_stage(n_keys: int = 1_000_000) -> dict:
     """Sharded state plane (ISSUE r12 proof point): batched-apply
     throughput flat (n_shards=1) vs sharded (n_shards=8) over the SAME
@@ -1169,6 +1316,17 @@ def main():
             detail.update(bench_commit_stage(n_tx=commit_tx))
         except Exception as exc:
             detail["commit_stage_error"] = str(exc)[:200]
+
+    # -- cross-block wavefront: windowed pipeline vs per-block commit --------
+    # (ISSUE 19 proof point: same conflicting stream, hash identity
+    # asserted in-bench, cross-block overlap fraction reported.  Pure
+    # host work — honest on any box; ratio caveated cpu-virtual.)
+    if os.environ.get("BENCH_SKIP_WAVEFRONT") != "1":
+        try:
+            wf_tx = int(os.environ.get("BENCH_WAVEFRONT_TXS", "120"))
+            detail.update(bench_wavefront(n_tx=wf_tx))
+        except Exception as exc:
+            detail["wavefront_error"] = str(exc)[:200]
 
     # -- sharded state plane: apply throughput + recovery-time shape ---------
     # (ISSUE r12 proof point: flat vs 8-shard batched apply on the same

@@ -9,8 +9,9 @@ for transactions that are already doomed by a preceding same-block write.
 """
 
 from .earlyabort import EarlyAbortAnalyzer
-from .graph import ConflictGraph, TxFootprint, footprint_of
-from .scheduler import ParallelCommitScheduler
+from .graph import ConflictGraph, PendingOverlay, TxFootprint, footprint_of
+from .scheduler import CommitWindow, ParallelCommitScheduler, WindowEntry
 
-__all__ = ["ConflictGraph", "TxFootprint", "footprint_of",
-           "ParallelCommitScheduler", "EarlyAbortAnalyzer"]
+__all__ = ["ConflictGraph", "PendingOverlay", "TxFootprint",
+           "footprint_of", "ParallelCommitScheduler", "CommitWindow",
+           "WindowEntry", "EarlyAbortAnalyzer"]

@@ -38,11 +38,16 @@ Scope guards (all conservative — any doubt means "doom nothing"):
   - range queries over intervals touched by any preceding in-block
     write are never doomed (interval phantoms then depend on which
     writers land);
-  - the committed version must be exactly the pre-block state:
-    statedb.savepoint == block_num - 1, which holds under the standard
-    Committer.store_block driver (validate runs strictly after the
-    previous block's state commit).  A pipelined driver that begins
-    block N+1 before block N's state lands fails the guard and gets no
+  - the committed version must be accounted for exactly.  Serially that
+    means statedb.savepoint == block_num - 1 (validate runs strictly
+    after the previous block's state commit).  Under the pipelined
+    commit window the savepoint may lag anywhere in [N-W, N-1]; the
+    guard then accepts a PendingOverlay covering every block of the gap
+    (the window's frozen in-flight write set), and any key or interval
+    the overlay touches is judged UNCERTAIN — the observable version
+    depends on writes that are still in flight — which suppresses both
+    the certainly-passes and the certainly-fails verdicts for it.  A
+    gap the overlay does not fully cover fails the guard and gets no
     early aborts for that block — never a wrong flag.
 
 Consensus note: the final flag byte of a doomed tx is MVCC_READ_CONFLICT
@@ -56,7 +61,7 @@ must be configured uniformly across peers of a channel (README
 
 from __future__ import annotations
 
-from typing import Dict, Optional, Set, Tuple
+from typing import Callable, Dict, Optional, Set, Tuple
 
 from fabric_tpu.protocol import Envelope
 from fabric_tpu.protocol.txflags import ValidationCode
@@ -64,22 +69,52 @@ from fabric_tpu.protocol.txflags import ValidationCode
 from fabric_tpu.ledger.mvcc import _validate_range_query, parse_endorser_tx
 from fabric_tpu.ledger.statedb import StateDB, UpdateBatch
 
+from .graph import PendingOverlay
+
 
 class EarlyAbortAnalyzer:
-    """Bound to one channel's state DB; stateless across blocks."""
+    """Bound to one channel's state DB; stateless across blocks.
 
-    def __init__(self, statedb: StateDB, channel_id: str = ""):
+    `overlay_source` (e.g. KVLedger.pending_overlay on a windowed
+    ledger) supplies the in-flight write-set snapshot that lets dooming
+    keep working while the savepoint lags mid-window; without one the
+    analyzer falls back to the strict savepoint == block-1 guard."""
+
+    def __init__(self, statedb: StateDB, channel_id: str = "",
+                 overlay_source: Optional[
+                     Callable[[], Optional[PendingOverlay]]] = None):
         self.statedb = statedb
         self.channel_id = channel_id
+        self.overlay_source = overlay_source
 
-    def doomed(self, block) -> Dict[int, ValidationCode]:
+    def doomed(self, block,
+               overlay: Optional[PendingOverlay] = None
+               ) -> Dict[int, ValidationCode]:
         """tx_num -> MVCC_READ_CONFLICT for txs that cannot win MVCC.
         Empty when the savepoint guard fails (see module docstring)."""
         db = self.statedb
         blk = int(block.header.number)
+        if overlay is None and self.overlay_source is not None:
+            try:
+                overlay = self.overlay_source()
+            except Exception:
+                overlay = None
+        # snapshot the overlay BEFORE reading the savepoint: retirement
+        # applies a block and only then pops it, so a savepoint read
+        # second can only have advanced — the overlay stays a superset
+        # of the real gap and the guard stays conservative
         sp = db.savepoint
-        if (-1 if sp is None else sp) != blk - 1:
-            return {}
+        sp = -1 if sp is None else sp
+        if sp != blk - 1:
+            if (overlay is None
+                    or not overlay.covers(sp + 1, blk - 1)
+                    or any(b >= blk for b in overlay.blocks)):
+                return {}
+        pending = overlay.keys if overlay is not None else frozenset()
+
+        def pending_interval(ns2: str, start2: str, end2: str) -> bool:
+            return (overlay is not None
+                    and overlay.touches_interval(ns2, start2, end2))
 
         doomed: Dict[int, ValidationCode] = {}
         puts: Dict[Tuple[str, str], Set[Tuple[int, int]]] = {}
@@ -112,6 +147,12 @@ class EarlyAbortAnalyzer:
                 ns = ns_rw.namespace
                 for read in ns_rw.reads:
                     k = (ns, read.key)
+                    if k in pending:
+                        # an in-flight predecessor writes this key: the
+                        # observable version depends on a write that has
+                        # not landed — could pass, could fail first
+                        read_unc = True
+                        continue
                     v = read.version
                     vt = None if v is None else (v.block_num, v.tx_num)
                     touched = k in deleted or k in puts
@@ -135,6 +176,9 @@ class EarlyAbortAnalyzer:
                            and (not end or k2 < end)
                            for ns2, k2 in touched_keys):
                         range_unc = True  # interval touched: undecidable
+                        continue
+                    if pending_interval(ns, start, end):
+                        range_unc = True  # in-flight write in interval
                         continue
                     # untouched interval: the oracle's merged range IS
                     # the committed range — replay decides the verdict

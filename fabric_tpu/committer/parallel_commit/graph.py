@@ -15,14 +15,32 @@ is a DAG by construction; `level[j] = 1 + max(level[preds])` partitions
 the block into waves — every transaction in a wave is independent of
 every other, and all of a transaction's conflicting predecessors sit in
 strictly earlier waves.
+
+Cross-block extension (the commit window): a `PendingOverlay` freezes
+the write keys of blocks that are admitted to the pipelined commit
+window but whose state apply has not landed yet.  Building block N+1's
+graph against that overlay adds virtual edges from the pending blocks
+into N+1: a pending write feeding one of N+1's reads (cross-block wr)
+or falling inside one of its scanned intervals (cross-block range)
+makes the tx's verdict depend on state that is still in flight, so the
+tx — and transitively everything ordered after it — is DEFERRED until
+the overlay retires.  Cross-block ww hits are counted but never defer:
+retirement is strictly in order, so same-key writes serialize at apply
+time regardless of when the later block validated.
 """
 
 from __future__ import annotations
 
+import bisect
 from dataclasses import dataclass, field
-from typing import Dict, List, Sequence, Set, Tuple
+from typing import Dict, FrozenSet, Iterable, List, Optional, Sequence, \
+    Set, Tuple
 
 EDGE_KINDS = ("ww", "wr", "rw", "range")
+# cross-block edge kinds: an in-flight predecessor block's pending write
+# vs this block's footprint (wr = feeds a read, range = lands inside a
+# scanned interval — both defer; ww = same-key write, informational)
+XBLOCK_KINDS = ("xww", "xwr", "xrange")
 
 
 @dataclass
@@ -54,19 +72,127 @@ def _in_interval(key: str, start_key: str, end_key: str) -> bool:
     return key >= start_key and (not end_key or key < end_key)
 
 
+class PendingOverlay:
+    """Frozen write-key snapshot of the commit window's in-flight blocks.
+
+    `blocks` are the block numbers admitted to the window whose state
+    apply has not retired yet; `keys` is the UNION of their (ns, key)
+    write sets, taken as a SUPERSET: every write of every tx still
+    flagged valid at admit time is included, even ones that later lose
+    MVCC — over-inclusion only defers more and is always safe, while a
+    missed key could let a dependent tx validate against stale state.
+    The snapshot is immutable: the window re-snapshots per admit."""
+
+    __slots__ = ("blocks", "keys", "_by_ns")
+
+    def __init__(self, blocks: Iterable[int],
+                 keys: Iterable[Tuple[str, str]]):
+        self.blocks: Tuple[int, ...] = tuple(sorted(int(b) for b in blocks))
+        self.keys: FrozenSet[Tuple[str, str]] = frozenset(keys)
+        by_ns: Dict[str, List[str]] = {}
+        for ns, key in self.keys:
+            by_ns.setdefault(ns, []).append(key)
+        self._by_ns = {ns: sorted(ks) for ns, ks in by_ns.items()}
+
+    @property
+    def empty(self) -> bool:
+        return not self.blocks
+
+    def covers(self, lo: int, hi: int) -> bool:
+        """True when every block of [lo, hi] is represented (the early-
+        abort analyzer's guard: the gap between the state savepoint and
+        the block under analysis must be exactly the in-flight set)."""
+        return set(range(lo, hi + 1)) <= set(self.blocks)
+
+    def touches_interval(self, ns: str, start_key: str,
+                         end_key: str) -> bool:
+        """Any pending write inside [start_key, end_key) of `ns`
+        (mvcc._merged_range interval semantics)."""
+        ks = self._by_ns.get(ns)
+        if not ks:
+            return False
+        i = bisect.bisect_left(ks, start_key)
+        return i < len(ks) and (not end_key or ks[i] < end_key)
+
+    def conflicts(self, fp: TxFootprint) -> Optional[str]:
+        """First DEFERRING cross-block hazard for `fp`, or None: "xwr"
+        (a pending write feeds one of fp's reads — the observed version
+        depends on whether/when the overlay lands) or "xrange" (a
+        pending write lands inside a scanned interval — phantom verdict
+        depends on the overlay).  Write-write overlap is NOT a deferral
+        hazard — see module docstring."""
+        for k in fp.reads:
+            if k in self.keys:
+                return "xwr"
+        for ns, start_key, end_key in fp.ranges:
+            if self.touches_interval(ns, start_key, end_key):
+                return "xrange"
+        return None
+
+    def ww_hits(self, fp: TxFootprint) -> int:
+        return sum(1 for k in fp.writes if k in self.keys)
+
+
 class ConflictGraph:
     """Built once per block from the participating tx footprints
     (block order).  Exposes `preds` (tx_num -> conflicting lower
     tx_nums), `waves` (lists of tx_nums, block-ordered within each
-    wave), and per-kind deduplicated `edge_counts`."""
+    wave), and per-kind deduplicated `edge_counts`.
 
-    def __init__(self, footprints: Sequence[TxFootprint]):
+    With `overlay` set (pipelined commit window), also computes
+    `deferred`: txs with a cross-block wr/range edge into the overlay,
+    closed transitively over in-block successors — an early (non-
+    deferred) tx therefore has ONLY early predecessors, so the early
+    waves are a self-contained prefix projection that validates
+    identically before or after the overlay's apply lands."""
+
+    def __init__(self, footprints: Sequence[TxFootprint],
+                 overlay: Optional[PendingOverlay] = None):
         self.preds: Dict[int, Set[int]] = {fp.tx_num: set()
                                            for fp in footprints}
         self.edge_counts: Dict[str, int] = {k: 0 for k in EDGE_KINDS}
+        self.xblock_counts: Dict[str, int] = {k: 0 for k in XBLOCK_KINDS}
+        self.deferred: Set[int] = set()
         self._seen_pairs: Set[Tuple[int, int]] = set()
         self._build(footprints)
         self.waves: List[List[int]] = self._level(footprints)
+        if overlay is not None and not overlay.empty:
+            self._cross_block(footprints, overlay)
+
+    def _cross_block(self, footprints: Sequence[TxFootprint],
+                     overlay: PendingOverlay) -> None:
+        # direct hits, then transitive closure over preds: footprints
+        # arrive in block order and edges point low -> high, so one
+        # forward pass resolves every predecessor before its successors
+        for fp in footprints:
+            kind = overlay.conflicts(fp)
+            if kind is not None:
+                self.xblock_counts[kind] += 1
+                self.deferred.add(fp.tx_num)
+            ww = overlay.ww_hits(fp)
+            if ww:
+                self.xblock_counts["xww"] += ww
+        for fp in footprints:
+            if fp.tx_num in self.deferred:
+                continue
+            if any(p in self.deferred for p in self.preds[fp.tx_num]):
+                self.deferred.add(fp.tx_num)
+
+    def split_waves(self) -> Tuple[List[List[int]], List[List[int]]]:
+        """(early_waves, deferred_waves), each preserving wave level
+        order and in-wave block order.  Early waves may validate while
+        the overlay's apply is still in flight; deferred waves run only
+        after every in-flight predecessor block retires."""
+        early: List[List[int]] = []
+        late: List[List[int]] = []
+        for wave in self.waves:
+            e = [t for t in wave if t not in self.deferred]
+            d = [t for t in wave if t in self.deferred]
+            if e:
+                early.append(e)
+            if d:
+                late.append(d)
+        return early, late
 
     def _edge(self, a: int, b: int, kind: str) -> None:
         if a == b:

@@ -297,8 +297,29 @@ class TxValidator:
         pure optimization; the MVCC pass remains authoritative."""
         if self.early_abort is None:
             return None
+        # fetch the pending-window overlay ONCE here so dooming and the
+        # mid-window accounting below judge the same frozen snapshot (a
+        # pipelined driver validates block N+1 while N's apply is still
+        # in flight; the analyzer needs the overlay to keep dooming
+        # across the savepoint gap — see earlyabort.py guard notes)
+        overlay = None
+        src = getattr(self.early_abort, "overlay_source", None)
+        if src is not None:
+            try:
+                overlay = src()
+            except Exception:
+                overlay = None
+        if overlay is not None and not overlay.empty:
+            try:
+                from fabric_tpu.ops_plane import registry
+                registry.counter(
+                    "validator_midwindow_blocks_total",
+                    "blocks validated while commit-window predecessors "
+                    "were still in flight").add(1, channel=self.channel_id)
+            except Exception:
+                pass
         try:
-            doomed = self.early_abort.doomed(block)
+            doomed = self.early_abort.doomed(block, overlay=overlay)
         except Exception:
             logger.exception("early-abort analysis failed; skipping")
             return None

@@ -342,14 +342,24 @@ class PeerChannel:
                          parallel_commit=bool(pc_cfg.get("enabled", False)),
                          commit_workers=int(pc_cfg.get("max_workers", 4)),
                          commit_adaptive=bool(pc_cfg.get("adaptive", True)),
+                         commit_serial_fallback=bool(
+                             pc_cfg.get("serial_fallback", True)),
+                         # cross-block wavefront window (README
+                         # "Cross-block wavefront"): W > 0 enables the
+                         # pipelined commit_begin/commit_finish entry
+                         # points used by PipelinedCommitter drivers
+                         commit_window=int(pc_cfg.get("window", 0)),
                          device_validate=dv_on))
         early_abort = None
         if pc_cfg.get("early_abort", pc_cfg.get("enabled", False)):
             from fabric_tpu.committer.parallel_commit import (
                 EarlyAbortAnalyzer,
             )
-            early_abort = EarlyAbortAnalyzer(self.ledger.statedb,
-                                             self.channel_id)
+            # overlay_source keeps dooming sound while the pipelined
+            # window holds uncommitted predecessors (savepoint lag)
+            early_abort = EarlyAbortAnalyzer(
+                self.ledger.statedb, self.channel_id,
+                overlay_source=self.ledger.pending_overlay)
         device_validate = None
         if dv_on:
             from fabric_tpu.committer.device_validate import DeviceValidator
@@ -1311,9 +1321,26 @@ class PeerNode:
             str(body["file"]), int(body["offset"]))
 
     def _state_route(self, path, body):
-        return 200, {"channels": {
-            cid: ch.ledger.state_status()
-            for cid, ch in sorted(self.channels.items())}}
+        from fabric_tpu.ops_plane import registry
+        demotions = registry.counter(
+            "validator_device_demotions_total",
+            "device-validation demotions to the host path, by reason")
+        out = {}
+        for cid, ch in sorted(self.channels.items()):
+            st = ch.ledger.state_status()
+            by_reason = demotions.breakdown("reason", channel=cid)
+            if by_reason:
+                # policy_width called out: it is the k<=8 truth-table
+                # cap's real-world demotion rate (README "Device-
+                # resident validation")
+                st["device_validate"] = {
+                    "demotions": {r: int(n)
+                                  for r, n in sorted(by_reason.items())},
+                    "policy_width_demotions": int(
+                        by_reason.get("policy_width", 0)),
+                }
+            out[cid] = st
+        return 200, {"channels": out}
 
     def _rpc_chain_info(self, body: dict, peer_identity) -> dict:
         return self._chan(body).qscc.get_chain_info(peer_identity)

@@ -134,6 +134,20 @@ def collect_node(addr: str, timeout: float = 2.0) -> dict:
     ov = [v for _, v in
           metrics.get("pipeline_collect_under_verify_frac", ())]
     row["overlap"] = (sum(ov) / len(ov)) if ov else None
+    # fused device validation: demotions to the host path by reason,
+    # policy_width (the k<=8 truth-table cap) called out; the per-
+    # channel split lives on GET /state
+    dem = metrics.get("validator_device_demotions_total", ()) or ()
+    if dem:
+        by_reason: Dict[str, float] = {}
+        for labels, v in dem:
+            r = labels.get("reason", "?")
+            by_reason[r] = by_reason.get(r, 0.0) + v
+        row["devval_demotions"] = by_reason
+        row["devval_policy_width"] = by_reason.get("policy_width", 0.0)
+    else:
+        row["devval_demotions"] = None
+        row["devval_policy_width"] = None
     row["queue_depth"] = _sum(metrics.get("provider_dispatch_queue_depth"))
     row["breakers_open"] = _sum(metrics.get("gateway_orderer_breaker_open"))
     row["faults_fired"] = _sum(metrics.get("fault_injected_total"))
@@ -261,10 +275,11 @@ def _fmt_devices(devs) -> str:
 
 
 _COLS = ("NODE", "HT", "TX/S", "COLLECT", "DISP", "GATE", "COMMIT",
-         "OCC", "DEV", "OVLP", "VCACHE", "SPEC", "STATE", "RES", "QD",
-         "BRKR", "SHED", "FAULTS", "BYZ", "LIFE", "SLO", "HEALTH")
-_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 5, 6, 5, 11, 9, 4, 5, 9, 7, 12,
-           8, 12, 8)
+         "OCC", "DEV", "DEVVAL", "OVLP", "VCACHE", "SPEC", "STATE",
+         "RES", "QD", "BRKR", "SHED", "FAULTS", "BYZ", "LIFE", "SLO",
+         "HEALTH")
+_WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 9, 5, 6, 5, 11, 9, 4, 5, 9, 7,
+           12, 8, 12, 8)
 
 # gateway_admission_state gauge value -> short cell tag
 _ADM_SHORT = {0: "ok", 1: "EVAL", 2: "PROB", 3: "HARD"}
@@ -329,6 +344,21 @@ def _fmt_state(row: dict) -> str:
     return f"{n}sh/{k}" + ("" if ck is None else f"@{ck:.0f}")
 
 
+def _fmt_devval(row: dict) -> str:
+    """`<demotions>[pw:N]`: fused-device-validation demotions to the
+    host path, with the policy_width share (blocks demoted by the k<=8
+    truth-table cap — the cap's real-world demotion rate) called out;
+    `-` until the plane demotes (or on nodes running host MVCC only)."""
+    dem = row.get("devval_demotions")
+    if dem is None:
+        return "-"
+    cell = f"{sum(dem.values()):.0f}"
+    pw = dem.get("policy_width", 0.0)
+    if pw:
+        cell += f"[pw:{pw:.0f}]"
+    return cell
+
+
 def _fmt_res(row: dict) -> str:
     """`<RSS MB>M/<fd count>`: the resource collector's footprint cell;
     `-` on nodes that run with `resources` disabled."""
@@ -377,7 +407,7 @@ _SORT_KEYS = {
     "rate": "rate", "occupancy": "occupancy", "dev": "devices",
     "vcache": "vcache", "spec": "spec", "shed": "shed_total",
     "state": "state_keys", "byz": "byz_quarantines", "res": "rss",
-    "life": "lifecycle",
+    "life": "lifecycle", "devval": "devval_policy_width",
 }
 
 
@@ -435,6 +465,7 @@ def render(rows: List[dict], spark_name: Optional[str] = None) -> str:
             _fmt_pair(r.get("collect")), _fmt_pair(r.get("dispatch")),
             _fmt_pair(r.get("gate")), _fmt_pair(r.get("commit")),
             _fmt_pct(r.get("occupancy")), _fmt_devices(r.get("devices")),
+            _fmt_devval(r),
             _fmt_pct(r.get("overlap")),
             _fmt_pct(r.get("vcache")), _fmt_pct(r.get("spec")),
             _fmt_state(r), _fmt_res(r),

@@ -89,6 +89,22 @@ class Counter:
                 out[lv] = out.get(lv, 0.0) + v
         return out
 
+    def breakdown(self, group: str, **fixed) -> Dict[str, float]:
+        """Totals grouped by `group`'s label value, restricted to label
+        sets carrying every `fixed` label at the given value (e.g. one
+        channel's demotion counts by reason)."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            for k, v in self._values.items():
+                d = dict(k)
+                if any(d.get(fk) != fv for fk, fv in fixed.items()):
+                    continue
+                gv = d.get(group)
+                if gv is None:
+                    continue
+                out[gv] = out.get(gv, 0.0) + v
+        return out
+
     def expose(self) -> List[str]:
         out = [f"# HELP {self.name} {self.help}",
                f"# TYPE {self.name} counter"]

@@ -23,7 +23,7 @@ from fabric_tpu.workload.arrivals import (
     SquareWaveArrivals,
     from_spec,
 )
-from fabric_tpu.workload.clients import ClientPopulation
+from fabric_tpu.workload.clients import ClientPopulation, ThinkTimeModel
 from fabric_tpu.workload.keyspace import (
     Op,
     TrafficMix,
@@ -35,6 +35,7 @@ from fabric_tpu.workload.runner import PhaseStats, WorkloadRunner, pct
 __all__ = [
     "ArrivalProcess", "ClientPopulation", "ConstantArrivals",
     "DiurnalArrivals", "Op", "OpenLoopScheduler", "PhaseStats",
-    "RampArrivals", "SquareWaveArrivals", "TrafficMix", "WorkloadRunner",
-    "ZipfSampler", "expected_collision_p", "from_spec", "pct",
+    "RampArrivals", "SquareWaveArrivals", "ThinkTimeModel", "TrafficMix",
+    "WorkloadRunner", "ZipfSampler", "expected_collision_p", "from_spec",
+    "pct",
 ]

@@ -21,17 +21,86 @@ Scenarios (both seeded, both composable with any arrival process):
   stampede_schedule(n, window_s)  a cold-start burst: n arrivals
       crammed into the first window_s (uniform, seeded) — prepend to
       any schedule for the market-open profile.
+  ThinkTimeModel              per-client open-loop think time: each
+      client id gets its own seeded exponential/lognormal delay stream,
+      so a client's successive ops are spaced like a human's (bursts
+      and pauses), not like a Poisson process's — the per-client burst
+      structure is what cross-block conflict drills need to look real.
 """
 
 from __future__ import annotations
 
+import math
 import random
 import threading
 from typing import Callable, Dict, List, Optional
 
 from fabric_tpu.workload.keyspace import ZipfSampler
 
-__all__ = ["ClientPopulation"]
+__all__ = ["ClientPopulation", "ThinkTimeModel"]
+
+
+class ThinkTimeModel:
+    """Seeded per-client think-time delays.
+
+    Scenario-dict spec (WorkloadRunner phase key `think`):
+        {"kind": "exponential", "mean_s": 0.5}
+        {"kind": "lognormal", "median_s": 0.3, "sigma": 1.0}
+
+    Each client id owns an independent `random.Random` stream derived
+    from (seed, client_id), so the k-th think delay of client c is a
+    pure function of (spec, seed, c, k): re-running a scenario replays
+    the exact same per-client burst pattern regardless of how other
+    clients' draws interleave."""
+
+    KINDS = ("exponential", "lognormal")
+
+    def __init__(self, kind: str = "exponential", mean_s: float = 0.5,
+                 median_s: float = 0.3, sigma: float = 1.0,
+                 seed: int = 0):
+        if kind not in self.KINDS:
+            raise ValueError(f"unknown think-time kind {kind!r} "
+                             f"(one of {self.KINDS})")
+        self.kind = kind
+        self.mean_s = float(mean_s)
+        self.median_s = float(median_s)
+        self.sigma = float(sigma)
+        self.seed = int(seed)
+        self._streams: Dict[int, random.Random] = {}
+
+    @classmethod
+    def from_spec(cls, spec: dict, seed: int = 0) -> "ThinkTimeModel":
+        kind = str(spec.get("kind", "exponential"))
+        return cls(kind=kind,
+                   mean_s=float(spec.get("mean_s", 0.5)),
+                   median_s=float(spec.get("median_s", 0.3)),
+                   sigma=float(spec.get("sigma", 1.0)),
+                   seed=seed)
+
+    def _stream(self, client_id: int) -> random.Random:
+        rng = self._streams.get(client_id)
+        if rng is None:
+            rng = self._streams[client_id] = random.Random(
+                (self.seed * 1_000_003) ^ (int(client_id) * 2_654_435_761))
+        return rng
+
+    def delay(self, client_id: int) -> float:
+        """The client's next think delay (seconds, >= 0)."""
+        rng = self._stream(client_id)
+        if self.kind == "exponential":
+            return rng.expovariate(1.0 / self.mean_s) \
+                if self.mean_s > 0 else 0.0
+        # lognormal parameterized by its median: exp(mu) = median_s
+        mu = math.log(self.median_s) if self.median_s > 0 else 0.0
+        return rng.lognormvariate(mu, self.sigma)
+
+    def describe(self) -> dict:
+        d = {"kind": self.kind, "seed": self.seed}
+        if self.kind == "exponential":
+            d["mean_s"] = self.mean_s
+        else:
+            d.update(median_s=self.median_s, sigma=self.sigma)
+        return d
 
 
 class _ClientStats:

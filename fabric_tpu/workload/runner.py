@@ -43,7 +43,7 @@ from fabric_tpu.endorser.proposal import assemble_transaction
 from fabric_tpu.gateway.client import GatewayError, GatewayShedError
 from fabric_tpu.protocol.txflags import ValidationCode
 from fabric_tpu.workload.arrivals import OpenLoopScheduler, from_spec
-from fabric_tpu.workload.clients import ClientPopulation
+from fabric_tpu.workload.clients import ClientPopulation, ThinkTimeModel
 from fabric_tpu.workload.keyspace import Op, TrafficMix
 
 logger = logging.getLogger("fabric_tpu.workload")
@@ -93,6 +93,11 @@ class PhaseStats:
         self.wall_s = 0.0
         self.max_skew_s = 0.0
         self.backlog_max = 0
+        # per-client think-time shaping (phase key `think`): arrivals
+        # pushed past the raw schedule by the owning client's delay
+        self.think: Optional[dict] = None
+        self.think_delayed = 0
+        self.think_added_s = 0.0
 
     def report(self) -> dict:
         wall = max(self.wall_s, 1e-9)
@@ -124,6 +129,10 @@ class PhaseStats:
         }
         if self.other_codes:
             out["other_codes"] = dict(self.other_codes)
+        if self.think is not None:
+            out["think"] = dict(self.think,
+                                delayed=self.think_delayed,
+                                added_s=round(self.think_added_s, 3))
         return out
 
 
@@ -363,12 +372,46 @@ class WorkloadRunner:
                         time.sleep(min(
                             max(exc.retry_after_ms, 50) / 1000.0, 1.0))
 
+        # per-client open-loop think time (phase key `think`): pre-draw
+        # the owning client per arrival, then push each client's ops at
+        # least its think delay apart — the arrival process still sets
+        # the AGGREGATE offered load, but each client's stream turns
+        # bursty-with-pauses the way real submitters are.  The re-sort
+        # keeps (offset, op, env, client) association intact.
+        clients_for: Optional[List[int]] = None
+        if phase.get("think"):
+            model = ThinkTimeModel.from_spec(
+                phase["think"], seed=self.seed * 211 + index)
+            clients_for = [self.clients.next_client() for _ in schedule]
+            last_at: Dict[int, float] = {}
+            adjusted: List[float] = []
+            for i, t in enumerate(schedule):
+                c = clients_for[i]
+                t2 = t
+                prev = last_at.get(c)
+                if prev is not None:
+                    t2 = max(t, prev + model.delay(c))
+                    if t2 > t:
+                        stats.think_delayed += 1
+                        stats.think_added_s += t2 - t
+                last_at[c] = t2
+                adjusted.append(t2)
+            order = sorted(range(len(schedule)),
+                           key=lambda i: (adjusted[i], i))
+            schedule = [adjusted[i] for i in order]
+            ops = [ops[i] for i in order]
+            envs = [envs[i] for i in order]
+            clients_for = [clients_for[i] for i in order]
+            stats.think = model.describe()
+
         t_start = time.monotonic()
 
         def fire(i: int, offset: float) -> None:
             track = self.track_commits and i % self.commit_every == 0
+            client = (clients_for[i] if clients_for is not None
+                      else self.clients.next_client())
             job = _Job(stats, ops[i], envs[i],
-                       self.clients.next_client(), time.monotonic(),
+                       client, time.monotonic(),
                        track)
             with self._out_cv:
                 self._outstanding += 1

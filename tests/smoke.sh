@@ -52,6 +52,9 @@ python tests/smoke_mesh.py
 echo "== parallel commit probe (wavefront vs serial oracle, two-stack gate) =="
 python tests/smoke_parallel_commit.py
 
+echo "== cross-block wavefront probe (windowed pipeline vs serial, overlap gate) =="
+python tests/smoke_wavefront.py
+
 echo "== overload probe (open-loop 2x saturation, admission shed + recovery) =="
 python tests/smoke_overload.py
 

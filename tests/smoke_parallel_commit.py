@@ -84,8 +84,11 @@ def main() -> int:
     blocks = _stream(org)
 
     serial = KVLedger("ch", LedgerConfig())
+    # commit_serial_fallback=False: this probe asserts the WAVE path is
+    # live, so it must not be routed to the oracle on a 1-core host
     par = KVLedger("ch", LedgerConfig(parallel_commit=True,
-                                      commit_workers=4))
+                                      commit_workers=4,
+                                      commit_serial_fallback=False))
     h_serial = _commit_stream(serial, blocks)
     h_par = _commit_stream(par, blocks)
 

@@ -82,8 +82,12 @@ def three_way(envs, block_num=2, db_factory=seeded_db, pre=()):
             batch, history = validate_and_prepare_batch(
                 db, block_num, envs, flags)
         else:
+            # serial_fallback=False: these tests hold the WAVE path to
+            # bit-identity, so it must run even on a 1-core host (where
+            # the fallback would route every block to the oracle)
             sched = ParallelCommitScheduler(max_workers=workers,
-                                            channel_id="t")
+                                            channel_id="t",
+                                            serial_fallback=False)
             try:
                 batch, history = sched.validate_and_prepare_batch(
                     db, block_num, envs, flags)
@@ -247,7 +251,8 @@ def test_kvledger_parallel_matches_serial_commit_hash(org):
     results = []
     for parallel in (False, True):
         lg = KVLedger("ch", LedgerConfig(parallel_commit=parallel,
-                                         commit_workers=4))
+                                         commit_workers=4,
+                                         commit_serial_fallback=False))
         for envs in (b0, b1):
             prev = (lg.blockstore.chain_info().current_hash
                     if lg.height else b"\x00" * 32)
@@ -532,3 +537,514 @@ def test_adaptive_pool_tracks_rolling_wave_width():
     assert s._pool_size == 5 and pool_b is not pool_a
     assert s._executor(5) is pool_b          # stable while target holds
     s.close()
+
+
+# -- serial fallback (1-core hosts / narrow blocks) ---------------------------
+
+def _fallback_envs(org, n=4):
+    return [tx(org, rw(writes=[KVWrite(f"k{i:02d}", b"f%d" % i)]))
+            for i in range(n)]
+
+
+def test_serial_fallback_one_core_matches_oracle(org):
+    """On a forced 1-core host the scheduler must route the whole block
+    to the serial oracle (no graph, no pool) and count the fallback —
+    output still bit-identical, waves reported as 0."""
+    envs = _fallback_envs(org)
+    db_o, db_s = seeded_db(), seeded_db()
+    flags_o = TxFlags(len(envs), ValidationCode.VALID)
+    flags_s = TxFlags(len(envs), ValidationCode.VALID)
+    batch_o, hist_o = validate_and_prepare_batch(db_o, 2, envs, flags_o)
+    sched = ParallelCommitScheduler(max_workers=4, channel_id="fb1",
+                                    host_cores=1)
+    counter = registry.counter("commit_serial_fallbacks_total")
+    before = counter.value(reason="one_core", channel="fb1")
+    try:
+        batch_s, hist_s = sched.validate_and_prepare_batch(
+            db_s, 2, envs, flags_s)
+    finally:
+        sched.close()
+    assert _norm(flags_o, batch_o, hist_o) == _norm(flags_s, batch_s,
+                                                    hist_s)
+    assert sched.serial_fallbacks == 1
+    assert sched.last_waves == 0 and sched.last_max_width == 0
+    assert counter.value(reason="one_core", channel="fb1") == before + 1
+
+
+def test_serial_fallback_disabled_keeps_wave_path(org):
+    """serial_fallback=False must exercise the graph even on 1 core —
+    the differential tests' escape hatch."""
+    envs = _fallback_envs(org)
+    db = seeded_db()
+    flags = TxFlags(len(envs), ValidationCode.VALID)
+    sched = ParallelCommitScheduler(max_workers=4, channel_id="fb2",
+                                    host_cores=1, serial_fallback=False)
+    try:
+        sched.validate_and_prepare_batch(db, 2, envs, flags)
+    finally:
+        sched.close()
+    assert sched.serial_fallbacks == 0
+    assert sched.last_waves >= 1
+
+
+def test_serial_fallback_narrow_block_counted(org):
+    """A fully chained block (rolling wave width 1) on a multi-core
+    host degenerates to a serial walk — the `narrow` fallback counter
+    must say so, and output must still match the oracle."""
+    envs = [tx(org, rw(reads=[KVRead("k00", Version(1, 0) if i == 0
+                                     else Version(2, i - 1))],
+                       writes=[KVWrite("k00", b"c%d" % i)]))
+            for i in range(4)]
+    db_o, db_s = seeded_db(), seeded_db()
+    flags_o = TxFlags(len(envs), ValidationCode.VALID)
+    flags_s = TxFlags(len(envs), ValidationCode.VALID)
+    batch_o, hist_o = validate_and_prepare_batch(db_o, 2, envs, flags_o)
+    sched = ParallelCommitScheduler(max_workers=4, channel_id="fb3",
+                                    host_cores=4)
+    counter = registry.counter("commit_serial_fallbacks_total")
+    before = counter.value(reason="narrow", channel="fb3")
+    try:
+        batch_s, hist_s = sched.validate_and_prepare_batch(
+            db_s, 2, envs, flags_s)
+    finally:
+        sched.close()
+    assert _norm(flags_o, batch_o, hist_o) == _norm(flags_s, batch_s,
+                                                    hist_s)
+    assert counter.value(reason="narrow", channel="fb3") == before + 1
+
+
+# -- cross-block wavefront window ---------------------------------------------
+
+from fabric_tpu.committer.parallel_commit import (CommitWindow,  # noqa: E402
+                                                  PendingOverlay)
+from fabric_tpu.protocol.types import META_COMMIT_HASH  # noqa: E402,F401
+
+
+def _stream_serial(blocks_envs, root=None):
+    """Commit a stream of blocks through the serial oracle ledger."""
+    lg = KVLedger("ch", LedgerConfig(root=root))
+    for envs in blocks_envs:
+        prev = (lg.blockstore.chain_info().current_hash
+                if lg.height else b"\x00" * 32)
+        block = build.new_block(lg.height, prev, envs)
+        flags = TxFlags(len(envs), ValidationCode.VALID)
+        block.metadata.items[META_TXFLAGS] = flags.to_bytes()
+        lg.commit(block)
+    return lg
+
+
+def _stream_windowed(blocks_envs, W, root=None, finish_late=True):
+    """Commit the same stream via commit_begin/commit_finish with up to
+    W blocks in flight (finish only when the window fills, then drain)."""
+    from fabric_tpu.protocol import block_header_hash
+    lg = KVLedger("ch", LedgerConfig(root=root, commit_window=W))
+    tickets = []
+    for envs in blocks_envs:
+        tail = lg._commit_window.tail()
+        if tail is not None:
+            num, prev = tail.num + 1, tail.header_hash
+        else:
+            num = lg.height
+            prev = (lg.blockstore.chain_info().current_hash
+                    if lg.height else b"\x00" * 32)
+        block = build.new_block(num, prev, envs)
+        flags = TxFlags(len(envs), ValidationCode.VALID)
+        block.metadata.items[META_TXFLAGS] = flags.to_bytes()
+        tickets.append(lg.commit_begin(block))
+        if len(tickets) >= W:
+            lg.commit_finish(tickets.pop(0))
+    while tickets:
+        lg.commit_finish(tickets.pop(0))
+    return lg
+
+
+def _ledger_snapshot(lg, keys):
+    flags_per_block = [
+        lg.blockstore.get_by_number(n).metadata.items[META_TXFLAGS]
+        for n in range(lg.height)]
+    state = {k: lg.get_state("cc", k) for k in keys}
+    hist = {k: [(m.block_num, m.tx_num, m.value, m.is_delete)
+                for m in lg.get_history("cc", k)] for k in keys}
+    return (lg.commit_hash, flags_per_block, state, hist)
+
+
+def _assert_stream_identical(blocks_envs, keys, windows=(1, 2, 4)):
+    want = _ledger_snapshot(_stream_serial(blocks_envs), keys)
+    for W in windows:
+        got = _ledger_snapshot(_stream_windowed(blocks_envs, W), keys)
+        assert got == want, f"windowed W={W} diverged from serial oracle"
+    return want
+
+
+def test_window_adjacent_block_ww_wr_rw_chains(org):
+    """Adjacent-block conflict chains: N writes k, N+1 re-reads/writes
+    it (xwr -> deferred), N+1 write-write on the same key (xww -> NOT
+    deferred), N+1 read-then-write ordering — all bit-identical."""
+    b0 = [tx(org, rw(writes=[KVWrite(f"k{i}", b"v%d" % i)]))
+          for i in range(4)]
+    b1 = [
+        # xwr: reads k0 which block 1 wrote -> must defer, then WIN
+        tx(org, rw(reads=[KVRead("k0", Version(1, 0))],
+                   writes=[KVWrite("k0", b"w1")])),
+        # xww only: blind overwrite of k1 -> early, ordered by retire
+        tx(org, rw(writes=[KVWrite("k1", b"blind")])),
+        # untouched by block 1 -> early
+        tx(org, rw(writes=[KVWrite("z0", b"z")])),
+    ]
+    b2 = [
+        # rw across blocks: stale read of k0 (block 2 rewrote it) loses
+        tx(org, rw(reads=[KVRead("k0", Version(1, 0))])),
+        # fresh read of the block-2 version wins
+        tx(org, rw(reads=[KVRead("k0", Version(2, 0))],
+                   writes=[KVWrite("k0", b"w2")])),
+    ]
+    keys = [f"k{i}" for i in range(4)] + ["z0"]
+    _assert_stream_identical([b0, b1, b2], keys)
+    # white-box: W=2 must actually defer the xwr tx and keep xww early
+    lg = _stream_windowed([b0, b1, b2], 2)
+    st = lg._commit_window.stats()
+    assert st["deferred_txs"] >= 2      # b1's k0 reader + b2's k0 txs
+    assert st["early_txs"] >= 2         # b1's blind write + z0
+
+
+def test_window_cross_block_range_phantom(org):
+    """A pending write landing inside the next block's scanned interval
+    must defer the scanner, and the phantom verdict must match serial:
+    the scan re-reads committed state only after the writer lands."""
+    # block 1 inserts k25 (inside [k2, k5)); block 2 scans the interval
+    b0 = [tx(org, rw(writes=[KVWrite("k25", b"phantom")]))]
+    scan = tx(org, rw(rqs=[RangeQueryInfo(
+        "k2", "k5", True,
+        (KVRead("k2", Version(1, 2)), KVRead("k3", Version(1, 3)),
+         KVRead("k4", Version(1, 4))))],
+        writes=[KVWrite("z1", b"s")]))
+    indep = tx(org, rw(writes=[KVWrite("z2", b"i")]))
+    b1 = [scan, indep]
+
+    def db_factory():
+        return seeded_db()
+
+    # ledger-stream identity (phantom must be flagged in both worlds)
+    want = _assert_stream_identical([
+        [tx(org, rw(writes=[KVWrite(f"k{i:02d}", b"v%d" % i)]))
+         for i in range(6)],
+        b0, b1], [f"k{i:02d}" for i in range(6)] + ["k25", "z1", "z2"])
+    # white-box on the graph: the scanner defers via xrange, the
+    # independent write stays early
+    overlay = PendingOverlay([1], [("cc", "k25")])
+    parsed = _parse_envs(b1)
+    g = _graph_of(parsed, overlay)
+    assert g.xblock_counts["xrange"] == 1
+    assert 0 in g.deferred and 1 not in g.deferred
+    assert want is not None
+
+
+def _parse_envs(envs):
+    from fabric_tpu.ledger.mvcc import parse_endorser_tx
+    out = []
+    for i, e in enumerate(envs):
+        p = parse_endorser_tx(e)
+        out.append((i, p[1]))
+    return out
+
+
+def _graph_of(parsed, overlay):
+    from fabric_tpu.committer.parallel_commit.graph import (ConflictGraph,
+                                                            footprint_of)
+    return ConflictGraph([footprint_of(i, rws) for i, rws in parsed],
+                         overlay=overlay)
+
+
+def test_window_doomed_then_rewritten_key(org):
+    """A doomed tx's write still lands in the overlay (superset rule):
+    the next block's reader of that key must defer even though the
+    write never commits — and the final verdicts must match serial."""
+    b0 = [tx(org, rw(writes=[KVWrite(f"k{i:02d}", b"v%d" % i)]))
+          for i in range(4)]
+    b1 = [
+        # doomed: stale read of k00; its k50 write never lands
+        tx(org, rw(reads=[KVRead("k00", Version(9, 9))],
+                   writes=[KVWrite("k50", b"never")])),
+        # winner: rewrites k01
+        tx(org, rw(reads=[KVRead("k01", Version(1, 1))],
+                   writes=[KVWrite("k01", b"won")])),
+    ]
+    b2 = [
+        # reads k50 (nil): the DOOMED writer is still in the overlay ->
+        # defers, then validates against committed state (k50 absent)
+        tx(org, rw(reads=[KVRead("k50", None)],
+                   writes=[KVWrite("z3", b"ok")])),
+        # reads the rewritten k01 at its new version
+        tx(org, rw(reads=[KVRead("k01", Version(2, 1))])),
+    ]
+    keys = [f"k{i:02d}" for i in range(4)] + ["k50", "z3"]
+    _assert_stream_identical([b0, b1, b2], keys)
+    # white-box: the overlay carries the doomed write, so b2 tx0 defers
+    overlay = PendingOverlay([2], [("cc", "k50"), ("cc", "k01")])
+    g = _graph_of(_parse_envs(b2), overlay)
+    assert 0 in g.deferred and 1 in g.deferred
+
+
+def test_window_differential_fuzz_25_seeds(org):
+    """Seeded random block streams through {serial, W=1, W=4}: flags,
+    state, history, and commit hash bit-exact (batch insertion order is
+    held exact by the window-level fuzz below)."""
+    keys = [f"k{i:02d}" for i in range(12)]
+    for seed in range(25):
+        rng = random.Random(1000 + seed)
+        blocks = []
+        # block 0 seeds the keyspace so later reads have fresh versions
+        blocks.append([tx(org, rw(writes=[KVWrite(k, b"s%d" % i)]))
+                       for i, k in enumerate(keys[:8])])
+        for _b in range(rng.randrange(2, 5)):
+            envs = []
+            for _t in range(rng.randrange(1, 6)):
+                reads, writes, rqs = [], [], []
+                for _ in range(rng.randrange(0, 3)):
+                    k = rng.choice(keys)
+                    ver = rng.choice([Version(0, int(k[1:])),
+                                      Version(7, 7), None])
+                    reads.append(KVRead(k, ver))
+                for _ in range(rng.randrange(0, 3)):
+                    k = rng.choice(keys)
+                    if rng.random() < 0.25:
+                        writes.append(KVWrite(k, b"", True))
+                    else:
+                        writes.append(KVWrite(k, rng.randbytes(4)))
+                if rng.random() < 0.3:
+                    lo, hi = sorted(rng.sample(range(12), 2))
+                    recs = tuple(KVRead(f"k{i:02d}", Version(0, i))
+                                 for i in range(lo, min(hi, 8)))
+                    rqs.append(RangeQueryInfo(f"k{lo:02d}", f"k{hi:02d}",
+                                              rng.random() < 0.5, recs))
+                envs.append(tx(org, rw(reads=reads, writes=writes,
+                                       rqs=rqs)))
+            blocks.append(envs)
+        _assert_stream_identical(blocks, keys, windows=(1, 2, 4))
+
+
+def test_window_level_batch_insertion_order_fuzz(org):
+    """CommitWindow.admit/finish vs the serial oracle at the batch
+    level: UpdateBatch INSERTION ORDER and history tuples must be
+    literal (the _norm comparison includes items() order)."""
+    keys = [f"k{i:02d}" for i in range(10)]
+    for seed in range(10):
+        rng = random.Random(7000 + seed)
+        blocks = []
+        for _b in range(3):
+            envs = []
+            for _t in range(rng.randrange(1, 5)):
+                reads = [KVRead(rng.choice(keys),
+                                rng.choice([Version(1, 3), None]))
+                         for _ in range(rng.randrange(0, 2))]
+                writes = [KVWrite(rng.choice(keys), rng.randbytes(3))
+                          for _ in range(rng.randrange(0, 3))]
+                envs.append(tx(org, rw(reads=reads, writes=writes)))
+            blocks.append(envs)
+        # serial: oracle walk + apply per block
+        db_s = seeded_db()
+        serial_out = []
+        for num, envs in enumerate(blocks, start=2):
+            flags = TxFlags(len(envs), ValidationCode.VALID)
+            batch, hist = validate_and_prepare_batch(db_s, num, envs,
+                                                     flags)
+            serial_out.append(_norm(flags, batch, hist))
+            db_s.apply_updates(batch, num)
+        # windowed: admit all (W=3), then finish in order
+        db_w = seeded_db()
+        window = CommitWindow(channel_id="t", max_window=3)
+        entries = []
+        for num, envs in enumerate(blocks, start=2):
+            flags = TxFlags(len(envs), ValidationCode.VALID)
+            entries.append(window.admit(db_w, num, b"h%d" % num,
+                                        envs, flags))
+        for entry in entries:
+            batch, hist = window.finish(db_w, entry)
+            assert _norm(entry.flags, batch,
+                         hist) == serial_out[entry.num - 2], \
+                f"seed {seed} block {entry.num} diverged"
+            window.apply_started()
+            db_w.apply_updates(batch, entry.num)
+            window.apply_ended()
+            window.retire(entry)
+
+
+def test_window_ordering_and_depth_guards(org):
+    """The window enforces chain order, head-only finish, and depth."""
+    b = [tx(org, rw(writes=[KVWrite("k0", b"x")]))]
+    lg = KVLedger("ch", LedgerConfig(commit_window=2))
+    prev = b"\x00" * 32
+    block0 = build.new_block(0, prev, b)
+    flags = TxFlags(1, ValidationCode.VALID)
+    block0.metadata.items[META_TXFLAGS] = flags.to_bytes()
+    t0 = lg.commit_begin(block0)
+    # wrong number refused
+    bad = build.new_block(5, prev, b)
+    bad.metadata.items[META_TXFLAGS] = flags.to_bytes()
+    with pytest.raises(ValueError, match="out-of-order"):
+        lg.commit_begin(bad)
+    # serial commit refused while the window holds blocks
+    with pytest.raises(RuntimeError, match="pipelined window"):
+        lg.commit(bad)
+    from fabric_tpu.protocol import block_header_hash
+    block1 = build.new_block(1, block_header_hash(block0.header), b)
+    block1.metadata.items[META_TXFLAGS] = flags.to_bytes()
+    t1 = lg.commit_begin(block1)
+    # window full at depth 2
+    block2 = build.new_block(2, block_header_hash(block1.header), b)
+    block2.metadata.items[META_TXFLAGS] = flags.to_bytes()
+    with pytest.raises(RuntimeError, match="window full"):
+        lg.commit_begin(block2)
+    # head-only finish
+    with pytest.raises(RuntimeError, match="out of order"):
+        lg.commit_finish(t1)
+    lg.commit_finish(t0)
+    lg.commit_finish(t1)
+    assert lg.height == 2 and lg._commit_window.depth() == 0
+
+
+def test_window_crash_recovery_replays_exactly_once(org, tmp_path):
+    """Crash mid-window: finished blocks are durable, admitted-but-
+    unfinished blocks never reached the block store — reopening replays
+    nothing twice, and re-delivering the dropped blocks serially lands
+    the stream bit-identical to an all-serial ledger."""
+    blocks_envs = [
+        [tx(org, rw(writes=[KVWrite(f"k{i}", b"v%d" % i)]))
+         for i in range(4)],
+        [tx(org, rw(reads=[KVRead("k0", Version(1, 0))],
+                    writes=[KVWrite("k0", b"w")]))],
+        [tx(org, rw(writes=[KVWrite("z0", b"z")]))],
+        [tx(org, rw(reads=[KVRead("z0", Version(3, 0))],
+                    writes=[KVWrite("z1", b"zz")]))],
+    ]
+    keys = [f"k{i}" for i in range(4)] + ["z0", "z1"]
+    want = _ledger_snapshot(_stream_serial(blocks_envs), keys)
+
+    from fabric_tpu.protocol import block_header_hash
+    root = str(tmp_path / "wcrash")
+    lg = KVLedger("ch", LedgerConfig(root=root, commit_window=4))
+    tickets, blocks = [], []
+    for envs in blocks_envs:
+        tail = lg._commit_window.tail()
+        if tail is not None:
+            num, prev = tail.num + 1, tail.header_hash
+        else:
+            num, prev = lg.height, b"\x00" * 32
+        block = build.new_block(num, prev, envs)
+        flags = TxFlags(len(envs), ValidationCode.VALID)
+        block.metadata.items[META_TXFLAGS] = flags.to_bytes()
+        tickets.append(lg.commit_begin(block))
+        blocks.append(block)
+    # finish only the first two, then "crash" (drop the window)
+    lg.commit_finish(tickets[0])
+    lg.commit_finish(tickets[1])
+    assert lg.abort_window() == 2
+
+    # reopen: recovery must see exactly height 2, replay nothing extra
+    lg2 = KVLedger("ch", LedgerConfig(root=root))
+    assert lg2.height == 2
+    assert lg2.last_recovery["replayed_blocks"] == 0
+    # re-deliver the dropped blocks (deliver retry) — exactly once each.
+    # Their headers still chain from the stored tip because finish never
+    # mutated header bytes, only metadata.
+    for block in blocks[2:]:
+        lg2.commit(block)
+    assert _ledger_snapshot(lg2, keys) == want
+
+
+def test_early_abort_overlay_guard_midwindow(org):
+    """Savepoint in [N-W, N-1]: dooming keeps working when the overlay
+    covers the gap; overlay-touched keys are judged uncertain (never
+    doomed); an uncovered gap dooms nothing."""
+    db = seeded_db()     # savepoint == 1
+    envs = [
+        # stale read of an untouched key: doomable even mid-window
+        tx(org, rw(reads=[KVRead("k02", Version(9, 9))])),
+        # stale-LOOKING read of an overlay key: uncertain, not doomed
+        tx(org, rw(reads=[KVRead("k03", Version(9, 9))])),
+        # scan over an interval the overlay touches: uncertain
+        tx(org, rw(rqs=[RangeQueryInfo(
+            "k03", "k05", True,
+            (KVRead("k03", Version(1, 3)), KVRead("k04", Version(1, 4))))])),
+    ]
+    block = _block_of(envs, number=4)    # savepoint 1, block 4: gap 2..3
+    analyzer = EarlyAbortAnalyzer(db, "ch")
+    # no overlay: guard fails, nothing doomed
+    assert analyzer.doomed(block) == {}
+    # overlay covering the gap, touching k03
+    overlay = PendingOverlay([2, 3], [("cc", "k03")])
+    doomed = analyzer.doomed(block, overlay=overlay)
+    assert doomed == {0: ValidationCode.MVCC_READ_CONFLICT}
+    # partial cover: guard fails again
+    partial = PendingOverlay([3], [("cc", "k03")])
+    assert analyzer.doomed(block, overlay=partial) == {}
+    # overlay that already contains this block: stale snapshot, refuse
+    stale = PendingOverlay([2, 3, 4], [("cc", "k03")])
+    assert analyzer.doomed(block, overlay=stale) == {}
+    # overlay_source wiring delivers the same verdict
+    analyzer2 = EarlyAbortAnalyzer(db, "ch",
+                                   overlay_source=lambda: overlay)
+    assert analyzer2.doomed(block) == doomed
+
+
+def test_pipelined_committer_stream_matches_serial(sw_provider, org):
+    """PipelinedCommitter end to end: futures resolve in order, the
+    stream's commit hash and state match the serial Committer."""
+    org1 = DevOrg("Org1")
+    msps = {org1.mspid: CachedMSP(org1.msp())}
+
+    def mk(rwset):
+        return build.endorser_tx("ch", "cc", "1.0", rwset,
+                                 org1.new_identity("c"),
+                                 [org1.new_identity("e")])
+
+    def rws(reads=(), writes=()):
+        return TxRwSet((NsRwSet("cc", reads=tuple(reads),
+                                writes=tuple(writes)),))
+
+    blocks_envs = [
+        [mk(rws(writes=[KVWrite("a", b"1"), KVWrite("b", b"2")]))],
+        [mk(rws(reads=[KVRead("a", Version(0, 0))],
+                writes=[KVWrite("a", b"3")])),
+         mk(rws(writes=[KVWrite("c", b"4")]))],
+        [mk(rws(reads=[KVRead("c", Version(1, 1))],
+                writes=[KVWrite("c", b"5")]))],
+    ]
+
+    def build_committer(window):
+        policies = PolicyRegistry()
+        policies.set_policy("cc", parse_policy("OR('Org1.member')"))
+        ledger = KVLedger("ch", LedgerConfig(commit_window=window))
+        validator = TxValidator("ch", msps, sw_provider, policies)
+        return Committer(ledger, validator)
+
+    # serial reference
+    ser = build_committer(0)
+    for envs in blocks_envs:
+        lg = ser.ledger
+        prev = (lg.blockstore.chain_info().current_hash
+                if lg.height else b"\x00" * 32)
+        ser.store_block(build.new_block(lg.height, prev, envs))
+
+    # pipelined: submit everything, then collect futures
+    from fabric_tpu.committer import PipelinedCommitter
+    from fabric_tpu.protocol import block_header_hash
+    pc_committer = build_committer(4)
+    pipe = PipelinedCommitter(pc_committer)
+    try:
+        futs, prev, num = [], b"\x00" * 32, 0
+        for envs in blocks_envs:
+            block = build.new_block(num, prev, envs)
+            futs.append(pipe.submit(block))
+            prev = block_header_hash(block.header)
+            num += 1
+        results = [f.result(timeout=30) for f in futs]
+        pipe.drain(timeout=30)
+    finally:
+        pipe.close()
+    assert [r.final_flags.valid_count() for r in results] == [1, 2, 1]
+    lg_p, lg_s = pc_committer.ledger, ser.ledger
+    assert lg_p.commit_hash == lg_s.commit_hash
+    for k in ("a", "b", "c"):
+        assert lg_p.get_state("cc", k) == lg_s.get_state("cc", k)
+    assert lg_p._commit_window.stats()["retired"] == 3

@@ -427,3 +427,74 @@ def test_scenario_plans_compile_seeded_deterministic():
         assert p1.rules, name
         assert [r.as_dict() for r in p1.rules] \
             == [r.as_dict() for r in p2.rules], name
+
+
+# -- per-client think-time models ----------------------------------------
+
+
+def test_think_time_pure_function_of_spec_seed_client():
+    from fabric_tpu.workload import ThinkTimeModel
+    spec = {"kind": "exponential", "mean_s": 0.4}
+    a = ThinkTimeModel.from_spec(spec, seed=9)
+    b = ThinkTimeModel.from_spec(spec, seed=9)
+    c = ThinkTimeModel.from_spec(spec, seed=10)
+    seq_a = [a.delay(3) for _ in range(8)]
+    seq_b = [b.delay(3) for _ in range(8)]
+    seq_c = [c.delay(3) for _ in range(8)]
+    assert seq_a == seq_b              # replayable
+    assert seq_a != seq_c              # seed matters
+    # per-client independence: client 5's stream is not perturbed by
+    # interleaved draws for client 3
+    d = ThinkTimeModel.from_spec(spec, seed=9)
+    solo = [d.delay(5) for _ in range(4)]
+    e = ThinkTimeModel.from_spec(spec, seed=9)
+    interleaved = []
+    for _ in range(4):
+        e.delay(3)
+        interleaved.append(e.delay(5))
+    assert solo == interleaved
+
+
+def test_think_time_kinds_shape_and_validation():
+    from fabric_tpu.workload import ThinkTimeModel
+    exp = ThinkTimeModel("exponential", mean_s=0.5, seed=1)
+    draws = [exp.delay(1) for _ in range(4000)]
+    assert all(d >= 0.0 for d in draws)
+    assert 0.4 < sum(draws) / len(draws) < 0.6     # mean ~= mean_s
+    logn = ThinkTimeModel("lognormal", median_s=0.3, sigma=1.0, seed=1)
+    ldraws = sorted(logn.delay(1) for _ in range(4001))
+    assert all(d > 0.0 for d in ldraws)
+    assert 0.25 < ldraws[len(ldraws) // 2] < 0.36  # median ~= median_s
+    with pytest.raises(ValueError, match="unknown think-time kind"):
+        ThinkTimeModel("pareto")
+    assert exp.describe() == {"kind": "exponential", "seed": 1,
+                              "mean_s": 0.5}
+    assert logn.describe() == {"kind": "lognormal", "seed": 1,
+                               "median_s": 0.3, "sigma": 1.0}
+
+
+def test_think_time_spaces_per_client_arrivals():
+    """The runner's adjustment rule: a client's next op fires no sooner
+    than its previous op + its own think delay — reproduce the rule here
+    and check it pushes same-client arrivals apart but leaves distinct
+    clients on the raw schedule."""
+    from fabric_tpu.workload import ThinkTimeModel
+    model = ThinkTimeModel.from_spec({"kind": "exponential",
+                                      "mean_s": 0.5}, seed=3)
+    schedule = [i * 0.001 for i in range(20)]      # dense burst
+    clients = [1] * 10 + list(range(2, 12))        # hot client + singles
+    last_at, adjusted = {}, []
+    for t, c in zip(schedule, clients):
+        prev = last_at.get(c)
+        t2 = t if prev is None else max(t, prev + model.delay(c))
+        last_at[c] = t2
+        adjusted.append(t2)
+    hot = [t for t, c in zip(adjusted, clients) if c == 1]
+    assert hot == sorted(hot)
+    # consecutive ops of the hot client are think-time separated
+    gaps = [b - a for a, b in zip(hot, hot[1:])]
+    assert all(g > 0.0 for g in gaps) and sum(gaps) > 0.5
+    # each single-op client keeps its raw offset
+    for t, t2, c in zip(schedule, adjusted, clients):
+        if c != 1:
+            assert t2 == t
