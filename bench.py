@@ -1406,6 +1406,102 @@ def main():
         "detail": detail,
     }
     print(json.dumps(result))
+    try:
+        _perf_trajectory(result)
+    except Exception as exc:
+        print(f"perf-trajectory check skipped: {exc!r}", file=sys.stderr)
+
+
+# ---------------------------------------------------------------------------
+# perf trajectory: this run vs the previous round's BENCH artifact
+# ---------------------------------------------------------------------------
+
+def _bench_numbers(doc: dict) -> dict:
+    """Flatten one bench result (headline value, vs_baseline, numeric
+    detail keys) into {key: float} for round-over-round comparison."""
+    out = {}
+    for k in ("value", "vs_baseline"):
+        if isinstance(doc.get(k), (int, float)):
+            out[k] = float(doc[k])
+    for k, v in (doc.get("detail") or {}).items():
+        if isinstance(v, (int, float)) and not isinstance(v, bool):
+            out[k] = float(v)
+    return out
+
+
+def _higher_is_better(key: str):
+    """True/False/None (None = not a perf direction: counts, configs,
+    provenance — excluded from the regression gate)."""
+    if key in ("value", "vs_baseline", "batch_occupancy") \
+            or key.endswith("_per_sec") or key.endswith("_speedup") \
+            or key.endswith("_frac") or "vs_baseline" in key:
+        return True
+    if key.endswith("_ms") or key.endswith("_s") \
+            or key.endswith("_us_per_block"):
+        return False
+    return None
+
+
+def _perf_trajectory(result: dict, threshold: float = 0.20) -> None:
+    """Compare this run against the newest BENCH_r*.json next to this
+    script and WARN (stderr, non-fatal) on any >threshold regression.
+
+    The r18 0.73x fallback regression sat unnoticed for six rounds
+    because nothing diffed consecutive BENCH artifacts; this prints the
+    diff every run.  BENCH files are driver wrappers ({n, cmd, rc,
+    tail}) whose `tail` holds the result JSON line."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []
+    for fn in os.listdir(here):
+        if fn.startswith("BENCH_r") and fn.endswith(".json"):
+            try:
+                rounds.append((int(fn[7:-5]), fn))
+            except ValueError:
+                continue
+    if not rounds:
+        return
+    n, fn = max(rounds)
+    with open(os.path.join(here, fn)) as f:
+        doc = json.load(f)
+    prev = doc
+    tail = doc.get("tail")
+    if isinstance(tail, str):
+        # the result line is the last parseable JSON line of the tail
+        prev = None
+        for line in reversed(tail.strip().splitlines()):
+            try:
+                prev = json.loads(line)
+                break
+            except ValueError:
+                continue
+        if prev is None:
+            return
+    base, cur = _bench_numbers(prev), _bench_numbers(result)
+    warn = []
+    for key in sorted(base):
+        hib = _higher_is_better(key)
+        if hib is None or key not in cur:
+            continue
+        pv, cv = base[key], cur[key]
+        if pv <= 0:
+            continue
+        delta = (cv - pv) / pv
+        if (hib and delta < -threshold) \
+                or (not hib and delta > threshold):
+            warn.append((key, pv, cv, delta))
+    if not warn:
+        print(f"perf trajectory vs {fn}: no >"
+              f"{threshold * 100:.0f}% regressions "
+              f"({len(base)} keys compared)", file=sys.stderr)
+        return
+    print(f"\nWARN perf trajectory vs {fn} "
+          f"(>{threshold * 100:.0f}% regression):", file=sys.stderr)
+    w = max(len(k) for k, *_ in warn)
+    print(f"  {'key'.ljust(w)}  {'r%02d' % n:>12}  {'now':>12}  "
+          f"{'delta':>8}", file=sys.stderr)
+    for key, pv, cv, delta in warn:
+        print(f"  {key.ljust(w)}  {pv:>12.4g}  {cv:>12.4g}  "
+              f"{delta * 100:>+7.1f}%", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -351,6 +351,39 @@ class OrdererNode:
             _res.register_routes(self.ops, self.resources)
             self.resources.start()
 
+        # continuous sampling profiler + incident capture (same knobs
+        # and zero-overhead guards as the peer: `profiler`/`incidents`
+        # sub-dicts, OFF by default)
+        self.profiler = None
+        prof_cfg = cfg.get("profiler", {})
+        if self.ops is not None and prof_cfg.get("enabled", False):
+            from fabric_tpu.ops_plane import sampler as _sampler
+            self.profiler = _sampler.SamplingProfiler(prof_cfg)
+            _sampler.register_routes(self.ops, self.profiler)
+            self.profiler.start()
+        self.incidents = None
+        inc_cfg = dict(cfg.get("incidents", {}))
+        if self.ops is not None and inc_cfg.get("enabled", False):
+            from fabric_tpu.ops_plane import incidents as _inc
+            inc_cfg.setdefault(
+                "dir", _os.path.join(data_dir, "incidents"))
+            if "peers" not in inc_cfg:
+                own = "%s:%d" % self.ops.addr
+                inc_cfg["peers"] = [
+                    p for p in getattr(self, "trace_peers", [])
+                    if str(p) != own]
+            self.incidents = _inc.IncidentRecorder(
+                inc_cfg, node_name=f"orderer:{self.raft_id}",
+                profiler=self.profiler, timeseries=self.timeseries)
+            if getattr(self, "slo", None) is not None:
+                self.incidents.attach_slo(self.slo)
+            if self.resources is not None:
+                self.incidents.add_source(
+                    "resources", self.resources.collect)
+            self.incidents.add_source(
+                "lifecycle", lambda: {"lifecycle": self.lifecycle})
+            _inc.register_routes(self.ops, self.incidents)
+
     # -- byzantine hooks (cluster entry verifier -> containment plane) -------
 
     def _on_entry_offense(self, channel_id: str, frm_node: int,
@@ -908,6 +941,10 @@ class OrdererNode:
             self.timeseries.stop()
         if getattr(self, "resources", None) is not None:
             self.resources.stop()
+        if getattr(self, "profiler", None) is not None:
+            self.profiler.stop()
+        if getattr(self, "incidents", None) is not None:
+            self.incidents.stop()
         if self.ops is not None:
             self.ops.stop()
 

@@ -1053,6 +1053,63 @@ class PeerNode:
             _res.register_routes(self.ops, self.resources)
             self.resources.start()
 
+        # continuous sampling profiler: GET /profile/sampled, a daemon
+        # thread folding sys._current_frames() into time-bucketed
+        # windows.  OFF by default: disabled, no thread, no counter,
+        # /metrics byte-identical.  FABRIC_TPU_PEER_PROFILER__ENABLED=true
+        self.profiler = None
+        prof_cfg = cfg.get("profiler", {})
+        if self.ops is not None and prof_cfg.get("enabled", False):
+            from fabric_tpu.ops_plane import sampler as _sampler
+            self.profiler = _sampler.SamplingProfiler(prof_cfg)
+            _sampler.register_routes(self.ops, self.profiler)
+            self.profiler.start()
+
+        # incident capture: on SLO alert fire, write a self-contained
+        # incident_NNNN/ bundle (profile windows, slowest traces,
+        # metric history, snapshots, peer fan-out) under data_dir.
+        # OFF by default with the same zero-overhead guard.
+        self.incidents = None
+        inc_cfg = dict(cfg.get("incidents", {}))
+        if self.ops is not None and inc_cfg.get("enabled", False):
+            from fabric_tpu.ops_plane import incidents as _inc
+            inc_cfg.setdefault(
+                "dir", os.path.join(self.data_dir, "incidents"))
+            if "peers" not in inc_cfg:
+                own = "%s:%d" % self.ops.addr
+                inc_cfg["peers"] = [
+                    p for p in getattr(self, "trace_peers", [])
+                    if str(p) != own]
+            self.incidents = _inc.IncidentRecorder(
+                inc_cfg, node_name=f"peer:{self.mspid}",
+                profiler=self.profiler, timeseries=self.timeseries)
+            if self.slo is not None:
+                self.incidents.attach_slo(self.slo)
+            if self.resources is not None:
+                self.incidents.add_source(
+                    "resources", self.resources.collect)
+            if self.byzantine is not None:
+                self.incidents.add_source(
+                    "byzantine", self.byzantine.snapshot)
+            if self.gateway is not None:
+                gw = self.gateway
+
+                def _gw_snapshot():
+                    with gw._lock:
+                        depth = len(gw._queue)
+                        inflight = len(gw._inflight)
+                    return {"queue_depth": depth,
+                            "inflight": inflight,
+                            "lifecycle": gw.lifecycle,
+                            "healthy": gw.broadcaster.healthy(),
+                            "admission": gw.admission.snapshot(),
+                            "orderers": gw.broadcaster.states()}
+
+                self.incidents.add_source("gateway", _gw_snapshot)
+            self.incidents.add_source(
+                "lifecycle", lambda: {"lifecycle": self.lifecycle})
+            _inc.register_routes(self.ops, self.incidents)
+
     def _check_orderers(self):
         """healthz: at least one orderer breaker not OPEN (or no
         broadcast plane configured at all)."""
@@ -1533,6 +1590,10 @@ class PeerNode:
             self.timeseries.stop()
         if getattr(self, "resources", None) is not None:
             self.resources.stop()
+        if getattr(self, "profiler", None) is not None:
+            self.profiler.stop()
+        if getattr(self, "incidents", None) is not None:
+            self.incidents.stop()
         if self.ops is not None:
             self.ops.stop()
 

@@ -229,6 +229,19 @@ def collect_node(addr: str, timeout: float = 2.0) -> dict:
         row["fault_plan"] = f.get("name") if f.get("active") else None
     except Exception:
         row["fault_plan"] = None
+    # incident capture (r19): bundle count + last bundle's objective;
+    # blank on nodes running with `incidents` disabled
+    try:
+        inc = _get_json(addr, "/incidents", timeout)
+        row["inc_count"] = inc.get("count")
+        incidents = inc.get("incidents") or []
+        last = incidents[-1] if incidents else {}
+        row["inc_last"] = last.get("objective")
+        row["inc_partial"] = bool(last.get("partial"))
+    except Exception:
+        row["inc_count"] = None
+        row["inc_last"] = None
+        row["inc_partial"] = False
     try:
         hz = _get_json(addr, "/healthz", timeout)
     except Exception as exc:
@@ -276,10 +289,10 @@ def _fmt_devices(devs) -> str:
 
 _COLS = ("NODE", "HT", "TX/S", "COLLECT", "DISP", "GATE", "COMMIT",
          "OCC", "DEV", "DEVVAL", "OVLP", "VCACHE", "SPEC", "STATE",
-         "RES", "QD", "BRKR", "SHED", "FAULTS", "BYZ", "LIFE", "SLO",
-         "HEALTH")
+         "RES", "QD", "BRKR", "SHED", "FAULTS", "BYZ", "LIFE", "INC",
+         "SLO", "HEALTH")
 _WIDTHS = (21, 6, 8, 9, 9, 9, 9, 5, 10, 9, 5, 6, 5, 11, 9, 4, 5, 9, 7,
-           12, 8, 12, 8)
+           12, 8, 10, 12, 8)
 
 # gateway_admission_state gauge value -> short cell tag
 _ADM_SHORT = {0: "ok", 1: "EVAL", 2: "PROB", 3: "HARD"}
@@ -320,6 +333,22 @@ def _fmt_byz(row: dict) -> str:
     pardons = row.get("byz_pardons")
     if pardons:
         cell += f"+{pardons:.0f}p"
+    return cell
+
+
+def _fmt_inc(row: dict) -> str:
+    """`<bundles>[last objective]` with a `!` suffix when the newest
+    bundle is partial (a peer was unreachable during fan-out); `-` on
+    nodes without the incident recorder, `0` when armed but quiet."""
+    n = row.get("inc_count")
+    if n is None:
+        return "-"
+    cell = f"{n:.0f}"
+    last = row.get("inc_last")
+    if last:
+        cell += f"[{str(last)[:6]}]"
+    if row.get("inc_partial"):
+        cell += "!"
     return cell
 
 
@@ -408,6 +437,7 @@ _SORT_KEYS = {
     "vcache": "vcache", "spec": "spec", "shed": "shed_total",
     "state": "state_keys", "byz": "byz_quarantines", "res": "rss",
     "life": "lifecycle", "devval": "devval_policy_width",
+    "inc": "inc_count",
 }
 
 
@@ -472,7 +502,7 @@ def render(rows: List[dict], spark_name: Optional[str] = None) -> str:
             f"{r.get('queue_depth', 0):.0f}",
             f"{r.get('breakers_open', 0):.0f}",
             _fmt_shed(r),
-            faults, _fmt_byz(r), _fmt_life(r), slo,
+            faults, _fmt_byz(r), _fmt_life(r), _fmt_inc(r), slo,
             str(r.get("health", "?")))
         if spark_name:
             cells = cells + (r.get("spark") or "-",)

@@ -84,8 +84,13 @@ class OperationsServer:
                             ln = int(self.headers.get("Content-Length", "0"))
                             body = self.rfile.read(ln) if ln else b""
                             code, out = fn(self.path, body)
-                            self._send(code, json.dumps(out).encode(),
-                                       "application/json")
+                            if isinstance(out, str):
+                                # routes may return plain text (folded
+                                # profile stacks) instead of a jsonable
+                                self._send(code, out.encode())
+                            else:
+                                self._send(code, json.dumps(out).encode(),
+                                           "application/json")
                         except Exception as exc:
                             self._send(400, str(exc).encode())
                         return True

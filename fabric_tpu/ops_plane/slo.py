@@ -144,6 +144,12 @@ class SloEvaluator:
         self._last_status: List[dict] = []
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # alert-transition hooks (incidents.py attaches here): called
+        # as hook(name, alert_dict) AFTER the transition is recorded.
+        # Invoked under the evaluator lock — hooks must not block
+        # (the incident recorder only spawns a capture thread).
+        self.on_fire = None
+        self.on_clear = None
 
     # -- sampling ------------------------------------------------------------
 
@@ -380,6 +386,12 @@ class SloEvaluator:
         jlog(logger, "slo.alert_fired", level=logging.WARNING,
              **self._alert_attrs(name, o, value, bs, bl))
         self._trace_alert("slo.alert_fired", name, o, value, bs, bl)
+        hook = self.on_fire
+        if hook is not None:
+            try:
+                hook(name, dict(rec))
+            except Exception:
+                logger.exception("slo on_fire hook failed")
 
     def _clear_alert(self, name, o, value, bs, bl) -> None:
         rec = self._active.pop(name, None)
@@ -395,6 +407,13 @@ class SloEvaluator:
         jlog(logger, "slo.alert_cleared",
              **self._alert_attrs(name, o, value, bs, bl))
         self._trace_alert("slo.alert_cleared", name, o, value, bs, bl)
+        hook = self.on_clear
+        if hook is not None:
+            try:
+                hook(name, dict(rec) if rec is not None
+                     else self._alert_attrs(name, o, value, bs, bl))
+            except Exception:
+                logger.exception("slo on_clear hook failed")
 
     def _trace_alert(self, event, name, o, value, bs, bl) -> None:
         """Alert transitions land in the trace stream as a `slo.alert`

@@ -39,12 +39,34 @@ this framework already owns:
   scale_out       background drill: N peers wiped + snapshot-bootstrapped
                   simultaneously from ONE source peer under load (the
                   elastic-join path; exercises concurrent chunk serving)
-  phases          open-loop arrival phases (workload.runner format)
+  gateway         gateway config override passed to every ChaosNet
+                  peer (linger/max_batch/max_queue/admission) — how a
+                  scenario throttles the drain rate STRUCTURALLY so
+                  "overload" is a topology property, not a host-speed
+                  measurement
+  slo             scenario-owned SloEvaluator config (windows +
+                  objective overrides; DEFAULT_OBJECTIVES merge in
+                  unless disabled per-objective with enabled: False)
+  incidents       IncidentRecorder config for `incidents` expect kinds
+                  (cooldown_s, keep, profile_window_s, ...); bundles
+                  land under <base_dir>/incidents and the report
+                  carries their ids + MANIFEST verification verdicts
+  profiler        SamplingProfiler config feeding incident bundles'
+                  profile.json / profile_folded.txt
+  phases          open-loop arrival phases (workload.runner format);
+                  a phase's `think` key is a per-client think-time
+                  spec ({"kind": "exponential", "mean_s": ...} or
+                  {"kind": "lognormal", "median_s": ..., "sigma": ...},
+                  workload.clients.ThinkTimeModel) delaying that
+                  client's next arrival — seeded per client, so burst
+                  clustering replays exactly
   expect          in-run SLO assertions, evaluated before the report is
                   written: convergence, quarantine counts BY REASON,
                   zero-quarantine guarantees for crash-stop-only runs,
                   shed/commit bounds, exactly-once (no duplicate txid
-                  ever committed)
+                  ever committed), incident-bundle presence/absence
+                  (`incidents`: min/max count for an objective prefix +
+                  MANIFEST verification)
 
 Every run is seeded end to end (arrival schedules, fault draws, zipf
 keys) and writes a JSON report artifact next to its data dir (or at
@@ -91,9 +113,14 @@ SCENARIOS: Dict[str, dict] = {
             "OrdererMSP->*": {"latency_s": 0.005, "loss": 0.0},
         },
         "phases": [
+            # per-client lognormal think time rides the diurnal wave:
+            # WAN users pause between submissions, so per-client
+            # arrivals cluster instead of landing memorylessly
             {"name": "diurnal", "duration_s": 8.0,
              "arrivals": {"kind": "diurnal", "base_rate": 12.0,
-                          "amplitude": 0.7, "period_s": 4.0}},
+                          "amplitude": 0.7, "period_s": 4.0},
+             "think": {"kind": "lognormal", "median_s": 0.15,
+                       "sigma": 0.8}},
         ],
         "expect": [
             {"kind": "converged", "min_height": 2},
@@ -240,7 +267,8 @@ SCENARIOS: Dict[str, dict] = {
             {"name": "bursts", "duration_s": 8.0,
              "arrivals": {"kind": "burst", "low_rate": 3.0,
                           "high_rate": 12.0, "period_s": 3.0,
-                          "duty": 0.4}},
+                          "duty": 0.4},
+             "think": {"kind": "exponential", "mean_s": 0.1}},
         ],
         "expect": [
             {"kind": "converged", "min_height": 2},
@@ -268,14 +296,33 @@ SCENARIOS: Dict[str, dict] = {
         # lazily on their first round ~3-6 s into the load — a one-time
         # step the gate should never even see
         "observe": {"interval_s": 0.25, "warmup_s": 6.0},
+        # a clean soak must also capture ZERO incident bundles: the
+        # recorder arms on the shed-rate objective only (the default
+        # objectives are host-timing-sensitive and would make "zero
+        # bundles" a flaky claim), and an unshedding soak never burns it
+        "slo": {
+            "sample_interval_s": 0.5, "short_window_s": 5.0,
+            "long_window_s": 15.0,
+            "objectives": {
+                "shed_rate": {"kind": "max", "source": "counter_rate",
+                              "metric": "gateway_shed_total",
+                              "threshold": 1.0,
+                              "help": "gateway sheds per second"},
+                "commit_p99_s": {"enabled": False},
+                "verify_throughput_floor": {"enabled": False},
+                "breaker_open_frac": {"enabled": False},
+                "overlap_floor": {"enabled": False},
+            }},
         "phases": [
             {"name": "soak", "duration_s": 15.0,
-             "arrivals": {"kind": "constant", "rate": 10.0}},
+             "arrivals": {"kind": "constant", "rate": 10.0},
+             "think": {"kind": "exponential", "mean_s": 0.2}},
         ],
         "expect": [
             {"kind": "converged", "min_height": 2},
             {"kind": "min_committed", "value": 1},
             {"kind": "zero_quarantines"},
+            {"kind": "incidents", "max": 0},
             # fd/thread counts must be dead flat at steady state; RSS
             # and allocator blocks grow legitimately with committed
             # ledger state under a 90%-write mix, so their thresholds
@@ -289,6 +336,63 @@ SCENARIOS: Dict[str, dict] = {
                     {"max_growth_frac": 0.30},
                 "process_allocated_blocks": {"max_growth_frac": 0.40},
             }},
+        ],
+    },
+    "overload-incident": {
+        "description": "structurally throttled gateway flooded at ~5x "
+                       "its drain ceiling: the admission plane sheds, "
+                       "the shed-rate SLO burns, and the flight data "
+                       "recorder must capture EXACTLY ONE verifiable "
+                       "incident bundle naming that objective — the "
+                       "self-diagnosing-overload drill",
+        "topology": {"n_orderers": 1, "peer_orgs": ["Org1"],
+                     "peers_per_org": 1},
+        # max_batch 2 + 250ms linger caps the drain rate structurally
+        # (~8 tx/s), so "overload" is a topology property, not a host-
+        # speed measurement; the short queue forces shedding within the
+        # first burn window
+        "gateway": {"linger_s": 0.25, "max_batch": 2, "max_queue": 16,
+                    "broadcast_deadline_s": 20.0,
+                    "admission": {"enabled": True,
+                                  "queue_high_frac": 0.25,
+                                  "latency_slo_s": 0.4, "dwell_s": 0.5,
+                                  "recover_ratio": 0.6,
+                                  "eval_interval_s": 0.05,
+                                  "retry_after_base_ms": 50}},
+        # only the shed-rate objective is armed (defaults disabled):
+        # the drill must prove the bundle names the RIGHT objective,
+        # so no other objective may fire first.  cooldown outlasts the
+        # run -> "exactly one" is deterministic, not a race
+        "slo": {
+            "sample_interval_s": 0.25, "short_window_s": 2.0,
+            "long_window_s": 6.0,
+            "objectives": {
+                "shed_rate": {"kind": "max", "source": "counter_rate",
+                              "metric": "gateway_shed_total",
+                              "threshold": 1.0,
+                              "help": "gateway sheds per second"},
+                "commit_p99_s": {"enabled": False},
+                "verify_throughput_floor": {"enabled": False},
+                "breaker_open_frac": {"enabled": False},
+                "overlap_floor": {"enabled": False},
+            }},
+        "incidents": {"cooldown_s": 600.0, "keep": 4,
+                      "profile_window_s": 30.0},
+        "profiler": {"hz": 19.0, "window_s": 2.0},
+        "mode": "pool",
+        "phases": [
+            {"name": "flood", "duration_s": 8.0,
+             "arrivals": {"kind": "constant", "rate": 40.0}},
+            # the cool-down lets in-flight batches drain so the
+            # converged gate sees a quiesced ledger
+            {"name": "cool", "duration_s": 4.0,
+             "arrivals": {"kind": "constant", "rate": 1.0}},
+        ],
+        "expect": [
+            {"kind": "incidents", "min": 1, "max": 1,
+             "objective": "shed_rate"},
+            {"kind": "min_committed", "value": 1},
+            {"kind": "converged", "min_height": 1},
         ],
     },
     "rolling-upgrade": {
@@ -942,6 +1046,31 @@ def _check_expectations(spec: dict, net, report: dict,
             elif value_ms > limit:
                 violations.append(
                     f"p99_ms[{obj_name}]: {value_ms}ms > {limit}ms")
+        elif kind == "incidents":
+            # the flight-data-recorder assertion: overload-shaped runs
+            # must capture a bundle NAMING the burning objective
+            # (min>=1); clean runs must capture none (max=0) — a bundle
+            # on a healthy run is itself a regression
+            inc = report.get("incidents") or {}
+            bundles = inc.get("bundles") or []
+            obj = check.get("objective")
+            if obj is not None:
+                bundles = [b for b in bundles
+                           if str(b.get("objective", "")).startswith(obj)]
+            need = int(check.get("min", 0))
+            cap = check.get("max")
+            tag = f"incidents[{obj or '*'}]"
+            got = [(b["id"], b.get("objective")) for b in bundles]
+            if len(bundles) < need:
+                violations.append(
+                    f"{tag}: wanted >={need} bundle(s), got {got}")
+            if cap is not None and len(bundles) > int(cap):
+                violations.append(
+                    f"{tag}: wanted <={cap} bundle(s), got {got}")
+            bad = [b["id"] for b in bundles if not b.get("verified")]
+            if bad:
+                violations.append(
+                    f"{tag}: MANIFEST verification failed for {bad}")
         elif kind == "snapshot_rejoin":
             sr = report.get("snapshot_rejoin") or {}
             if sr.get("base", 0) <= 0:
@@ -1113,7 +1242,9 @@ def run_scenario(name: str, seed: int = 7,
                    peer_orgs=tuple(topo.get("peer_orgs", ["Org1"])),
                    peers_per_org=int(topo.get("peers_per_org", 1)),
                    node_factory=factory,
-                   spare_orderers=int(topo.get("spare_orderers", 0)))
+                   spare_orderers=int(topo.get("spare_orderers", 0)),
+                   gateway_cfg=(dict(spec["gateway"])
+                                if spec.get("gateway") else None))
     plan = build_plan(spec, seed)
     poison_sent: dict = {}
     clients = None
@@ -1121,12 +1252,32 @@ def run_scenario(name: str, seed: int = 7,
     # registry: ChaosNet nodes run without ops servers, so p99_ms
     # expectations sample here — tight windows sized to drill length
     slo_eval = None
-    if any(c.get("kind") == "p99_ms" for c in spec.get("expect", [])):
+    if any(c.get("kind") in ("p99_ms", "incidents")
+           for c in spec.get("expect", [])):
         from fabric_tpu.ops_plane import slo as _slo
-        slo_eval = _slo.SloEvaluator({"sample_interval_s": 0.5,
-                                      "short_window_s": 10.0,
-                                      "long_window_s": 60.0})
+        slo_cfg = {"sample_interval_s": 0.5,
+                   "short_window_s": 10.0,
+                   "long_window_s": 60.0}
+        slo_cfg.update(spec.get("slo", {}))
+        slo_eval = _slo.SloEvaluator(slo_cfg)
         slo_eval.start()
+    # scenario-owned incident recorder (+ sampling profiler feeding its
+    # bundles): `incidents` expect kinds assert that overload-shaped
+    # runs capture a bundle naming the burning objective — and that
+    # clean runs capture none
+    incident_rec = None
+    profiler = None
+    if any(c.get("kind") == "incidents" for c in spec.get("expect", [])):
+        from fabric_tpu.ops_plane import incidents as _inc
+        from fabric_tpu.ops_plane import sampler as _sampler
+        profiler = _sampler.SamplingProfiler(
+            dict(spec.get("profiler", {})))
+        profiler.start()
+        inc_cfg = dict(spec.get("incidents", {}))
+        inc_cfg.setdefault("dir", os.path.join(base_dir, "incidents"))
+        incident_rec = _inc.IncidentRecorder(
+            inc_cfg, node_name=f"scenario:{name}", profiler=profiler)
+        incident_rec.attach_slo(slo_eval)
     # scenario-owned timeseries ring + resource collector (the leak
     # gate's evidence): ChaosNet nodes share this process, so one
     # collector watching the process-global registry sees the whole
@@ -1143,6 +1294,8 @@ def run_scenario(name: str, seed: int = 7,
         interval = float(obs.get("interval_s", 0.25))
         ts_store = _tsm.TimeSeriesStore({"interval_s": interval})
         ts_collector = _res.ResourceCollector({"interval_s": interval})
+    if incident_rec is not None and ts_store is not None:
+        incident_rec.timeseries = ts_store
     try:
         net.start()
         if plan is not None:
@@ -1269,6 +1422,21 @@ def run_scenario(name: str, seed: int = 7,
             ts_store.step()
             ts_store.stop()
             ts_collector.stop()
+        if incident_rec is not None:
+            # the alert's capture thread may still be writing; the
+            # expectation must see the landed bundle, not the race
+            if slo_eval is not None:
+                slo_eval.step()
+            incident_rec.drain(30.0)
+            from fabric_tpu.ops_plane.incidents import verify_bundle
+            bundles = []
+            for meta in incident_rec.list():
+                bpath = os.path.join(incident_rec.dir, meta["id"])
+                bundles.append(dict(
+                    meta, path=bpath,
+                    verified=verify_bundle(bpath)["ok"]))
+            report["incidents"] = {"dir": incident_rec.dir,
+                                   "bundles": bundles}
         violations = _check_expectations(spec, net, report,
                                          slo_eval=slo_eval,
                                          ts_store=ts_store)
@@ -1283,6 +1451,10 @@ def run_scenario(name: str, seed: int = 7,
             d.join(timeout=300.0)
         if slo_eval is not None:
             slo_eval.stop()
+        if incident_rec is not None:
+            incident_rec.stop()
+        if profiler is not None:
+            profiler.stop()
         if ts_collector is not None:
             ts_collector.stop()
         if ts_store is not None:

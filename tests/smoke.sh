@@ -76,6 +76,9 @@ python tests/smoke_proof_gossip.py
 echo "== compressed-soak leak gate (Theil-Sen over resource series, honest + injected fd leak) =="
 python tests/smoke_soak.py
 
+echo "== incident capture drill (SLO burn -> verified 3-node flight-recorder bundle) =="
+python tests/smoke_incident.py
+
 echo "== ASan/UBSan fuzz corpus vs the native wire parser =="
 # Build _fastparse with the sanitizers and drive the full adversarial
 # corpus (tests/test_fastparse.py --asan-corpus) through it: any heap

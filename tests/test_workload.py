@@ -394,7 +394,8 @@ _EXPECT_KINDS = {"converged", "zero_quarantines", "quarantine",
                  "fraud_proofs", "min_committed", "max_shed_frac",
                  "exactly_once", "p99_ms", "snapshot_rejoin",
                  "leak_free", "rolling_upgrade", "no_height_regression",
-                 "membership_churn", "scale_out", "sojourn_p99_ms"}
+                 "membership_churn", "scale_out", "sojourn_p99_ms",
+                 "incidents"}
 
 
 def test_scenario_catalog_is_wellformed():
